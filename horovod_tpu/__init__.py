@@ -1,0 +1,88 @@
+"""horovod_tpu: a TPU-native distributed deep-learning training framework
+with Horovod's capabilities (reference: richardliaw/horovod, read-only at
+/root/reference).
+
+    import horovod_tpu as hvd
+    hvd.init()
+    step = hvd.wrap_step(train_step)        # collectives lower to ICI
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+
+Public surface mirrors `horovod.torch`/`horovod.tensorflow`
+(init/rank/size/allreduce/allgather/broadcast/alltoall/join/
+DistributedOptimizer/Compression/elastic/run), re-designed TPU-first:
+collectives are XLA ops over a `jax.sharding.Mesh` (ICI/DCN), the async
+name-negotiated engine serves the eager path, and the parallel layer
+adds mesh-axis parallelism (tp/pp/sp/ep) the reference never had.
+"""
+from .version import __version__
+
+from .common.basics import (
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    is_homogeneous,
+    mesh,
+    axis_name,
+    mode,
+    mpi_built,
+    nccl_built,
+    gloo_built,
+    ccl_built,
+    xla_built,
+    tcp_built,
+)
+from .common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from .common.functions import (
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    broadcast_object,
+    allgather_object,
+)
+from .common.types import (
+    Average,
+    Sum,
+    Adasum,
+    Min,
+    Max,
+    Product,
+    ReduceOp,
+)
+from .ops import (
+    allreduce,
+    allreduce_async,
+    grouped_allreduce,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_async,
+    alltoall,
+    alltoall_async,
+    reducescatter,
+    join,
+    barrier,
+    poll,
+    synchronize,
+)
+from .ops.compression import Compression
+from .ops.sync_batch_norm import SyncBatchNorm, sync_batch_stats
+from .optim.distributed import (
+    DistributedOptimizer,
+    DistributedGradientTape,
+    distributed_value_and_grad,
+)
+from .parallel import mesh as mesh_utils
+from .parallel.step import wrap_step
+
+from . import elastic
+from . import callbacks
+
+__all__ = [k for k in dir() if not k.startswith("_")]

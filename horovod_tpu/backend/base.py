@@ -1,0 +1,78 @@
+"""Data-plane backend interface.
+
+The reference dispatches each collective to the first enabled op in an
+ordered backend list (NCCL → MPI → Gloo → CPU; ref: horovod/common/
+operations.cc:142-249 CreateOperationManager, ops/operation_manager.cc:
+42-122). The TPU build has two data planes:
+
+  * XLA collectives over ICI — the traced path (ops/traced.py); and
+  * a host-side backend for the eager process-mode engine, operating on
+    numpy buffers: TCP full mesh (Gloo-equivalent) or trivial local.
+
+This module defines the interface both the engine and the controller
+transport use.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+from ..engine.controller import ControllerTransport
+
+
+class Backend(ControllerTransport):
+    """Combined control-plane transport + data-plane collectives
+    (the reference splits these into Controller and ops; the TCP socket
+    mesh naturally serves both, as Gloo does in the reference)."""
+
+    rank: int = 0
+    size: int = 1
+
+    # -- data plane -----------------------------------------------------
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        raise NotImplementedError
+
+    def allgatherv(self, arr: np.ndarray, first_dims: List[int]) -> np.ndarray:
+        """Concatenate per-rank arrays along dim 0; `first_dims[r]` is rank
+        r's first-dim size (ref: AllgatherOp displacement math,
+        collective_operations.h:148-185)."""
+        raise NotImplementedError
+
+    def broadcast(self, arr: Optional[np.ndarray], root: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def alltoallv(
+        self, arr: np.ndarray, splits: List[int]
+    ) -> Tuple[np.ndarray, List[int]]:
+        """Send splits[r] rows to rank r; returns (received, recv_splits)
+        (ref: AlltoallOp, collective_operations.h:206-256)."""
+        raise NotImplementedError
+
+    def shutdown(self):
+        pass
+
+
+def _reduce(op: ReduceOp, arrays: List[np.ndarray]) -> np.ndarray:
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        out = arrays[0].copy()
+        for a in arrays[1:]:
+            out += a
+        if op == ReduceOp.AVERAGE:
+            out = out / len(arrays)
+        return out
+    if op == ReduceOp.MIN:
+        return np.minimum.reduce(arrays)
+    if op == ReduceOp.MAX:
+        return np.maximum.reduce(arrays)
+    if op == ReduceOp.PRODUCT:
+        out = arrays[0].copy()
+        for a in arrays[1:]:
+            out *= a
+        return out
+    if op == ReduceOp.ADASUM:
+        from ..ops.adasum import adasum_numpy
+
+        return adasum_numpy(arrays)[0]
+    raise ValueError(f"unsupported op {op}")

@@ -1,0 +1,123 @@
+"""Worker-side elastic plumbing: rank re-assignment + host-update
+notifications.
+
+(ref: horovod/common/gloo/gloo_context.cc:157-200 — on reset a worker
+GETs its new rank/size from the rendezvous `rank_and_size` scope keyed
+by hostname:local_rank, rank==-1 meaning it was removed; and
+horovod/runner/elastic/worker.py — WorkerNotificationService/Manager.)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .rendezvous import RendezvousClient
+
+logger = get_logger()
+
+RANK_AND_SIZE_SCOPE = "rank_and_size"
+NOTIFY_SCOPE = "workers_notify"
+
+
+def _rendezvous() -> Optional[RendezvousClient]:
+    addr = env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR)
+    port = env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0)
+    if not addr or not port:
+        return None
+    return RendezvousClient(addr, port)
+
+
+def refresh_topology_from_rendezvous():
+    """Update HOROVOD_RANK/SIZE/... env from the driver's latest slot
+    assignment (ref: gloo_context.cc:157-200)."""
+    rdv = _rendezvous()
+    if rdv is None:
+        return
+    hostname = env_cfg.get_str(env_cfg.HOSTNAME, "localhost")
+    local_rank = env_cfg.get_int(env_cfg.LOCAL_RANK, 0)
+    key = f"{hostname}:{local_rank}"
+    data = rdv.wait_get(RANK_AND_SIZE_SCOPE, key).decode()
+    vals = [int(v) for v in data.split(",")]
+    rank, size, lrank, lsize, crank, csize = vals
+    if rank == -1:
+        logger.info("this worker was removed from the job; exiting")
+        sys.exit(0)
+    os.environ[env_cfg.RANK] = str(rank)
+    os.environ[env_cfg.SIZE] = str(size)
+    os.environ[env_cfg.LOCAL_RANK] = str(lrank)
+    os.environ[env_cfg.LOCAL_SIZE] = str(lsize)
+    os.environ[env_cfg.CROSS_RANK] = str(crank)
+    os.environ[env_cfg.CROSS_SIZE] = str(csize)
+
+
+class _NotifyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode()
+        mgr: WorkerNotificationManager = self.server.manager  # type: ignore
+        mgr._on_hosts_updated(body)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class WorkerNotificationManager:
+    """Receives HostsUpdated pings from the elastic driver and fans them
+    out to registered State listeners
+    (ref: horovod/runner/elastic/worker.py:20-110)."""
+
+    def __init__(self):
+        self._listeners: List = []
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._initialized = False
+
+    def init(self):
+        with self._lock:
+            if self._initialized:
+                return
+            rdv = _rendezvous()
+            if rdv is None or not env_cfg.get_bool(env_cfg.ELASTIC, False):
+                self._initialized = True
+                return
+            self._httpd = ThreadingHTTPServer(("0.0.0.0", 0), _NotifyHandler)
+            self._httpd.manager = self  # type: ignore
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 name="hvd-notify", daemon=True)
+            t.start()
+            port = self._httpd.server_address[1]
+            host = env_cfg.get_str(env_cfg.HOSTNAME, "127.0.0.1") or "127.0.0.1"
+            rank = env_cfg.get_int(env_cfg.RANK, 0)
+            rdv.put(NOTIFY_SCOPE, str(rank), f"{host}:{port}".encode())
+            self._initialized = True
+
+    def register_listener(self, state):
+        with self._lock:
+            self._listeners.append(state)
+
+    def remove_listener(self, state):
+        with self._lock:
+            if state in self._listeners:
+                self._listeners.remove(state)
+
+    def _on_hosts_updated(self, body: str):
+        parts = body.split(",")
+        ts = float(parts[0]) if parts and parts[0] else time.time()
+        res = int(parts[1]) if len(parts) > 1 else 0
+        with self._lock:
+            for l in self._listeners:
+                l.on_hosts_updated(ts, res)
+
+
+notification_manager = WorkerNotificationManager()

@@ -1,0 +1,54 @@
+"""Trivial single-process backend (size 1): every collective is identity.
+Lets user scripts run unmodified without a launcher, like the reference
+running with -np 1."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+from .base import Backend
+
+
+class LocalBackend(Backend):
+    def __init__(self):
+        self.rank = 0
+        self.size = 1
+
+    # control plane
+    def gather_bytes(self, payload: bytes) -> Optional[List[bytes]]:
+        return [payload]
+
+    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+        assert payload is not None
+        return payload
+
+    def allreduce_words(self, words: List[int], op: str) -> List[int]:
+        return list(words)
+
+    def barrier(self):
+        pass
+
+    # data plane
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        return arr.copy()
+
+    def allgatherv(self, arr: np.ndarray, first_dims: List[int]) -> np.ndarray:
+        return arr.copy()
+
+    def broadcast(self, arr: Optional[np.ndarray], root: int) -> np.ndarray:
+        assert arr is not None
+        return arr.copy()
+
+    def alltoallv(
+        self, arr: np.ndarray, splits: List[int]
+    ) -> Tuple[np.ndarray, List[int]]:
+        return arr.copy(), list(splits)
+
+    def adasum_allreduce_all(self, arr: np.ndarray) -> np.ndarray:
+        return arr.copy()
+
+    def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
+        assert payloads is not None
+        return payloads[0]

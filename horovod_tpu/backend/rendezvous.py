@@ -1,0 +1,62 @@
+"""HTTP KV rendezvous client (worker side).
+
+(ref: horovod/runner/http/http_client.py:17-45 read_data_from_kvstore /
+put_data_into_kvstore; the C++ consumer is gloo_context.cc:70-151.)
+"""
+from __future__ import annotations
+
+import http.client
+import time
+from typing import Optional
+
+
+class RendezvousClient:
+    def __init__(self, addr: str, port: int, timeout: float = 60.0):
+        self.addr = addr
+        self.port = port
+        self.timeout = timeout
+
+    def _conn(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.addr, self.port, timeout=10.0)
+
+    def put(self, scope: str, key: str, value: bytes):
+        c = self._conn()
+        try:
+            c.request("PUT", f"/{scope}/{key}", body=value)
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"rendezvous PUT failed: {r.status}")
+        finally:
+            c.close()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        c = self._conn()
+        try:
+            c.request("GET", f"/{scope}/{key}")
+            r = c.getresponse()
+            body = r.read()
+            if r.status == 200:
+                return body
+            return None
+        finally:
+            c.close()
+
+    def wait_get(self, scope: str, key: str) -> bytes:
+        """Poll until the key exists (peers registering)."""
+        deadline = time.monotonic() + self.timeout
+        while True:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"rendezvous key {scope}/{key} never appeared")
+            time.sleep(0.05)
+
+    def delete(self, scope: str):
+        c = self._conn()
+        try:
+            c.request("DELETE", f"/{scope}")
+            c.getresponse().read()
+        finally:
+            c.close()

@@ -1,0 +1,152 @@
+"""Star-topology collective algorithms over abstract gather/bcast.
+
+Rank 0 is the aggregation point, exactly how the reference's controller
+runs its control plane over MPI_Gather/Bcast (ref: mpi_controller.cc:
+108-199). Any transport providing gather_bytes/bcast_bytes gets the full
+data-plane collective set; the TCP mesh and the in-process threaded test
+backend both build on this. (On TPU hardware the data plane is XLA/ICI —
+this path serves CPU process-mode and tests.)
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+from .base import Backend, _reduce
+
+_LEN = struct.Struct("<Q")
+
+
+def pack_array(arr: np.ndarray) -> bytes:
+    # ';' separator: numpy dtype.str can itself contain '|' (e.g. '|u1').
+    head = f"{arr.dtype.str};{','.join(map(str, arr.shape))}".encode()
+    return _LEN.pack(len(head)) + head + np.ascontiguousarray(arr).tobytes()
+
+
+def unpack_array(buf: bytes) -> np.ndarray:
+    (hn,) = _LEN.unpack(buf[:8])
+    head = buf[8 : 8 + hn].decode()
+    dtype_str, shape_str = head.split(";")
+    shape = tuple(int(s) for s in shape_str.split(",")) if shape_str else ()
+    return np.frombuffer(buf[8 + hn :], dtype=np.dtype(dtype_str)).reshape(shape)
+
+
+class StarCollectivesMixin(Backend):
+    """Data-plane collectives via rank-0 aggregation."""
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        if self.size == 1:
+            return arr.copy()
+        gathered = self.gather_bytes(pack_array(arr))
+        if self.rank == 0:
+            arrays = [unpack_array(b) for b in gathered]
+            # Joined ranks contribute empty arrays == zeros
+            # (ref: JoinOp semantics, controller.cc:220-231).
+            nonempty = [a for a in arrays if a.size > 0]
+            out = _reduce(op, nonempty) if nonempty else arrays[0]
+            self.bcast_bytes(pack_array(out))
+            return out.reshape(arr.shape) if arr.size else out
+        out = unpack_array(self.bcast_bytes(None)).copy()
+        return out.reshape(arr.shape) if arr.size and out.size == arr.size else out
+
+    def adasum_allreduce_all(self, arr: np.ndarray) -> np.ndarray:
+        if self.size == 1:
+            return arr.copy()
+        gathered = self.gather_bytes(pack_array(arr))
+        if self.rank == 0:
+            from ..ops.adasum import adasum_numpy
+
+            arrays = [unpack_array(b) for b in gathered]
+            nonempty = [a for a in arrays if a.size > 0]
+            if len(nonempty) & (len(nonempty) - 1) != 0:
+                # Must never silently degrade: the controller rejects
+                # Adasum+join, and enqueue rejects non-power-of-2 worlds,
+                # so this is an internal invariant violation.
+                raise RuntimeError(
+                    f"Adasum requires a power-of-2 contributor count, got "
+                    f"{len(nonempty)}"
+                )
+            out = np.asarray(adasum_numpy(nonempty)[0]) if nonempty else arrays[0]
+            self.bcast_bytes(pack_array(out))
+            return out
+        return unpack_array(self.bcast_bytes(None)).copy()
+
+    def allgatherv(self, arr: np.ndarray, first_dims: List[int]) -> np.ndarray:
+        if self.size == 1:
+            return arr.copy()
+        gathered = self.gather_bytes(pack_array(arr))
+        if self.rank == 0:
+            arrays = [unpack_array(b) for b in gathered]
+            out = (
+                np.concatenate(arrays, axis=0)
+                if arrays[0].ndim
+                else np.stack(arrays)
+            )
+            self.bcast_bytes(pack_array(out))
+            return out
+        return unpack_array(self.bcast_bytes(None)).copy()
+
+    def broadcast(self, arr: Optional[np.ndarray], root: int) -> np.ndarray:
+        if self.size == 1:
+            assert arr is not None
+            return arr.copy()
+        # Root contributes its payload through the gather; rank 0 relays.
+        payload = pack_array(arr) if self.rank == root else b""
+        gathered = self.gather_bytes(payload)
+        if self.rank == 0:
+            chosen = gathered[root]
+            self.bcast_bytes(chosen)
+            return unpack_array(chosen).copy()
+        return unpack_array(self.bcast_bytes(None)).copy()
+
+    def alltoallv(
+        self, arr: np.ndarray, splits: List[int]
+    ) -> Tuple[np.ndarray, List[int]]:
+        if self.size == 1:
+            return arr.copy(), list(splits)
+        # Root-mediated exchange: gather (splits, data), redistribute.
+        head = struct.pack(f"<{self.size}q", *splits)
+        gathered = self.gather_bytes(
+            _LEN.pack(len(head)) + head + pack_array(arr)
+        )
+        if self.rank == 0:
+            all_splits, all_arrays = [], []
+            for buf in gathered:
+                (hn,) = _LEN.unpack(buf[:8])
+                all_splits.append(list(struct.unpack(f"<{self.size}q", buf[8 : 8 + hn])))
+                all_arrays.append(unpack_array(buf[8 + hn :]))
+            src_offsets = [
+                np.concatenate([[0], np.cumsum(s)]).astype(int) for s in all_splits
+            ]
+            per_dest: List[bytes] = []
+            recv_splits_all: List[List[int]] = []
+            for dest in range(self.size):
+                parts = []
+                rsplits = []
+                for src in range(self.size):
+                    offs = src_offsets[src]
+                    parts.append(all_arrays[src][offs[dest] : offs[dest + 1]])
+                    rsplits.append(all_splits[src][dest])
+                out = np.concatenate(parts, axis=0)
+                rs_head = struct.pack(f"<{self.size}q", *rsplits)
+                per_dest.append(_LEN.pack(len(rs_head)) + rs_head + pack_array(out))
+                recv_splits_all.append(rsplits)
+            self.scatter_bytes(per_dest)
+            buf = per_dest[0]
+        else:
+            buf = self.scatter_bytes(None)
+        (hn,) = _LEN.unpack(buf[:8])
+        recv_splits = list(struct.unpack(f"<{self.size}q", buf[8 : 8 + hn]))
+        return unpack_array(buf[8 + hn :]).copy(), recv_splits
+
+    def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
+        """Root sends payloads[r] to rank r. Default: r-indexed bcast
+        fallback; transports override with true point-to-point."""
+        raise NotImplementedError
+
+    def barrier(self):
+        self.gather_bytes(b"")
+        self.bcast_bytes(b"" if self.rank == 0 else None)

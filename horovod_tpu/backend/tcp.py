@@ -1,0 +1,166 @@
+"""TCP full-mesh backend: the Gloo-equivalent control+data plane.
+
+Workers rendezvous through the HTTP KV store (each PUTs its listening
+address, then connects to every lower rank — the same connectFullMesh
+bootstrap gloo performs against the KV store, ref: horovod/common/gloo/
+gloo_context.cc:70-151). All collective traffic then runs over the mesh
+sockets from the engine's single background thread, so no framing tags
+are needed beyond a length prefix (the reference relies on the same
+single-communication-thread invariant, ref: operations.cc:332-351).
+
+Control plane is star-topology at rank 0 (like MPIController's
+Gather/Bcast, ref: mpi_controller.cc:108-199); the data-plane algorithms
+come from StarCollectivesMixin. On TPU hardware the data plane is
+XLA/ICI — this path serves CPU process-mode and tests; the C++ engine
+(horovod_tpu/cc) supersedes it for performance.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from typing import Dict, List, Optional
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .rendezvous import RendezvousClient
+from .star import StarCollectivesMixin
+
+logger = get_logger()
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_all(sock: socket.socket, data: bytes):
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class TcpBackend(StarCollectivesMixin):
+    """Full-mesh sockets; rank 0 doubles as the coordinator."""
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        rendezvous: Optional[RendezvousClient] = None,
+        scope: str = "hvd_mesh",
+    ):
+        self.rank = rank
+        self.size = size
+        self.peers: Dict[int, socket.socket] = {}
+        if size == 1:
+            return
+        if rendezvous is None:
+            addr = env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR, "127.0.0.1")
+            port = env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0)
+            if port == 0:
+                raise RuntimeError(
+                    "TcpBackend needs HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT "
+                    "(set by the hvdrun launcher)"
+                )
+            rendezvous = RendezvousClient(addr, port)
+        self._rendezvous = rendezvous
+        self._connect_full_mesh(scope)
+
+    # ------------------------------------------------------------------
+    def _connect_full_mesh(self, scope: str):
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(self.size)
+        my_port = listener.getsockname()[1]
+        my_host = os.environ.get(env_cfg.HOSTNAME) or "127.0.0.1"
+        self._rendezvous.put(scope, str(self.rank), f"{my_host}:{my_port}".encode())
+
+        # Connect to all lower ranks; accept from all higher ranks.
+        for peer in range(self.rank):
+            addr = self._rendezvous.wait_get(scope, str(peer)).decode()
+            host, port = addr.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_all(s, struct.pack("<i", self.rank))
+            self.peers[peer] = s
+        for _ in range(self.rank + 1, self.size):
+            s, _ = listener.accept()
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            (peer,) = struct.unpack("<i", _recv_frame(s))
+            self.peers[peer] = s
+        listener.close()
+        logger.debug("rank %d: TCP mesh connected (%d peers)", self.rank, len(self.peers))
+
+    # ------------------------------------------------------------------
+    # transport primitives
+    def gather_bytes(self, payload: bytes) -> Optional[List[bytes]]:
+        if self.size == 1:
+            return [payload]
+        if self.rank == 0:
+            out = [payload]
+            for r in range(1, self.size):
+                out.append(_recv_frame(self.peers[r]))
+            return out
+        _send_all(self.peers[0], payload)
+        return None
+
+    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+        if self.size == 1:
+            assert payload is not None
+            return payload
+        if self.rank == 0:
+            assert payload is not None
+            for r in range(1, self.size):
+                _send_all(self.peers[r], payload)
+            return payload
+        return _recv_frame(self.peers[0])
+
+    def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
+        if self.size == 1:
+            assert payloads is not None
+            return payloads[0]
+        if self.rank == 0:
+            assert payloads is not None
+            for r in range(1, self.size):
+                _send_all(self.peers[r], payloads[r])
+            return payloads[0]
+        return _recv_frame(self.peers[0])
+
+    def allreduce_words(self, words: List[int], op: str) -> List[int]:
+        payload = struct.pack(f"<{len(words)}Q", *words)
+        gathered = self.gather_bytes(payload)
+        if self.rank == 0:
+            acc = list(words)
+            for buf in gathered[1:]:
+                other = struct.unpack(f"<{len(buf) // 8}Q", buf)
+                for i in range(min(len(acc), len(other))):
+                    acc[i] = (acc[i] & other[i]) if op == "and" else (acc[i] | other[i])
+                if op == "and" and len(other) < len(acc):
+                    # Peer has fewer cache bits: treat missing as 0.
+                    for i in range(len(other), len(acc)):
+                        acc[i] = 0
+            self.bcast_bytes(struct.pack(f"<{len(acc)}Q", *acc))
+            return acc
+        buf = self.bcast_bytes(None)
+        return list(struct.unpack(f"<{len(buf) // 8}Q", buf))
+
+    # ------------------------------------------------------------------
+    def shutdown(self):
+        for s in self.peers.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.peers.clear()

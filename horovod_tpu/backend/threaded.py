@@ -1,0 +1,84 @@
+"""In-process multi-rank backend for tests.
+
+N Engine instances in one process, each with a ThreadedBackend sharing a
+ThreadedGroup — queue-based gather/bcast/scatter stand in for sockets.
+This lets the full negotiation/fusion/cache/join machinery run cross-
+"rank" in a single pytest process (the reference's analogue is running
+its test matrix under `horovodrun -np 2` on localhost; with one CPU core
+in CI, threads are the cheaper spelling).
+"""
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+from typing import List, Optional
+
+from .star import StarCollectivesMixin
+
+
+class ThreadedGroup:
+    def __init__(self, size: int):
+        self.size = size
+        self.up = [queue.Queue() for _ in range(size)]    # rank -> root
+        self.down = [queue.Queue() for _ in range(size)]  # root -> rank
+
+    def backend(self, rank: int) -> "ThreadedBackend":
+        return ThreadedBackend(self, rank)
+
+
+class ThreadedBackend(StarCollectivesMixin):
+    def __init__(self, group: ThreadedGroup, rank: int):
+        self.group = group
+        self.rank = rank
+        self.size = group.size
+
+    def gather_bytes(self, payload: bytes) -> Optional[List[bytes]]:
+        if self.size == 1:
+            return [payload]
+        if self.rank == 0:
+            out = [payload]
+            for r in range(1, self.size):
+                out.append(self.group.up[r].get(timeout=60))
+            return out
+        self.group.up[self.rank].put(payload)
+        return None
+
+    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+        if self.size == 1:
+            assert payload is not None
+            return payload
+        if self.rank == 0:
+            assert payload is not None
+            for r in range(1, self.size):
+                self.group.down[r].put(payload)
+            return payload
+        return self.group.down[self.rank].get(timeout=60)
+
+    def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
+        if self.size == 1:
+            assert payloads is not None
+            return payloads[0]
+        if self.rank == 0:
+            assert payloads is not None
+            for r in range(1, self.size):
+                self.group.down[r].put(payloads[r])
+            return payloads[0]
+        return self.group.down[self.rank].get(timeout=60)
+
+    def allreduce_words(self, words: List[int], op: str) -> List[int]:
+        payload = struct.pack(f"<{len(words)}Q", *words)
+        gathered = self.gather_bytes(payload)
+        if self.rank == 0:
+            acc = list(words)
+            for buf in gathered[1:]:
+                other = struct.unpack(f"<{len(buf) // 8}Q", buf)
+                for i in range(min(len(acc), len(other))):
+                    acc[i] = (acc[i] & other[i]) if op == "and" else (acc[i] | other[i])
+                if op == "and" and len(other) < len(acc):
+                    for i in range(len(other), len(acc)):
+                        acc[i] = 0
+            self.bcast_bytes(struct.pack(f"<{len(acc)}Q", *acc))
+            return acc
+        buf = self.bcast_bytes(None)
+        return list(struct.unpack(f"<{len(buf) // 8}Q", buf))

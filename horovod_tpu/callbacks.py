@@ -1,0 +1,126 @@
+"""Training-loop callbacks (Keras-style).
+
+(ref: horovod/_keras/callbacks.py:22-192 — BroadcastGlobalVariables,
+MetricAverage, LearningRateSchedule, LearningRateWarmup.)
+
+JAX has no Model.fit, so these are small composable objects for custom
+loops plus pure helpers (optax schedules for warmup).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .common import basics
+from .common.functions import broadcast_parameters
+from .common.types import ReduceOp
+
+
+class Callback:
+    def on_train_begin(self, context: dict):
+        pass
+
+    def on_epoch_begin(self, epoch: int, context: dict):
+        pass
+
+    def on_epoch_end(self, epoch: int, context: dict):
+        pass
+
+    def on_batch_begin(self, batch: int, context: dict):
+        pass
+
+    def on_batch_end(self, batch: int, context: dict):
+        pass
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial params from root so all ranks start identical
+    (ref: _keras/callbacks.py:22-46; torch broadcast_parameters)."""
+
+    def __init__(self, root_rank: int = 0, params_key: str = "params"):
+        self.root_rank = root_rank
+        self.params_key = params_key
+        self._done = False
+
+    def on_train_begin(self, context: dict):
+        if not self._done and self.params_key in context:
+            context[self.params_key] = broadcast_parameters(
+                context[self.params_key], self.root_rank
+            )
+            self._done = True
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over ranks before logging
+    (ref: _keras/callbacks.py:48-88)."""
+
+    def __init__(self, metrics_key: str = "metrics"):
+        self.metrics_key = metrics_key
+
+    def on_epoch_end(self, epoch: int, context: dict):
+        from . import ops
+
+        metrics = context.get(self.metrics_key)
+        if not metrics:
+            return
+        context[self.metrics_key] = {
+            k: float(np.asarray(ops.allreduce(np.asarray(v, dtype=np.float64),
+                                              op=ReduceOp.AVERAGE)))
+            for k, v in metrics.items()
+        }
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply base LR by `multiplier(epoch)` (ref: _keras/callbacks.py:
+    90-132). Works with a mutable lr holder dict: {"lr": float}."""
+
+    def __init__(self, lr_holder: Dict[str, float], multiplier: Callable[[float], float],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True):
+        self.holder = lr_holder
+        self.base = lr_holder.get("lr", 0.0)
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+
+    def on_epoch_begin(self, epoch: int, context: dict):
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        self.holder["lr"] = self.base * self.multiplier(epoch)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from lr/size to lr over warmup_epochs
+    (ref: _keras/callbacks.py:134-192: gradual warmup of Goyal et al.)."""
+
+    def __init__(self, lr_holder: Dict[str, float], warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch: Optional[int] = None,
+                 verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        size = basics.size() if basics.is_initialized() else 1
+
+        def multiplier(epoch):
+            if epoch >= warmup_epochs:
+                return 1.0
+            alpha = (epoch + 1) / float(warmup_epochs)
+            return 1.0 / size * (1 + alpha * (size - 1))
+
+        super().__init__(lr_holder, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs + 1, staircase=False)
+
+
+def warmup_schedule(base_lr: float, warmup_steps: int, size: Optional[int] = None):
+    """Optax-style schedule: lr/size → lr·1 linear warmup then constant —
+    the idiomatic JAX spelling of LearningRateWarmupCallback."""
+    import optax
+
+    n = size if size is not None else (basics.size() if basics.is_initialized() else 1)
+    return optax.join_schedules(
+        [optax.linear_schedule(base_lr / n, base_lr, warmup_steps),
+         optax.constant_schedule(base_lr)],
+        [warmup_steps],
+    )

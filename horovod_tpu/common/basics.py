@@ -1,0 +1,243 @@
+"""Global runtime state + init/shutdown/rank/size introspection.
+
+TPU-native re-design of the reference's HorovodBasics ctypes layer
+(ref: horovod/common/basics.py:22-233) and the C-side InitializeHorovodOnce
+(ref: horovod/common/operations.cc:620-666).
+
+Two execution modes:
+
+* **mesh mode** (default, single-controller SPMD): `init()` builds a 1-D
+  `jax.sharding.Mesh` over every visible chip. `size()` is the number of
+  chips in the data axis; collectives called inside `jit`/`shard_map`
+  lower to XLA collectives over ICI. This is the idiomatic TPU shape of
+  "one rank per accelerator": XLA *is* the communication engine, so the
+  reference's background negotiation thread is unnecessary — the static
+  op set under jit plays the role of a 100%-hit response cache
+  (ref: controller.cc:174-203 fast path).
+
+* **process mode** (launched by `hvdrun`, detected via HOROVOD_RANK env;
+  ref env contract: horovod/runner/gloo_run.py:65-198): classic
+  one-process-per-rank SPMD with the asynchronous name-negotiated engine
+  (horovod_tpu.engine) over a TCP full-mesh backend — the Gloo-equivalent
+  control+data plane — or over XLA collectives when each process owns
+  TPU chips (multi-host).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import List, Optional, Sequence
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .exceptions import NotInitializedError
+from .types import ReduceOp
+
+logger = get_logger()
+
+
+class _State:
+    def __init__(self):
+        self.initialized = False
+        self.mode: Optional[str] = None  # "mesh" | "process"
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.mesh = None
+        self.axis_name = None
+        self.engine = None
+        self.ranks: Optional[List[int]] = None  # subset init (ref: basics.py:33-65)
+        self.lock = threading.Lock()
+
+
+_state = _State()
+
+
+def _init_mesh_mode(devices=None, axis_name: str = "hvd"):
+    import jax
+
+    from ..parallel.mesh import data_parallel_mesh
+
+    _state.mesh = data_parallel_mesh(devices, axis_name)
+    _state.axis_name = axis_name
+    _state.mode = "mesh"
+    _state.size = _state.mesh.size
+    _state.rank = jax.process_index()
+    _state.local_rank = 0
+    _state.local_size = jax.local_device_count()
+    _state.cross_rank = jax.process_index()
+    _state.cross_size = jax.process_count()
+
+
+def _init_process_mode():
+    from ..engine.engine import Engine
+
+    _state.mode = "process"
+    _state.rank = env_cfg.get_int(env_cfg.RANK, 0)
+    _state.size = env_cfg.get_int(env_cfg.SIZE, 1)
+    _state.local_rank = env_cfg.get_int(env_cfg.LOCAL_RANK, 0)
+    _state.local_size = env_cfg.get_int(env_cfg.LOCAL_SIZE, 1)
+    _state.cross_rank = env_cfg.get_int(env_cfg.CROSS_RANK, 0)
+    _state.cross_size = env_cfg.get_int(env_cfg.CROSS_SIZE, 1)
+    _state.engine = Engine(
+        rank=_state.rank,
+        size=_state.size,
+        local_rank=_state.local_rank,
+        local_size=_state.local_size,
+        cross_rank=_state.cross_rank,
+        cross_size=_state.cross_size,
+    )
+    _state.engine.start()
+
+
+def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "hvd",
+         mode: Optional[str] = None):
+    """Initialize the runtime (ref: horovod/common/basics.py:33-65).
+
+    `ranks`: optional subset of ranks forming the communicator (process
+    mode only). `mode`: force "mesh" or "process"; by default process
+    mode is selected when the launcher's HOROVOD_RANK env is present.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        if mode is None:
+            mode = "process" if os.environ.get(env_cfg.RANK) is not None else "mesh"
+        if mode == "process":
+            if ranks is not None:
+                # Subset communicators (process sets) are not wired into
+                # the engine yet; fail loudly rather than silently
+                # spanning the full world (ref: basics.py:33-65).
+                raise NotImplementedError(
+                    "init(ranks=...) subset communicators are not yet "
+                    "supported in process mode"
+                )
+            _init_process_mode()
+        else:
+            _init_mesh_mode(devices, axis_name)
+        _state.initialized = True
+        logger.debug(
+            "horovod_tpu initialized: mode=%s rank=%d size=%d local=%d/%d cross=%d/%d",
+            _state.mode, _state.rank, _state.size, _state.local_rank,
+            _state.local_size, _state.cross_rank, _state.cross_size,
+        )
+
+
+def shutdown():
+    """(ref: horovod/common/basics.py:74-80 → operations.cc horovod_shutdown)"""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.shutdown()
+            _state.engine = None
+        _state.mesh = None
+        _state.initialized = False
+        _state.mode = None
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    """(ref: horovod/common/basics.py:82-86)"""
+    return _state.initialized
+
+
+def _require_init():
+    if not _state.initialized:
+        raise NotInitializedError()
+
+
+def rank() -> int:
+    """Global rank (ref: basics.py:120-133).
+
+    Mesh mode: the controlling process's index (0 on a single host)."""
+    _require_init()
+    return _state.rank
+
+
+def size() -> int:
+    """World size (ref: basics.py:148-160). Mesh mode: number of chips in
+    the data-parallel mesh — one rank per accelerator, TPU-style."""
+    _require_init()
+    return _state.size
+
+
+def local_rank() -> int:
+    """(ref: basics.py:135-146)"""
+    _require_init()
+    return _state.local_rank
+
+
+def local_size() -> int:
+    """(ref: basics.py:162-172)"""
+    _require_init()
+    return _state.local_size
+
+
+def cross_rank() -> int:
+    _require_init()
+    return _state.cross_rank
+
+
+def cross_size() -> int:
+    _require_init()
+    return _state.cross_size
+
+
+def is_homogeneous() -> bool:
+    """(ref: mpi_controller.cc:26-82 homogeneity check)"""
+    _require_init()
+    return _state.size % _state.cross_size == 0
+
+
+def mesh():
+    """The active device mesh (mesh mode) or None (process mode)."""
+    _require_init()
+    return _state.mesh
+
+
+def axis_name() -> Optional[str]:
+    _require_init()
+    return _state.axis_name
+
+
+def mode() -> str:
+    _require_init()
+    return _state.mode
+
+
+def engine():
+    _require_init()
+    return _state.engine
+
+
+# Capability introspection (ref: basics.py:174-208 mpi_built/nccl_built...).
+def xla_built() -> bool:
+    return True
+
+
+def tcp_built() -> bool:
+    return True
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    # The TCP backend is the Gloo-equivalent.
+    return True
+
+
+def ccl_built() -> bool:
+    return False

@@ -1,0 +1,30 @@
+"""Framework exceptions (ref: horovod/common/exceptions.py:17-31)."""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective fails.
+
+    In elastic mode this triggers state restore + re-initialization
+    (ref: horovod/common/exceptions.py:17-22)."""
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised when the set of hosts changed mid-training; the current batch
+    result is still valid, so state is committed rather than restored
+    (ref: horovod/common/exceptions.py:25-31)."""
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self, what: str = "Horovod-TPU"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class TensorValidationError(ValueError):
+    """Cross-rank tensor mismatch detected by the controller
+    (ref: controller.cc:380-657 ConstructResponse error strings)."""

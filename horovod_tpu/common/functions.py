@@ -1,0 +1,87 @@
+"""Object/parameter broadcast + allgather helpers.
+
+(ref: horovod/torch/functions.py:30-262 — broadcast_parameters,
+broadcast_optimizer_state, broadcast_object, allgather_object;
+horovod/tensorflow/functions.py:47-160.)
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import basics
+from .types import ReduceOp
+
+
+def _bcast_leaf(x, root_rank: int):
+    from .. import ops
+
+    arr = np.asarray(x)
+    out = ops.broadcast(arr, root_rank)
+    try:
+        import jax.numpy as jnp
+
+        if not isinstance(x, np.ndarray):
+            return jnp.asarray(np.asarray(out)).astype(arr.dtype).reshape(arr.shape)
+    except ImportError:
+        pass
+    return np.asarray(out).reshape(arr.shape)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a pytree of parameters from root to all ranks
+    (ref: horovod/torch/functions.py:30-60 broadcast_parameters). Returns
+    the synchronized pytree (functional, JAX-style — no in-place)."""
+    import jax
+
+    return jax.tree.map(lambda x: _bcast_leaf(x, root_rank), params)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """(ref: horovod/torch/functions.py:62-107) — optax states are
+    pytrees, so this is the same traversal."""
+    return broadcast_parameters(opt_state, root_rank)
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0, name: Optional[str] = None):
+    """Pickle + broadcast an arbitrary object
+    (ref: horovod/torch/functions.py:186-227)."""
+    from .. import ops
+
+    if basics.size() == 1 or basics.mode() == "mesh":
+        return obj
+    if basics.rank() == root_rank:
+        payload = pickle.dumps(obj)
+        sz = np.array([len(payload)], dtype=np.int64)
+    else:
+        payload = b""
+        sz = np.zeros(1, dtype=np.int64)
+    nm = name or "broadcast_object"
+    sz = np.asarray(ops.broadcast(sz, root_rank, name=f"{nm}.size"))
+    buf = np.frombuffer(payload, dtype=np.uint8).copy() if payload else np.zeros(
+        int(sz[0]), dtype=np.uint8
+    )
+    buf = np.asarray(ops.broadcast(buf, root_rank, name=f"{nm}.data"))
+    return pickle.loads(buf.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """(ref: horovod/torch/functions.py:229-262)"""
+    from .. import ops
+
+    if basics.size() == 1 or basics.mode() == "mesh":
+        return [obj] * basics.size()
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    nm = name or "allgather_object"
+    sizes = np.asarray(
+        ops.allgather(np.array([payload.size], dtype=np.int64), name=f"{nm}.size")
+    )
+    data = np.asarray(ops.allgather(payload, name=f"{nm}.data"))
+    out, off = [], 0
+    for s in sizes.ravel():
+        out.append(pickle.loads(data[off : off + int(s)].tobytes()))
+        off += int(s)
+    return out

@@ -1,0 +1,221 @@
+"""Control-plane wire messages: Request / Response and their lists.
+
+Re-design of the reference's FlatBuffers-based protocol
+(ref: horovod/common/message.h:50-149, horovod/common/wire/message.fbs:18-40).
+We use a compact length-prefixed binary codec (struct-packed) instead of
+FlatBuffers: messages are tiny (names + shapes), the codec has zero
+dependencies, and the identical layout is implemented by the C++ engine
+(horovod_tpu/cc) so both engines speak the same wire format.
+"""
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .types import DataType
+
+
+class RequestType(enum.IntEnum):
+    """(ref: horovod/common/message.h:50-52)"""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+
+
+class ResponseType(enum.IntEnum):
+    """(ref: horovod/common/message.h:147-149)"""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+    ERROR = 8
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<I", len(b)) + b
+
+
+def _unpack_str(buf: bytes, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return buf[off : off + n].decode("utf-8"), off + n
+
+
+def _pack_i64list(xs) -> bytes:
+    return struct.pack("<I", len(xs)) + struct.pack(f"<{len(xs)}q", *xs)
+
+
+def _unpack_i64list(buf: bytes, off: int):
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    xs = list(struct.unpack_from(f"<{n}q", buf, off))
+    return xs, off + 8 * n
+
+
+@dataclass
+class Request:
+    """A worker's announcement that one tensor is ready for a collective
+    (ref: message.h Request; fields mirror wire/message.fbs:18-29)."""
+
+    request_rank: int = 0
+    request_type: RequestType = RequestType.ALLREDUCE
+    tensor_type: DataType = DataType.FLOAT32
+    tensor_name: str = ""
+    root_rank: int = 0
+    device: int = 0
+    tensor_shape: Tuple[int, ...] = ()
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+
+    def serialize(self) -> bytes:
+        head = struct.pack(
+            "<iiiiidd",
+            self.request_rank,
+            int(self.request_type),
+            int(self.tensor_type),
+            self.root_rank,
+            self.device,
+            self.prescale_factor,
+            self.postscale_factor,
+        )
+        return head + _pack_str(self.tensor_name) + _pack_i64list(self.tensor_shape)
+
+    @staticmethod
+    def deserialize(buf: bytes, off: int = 0) -> Tuple["Request", int]:
+        rr, rt, tt, root, dev, pre, post = struct.unpack_from("<iiiiidd", buf, off)
+        off += struct.calcsize("<iiiiidd")
+        name, off = _unpack_str(buf, off)
+        shape, off = _unpack_i64list(buf, off)
+        return (
+            Request(rr, RequestType(rt), DataType(tt), name, root, dev, tuple(shape), pre, post),
+            off,
+        )
+
+
+@dataclass
+class RequestList:
+    """(ref: message.h RequestList; shutdown flag at message.h:120-135)"""
+
+    requests: List[Request] = field(default_factory=list)
+    shutdown: bool = False
+
+    def serialize(self) -> bytes:
+        out = struct.pack("<?I", self.shutdown, len(self.requests))
+        for r in self.requests:
+            out += r.serialize()
+        return out
+
+    @staticmethod
+    def deserialize(buf: bytes) -> "RequestList":
+        shutdown, n = struct.unpack_from("<?I", buf, 0)
+        off = struct.calcsize("<?I")
+        reqs = []
+        for _ in range(n):
+            r, off = Request.deserialize(buf, off)
+            reqs.append(r)
+        return RequestList(reqs, shutdown)
+
+
+@dataclass
+class Response:
+    """Coordinator's instruction to execute a (possibly fused) collective
+    (ref: message.h Response; wire/message.fbs:31-40)."""
+
+    response_type: ResponseType = ResponseType.ALLREDUCE
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+    devices: List[int] = field(default_factory=list)
+    # Allgather: aggregated first-dim sizes per rank; Alltoall: recv splits.
+    tensor_sizes: List[int] = field(default_factory=list)
+    tensor_type: DataType = DataType.FLOAT32
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    last_joined_rank: int = -1
+    # Per-tensor shapes (parallel to tensor_names). Lets every rank —
+    # including joined ranks that never issued the request — populate the
+    # response cache with an identical key, keeping cache-bit assignment
+    # rank-consistent (ref: response_cache.cc put-from-response).
+    tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
+
+    def serialize(self) -> bytes:
+        out = struct.pack(
+            "<iiddi",
+            int(self.response_type),
+            int(self.tensor_type),
+            self.prescale_factor,
+            self.postscale_factor,
+            self.last_joined_rank,
+        )
+        out += struct.pack("<I", len(self.tensor_names))
+        for n in self.tensor_names:
+            out += _pack_str(n)
+        out += _pack_str(self.error_message)
+        out += _pack_i64list(self.devices)
+        out += _pack_i64list(self.tensor_sizes)
+        out += struct.pack("<I", len(self.tensor_shapes))
+        for shp in self.tensor_shapes:
+            out += _pack_i64list(shp)
+        return out
+
+    @staticmethod
+    def deserialize(buf: bytes, off: int = 0) -> Tuple["Response", int]:
+        rt, tt, pre, post, ljr = struct.unpack_from("<iiddi", buf, off)
+        off += struct.calcsize("<iiddi")
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        names = []
+        for _ in range(n):
+            s, off = _unpack_str(buf, off)
+            names.append(s)
+        err, off = _unpack_str(buf, off)
+        devices, off = _unpack_i64list(buf, off)
+        sizes, off = _unpack_i64list(buf, off)
+        (nshapes,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        shapes = []
+        for _ in range(nshapes):
+            shp, off = _unpack_i64list(buf, off)
+            shapes.append(tuple(int(d) for d in shp))
+        return (
+            Response(ResponseType(rt), names, err, [int(d) for d in devices],
+                     sizes, DataType(tt), pre, post, ljr, shapes),
+            off,
+        )
+
+
+@dataclass
+class ResponseList:
+    """(ref: message.h ResponseList)"""
+
+    responses: List[Response] = field(default_factory=list)
+    shutdown: bool = False
+
+    def serialize(self) -> bytes:
+        out = struct.pack("<?I", self.shutdown, len(self.responses))
+        for r in self.responses:
+            out += r.serialize()
+        return out
+
+    @staticmethod
+    def deserialize(buf: bytes) -> "ResponseList":
+        shutdown, n = struct.unpack_from("<?I", buf, 0)
+        off = struct.calcsize("<?I")
+        resps = []
+        for _ in range(n):
+            r, off = Response.deserialize(buf, off)
+            resps.append(r)
+        return ResponseList(resps, shutdown)

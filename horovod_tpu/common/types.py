@@ -1,0 +1,168 @@
+"""Core value types shared across the framework.
+
+TPU-native re-design of the reference's C++ core types:
+  - Status / StatusType   (ref: horovod/common/common.h Status)
+  - DataType              (ref: horovod/common/message.h DataType enum)
+  - TensorShape           (ref: horovod/common/common.h TensorShape)
+  - ReduceOp constants    (ref: horovod/common/basics.py:210-233)
+
+Unlike the reference (C++ structs shared across an ABI), these are plain
+Python dataclasses: the hot data path on TPU is jit-compiled XLA, so the
+host-side types only carry metadata for negotiation/validation, never
+tensor payloads.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class StatusType(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclass
+class Status:
+    """Operation status, mirroring the reference Status semantics
+    (ref: horovod/common/common.h:126-166)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def UnknownError(msg: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def PreconditionError(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def Aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def InvalidArgument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def InProgress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype enum (ref: horovod/common/wire/message.fbs DataType)."""
+
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    BFLOAT16 = 10  # TPU-native addition: bf16 is the TPU's native reduced type
+
+
+_NP_TO_DTYPE = {
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.uint16): DataType.UINT16,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.float16): DataType.FLOAT16,
+    np.dtype(np.float32): DataType.FLOAT32,
+    np.dtype(np.float64): DataType.FLOAT64,
+    np.dtype(np.bool_): DataType.BOOL,
+}
+
+_DTYPE_TO_NP = {v: k for k, v in _NP_TO_DTYPE.items()}
+
+
+def to_wire_dtype(dtype) -> DataType:
+    """Map a numpy/jax dtype to the wire enum."""
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    if name == "bfloat16":
+        return DataType.BFLOAT16
+    return _NP_TO_DTYPE[np.dtype(dtype)]
+
+
+def from_wire_dtype(dt: DataType):
+    if dt == DataType.BFLOAT16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return _DTYPE_TO_NP[DataType(dt)]
+
+
+def dtype_size(dt: DataType) -> int:
+    if dt in (DataType.UINT8, DataType.INT8, DataType.BOOL):
+        return 1
+    if dt in (DataType.UINT16, DataType.INT16, DataType.FLOAT16, DataType.BFLOAT16):
+        return 2
+    if dt in (DataType.INT32, DataType.FLOAT32):
+        return 4
+    return 8
+
+
+@dataclass(frozen=True)
+class TensorShape:
+    """Shape metadata (ref: horovod/common/common.h TensorShape)."""
+
+    dims: Tuple[int, ...] = ()
+
+    @staticmethod
+    def of(x) -> "TensorShape":
+        return TensorShape(tuple(int(d) for d in x.shape))
+
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def to_string(self) -> str:
+        return "[" + ", ".join(str(d) for d in self.dims) + "]"
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops exposed to users (ref: horovod/common/basics.py:210-233
+    Average/Sum/Adasum constants; Min/Max/Product are TPU-native additions
+    that map directly onto lax.pmin/pmax/product psum variants)."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Module-level aliases matching horovod's public names.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT

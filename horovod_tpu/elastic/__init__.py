@@ -1,0 +1,15 @@
+"""Elastic training: worker-side state + fault-tolerant run loop.
+
+(ref: horovod/common/elastic.py:1-168 — State/ObjectState/run_fn;
+horovod/torch/elastic.py:51-84 TorchState.)
+
+Worker loop semantics (ref: common/elastic.py:147-168):
+    loop { state.sync(); train(state);
+           except HorovodInternalError -> state.restore();
+           except HostsUpdatedInterrupt -> (commit is still valid);
+           reset(): hvd.shutdown()+hvd.init(); state.on_reset() }
+"""
+from .state import State, ObjectState, JaxState, TrainStateState
+from .run import run, run_fn
+
+__all__ = ["State", "ObjectState", "JaxState", "TrainStateState", "run", "run_fn"]

@@ -1,0 +1,59 @@
+"""Elastic run loop (ref: horovod/common/elastic.py:115-168 run_fn)."""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+from ..common import basics
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils.logging import get_logger
+from .state import State
+
+logger = get_logger()
+
+
+def _reset():
+    """Full re-initialization with the new topology
+    (ref: common/elastic.py reset → hvd.shutdown()+hvd.init();
+    rank/size are re-read from the rendezvous-updated env)."""
+    from ..backend import elastic_env
+
+    basics.shutdown()
+    elastic_env.refresh_topology_from_rendezvous()
+    basics.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: `@hvd.elastic.run` (ref: common/elastic.py:115-130)."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        return run_fn(func, state, *args, **kwargs)
+
+    return wrapper
+
+
+def run_fn(func: Callable, state: State, *args, **kwargs):
+    """(ref: common/elastic.py:133-168)"""
+    from ..backend.elastic_env import notification_manager
+
+    notification_manager.init()
+    notification_manager.register_listener(state)
+    skip_sync = False
+    try:
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                logger.warning("collective failure; restoring last commit")
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                logger.info("hosts updated; re-initializing")
+                skip_sync = e.skip_sync
+            _reset()
+            state.on_reset()
+    finally:
+        notification_manager.remove_listener(state)

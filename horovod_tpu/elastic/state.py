@@ -1,0 +1,140 @@
+"""Elastic state objects: in-memory replicated checkpoints.
+
+(ref: horovod/common/elastic.py:95-145 State/ObjectState;
+horovod/torch/elastic.py:51-84 TorchState — deepcopy save/restore +
+broadcast sync.)
+
+JAX pytrees make this clean: `save` keeps a host copy of the tree,
+`restore` reinstates it, `sync` broadcasts rank 0's tree so a newly
+added worker starts consistent.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..common import basics
+from ..common.functions import broadcast_object, broadcast_parameters
+
+
+class State:
+    """Base elastic state (ref: common/elastic.py:95-145)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable[[], None]] = []
+        self._host_messages: List[Any] = []
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages.clear()
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        self._host_messages.append((timestamp, update_res))
+
+    def commit(self):
+        """Save + check for pending host updates
+        (ref: common/elastic.py:60-71)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt on all ranks together
+        (ref: common/elastic.py:73-93 — broadcast of the update timestamp
+        keeps ranks in lockstep)."""
+        from ..common.exceptions import HostsUpdatedInterrupt
+
+        if not self._host_messages:
+            return
+        # Synchronize the decision across ranks.
+        ts, res = self._host_messages[-1]
+        agreed = broadcast_object((ts, res), root_rank=0, name="host_update_ts")
+        self._host_messages.clear()
+        raise HostsUpdatedInterrupt(skip_sync=bool(agreed[1]))
+
+    # subclass interface
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """State of picklable attributes (ref: common/elastic.py ObjectState)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._attrs = list(kwargs.keys())
+        self.save()
+
+    def save(self):
+        self._saved = {k: copy.deepcopy(getattr(self, k)) for k in self._attrs}
+
+    def restore(self):
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        synced = broadcast_object(
+            {k: getattr(self, k) for k in self._attrs}, root_rank=0,
+            name="object_state",
+        )
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Elastic state holding JAX pytrees (params/opt_state) plus scalars
+    — the JAX analogue of TorchState (ref: torch/elastic.py:51-84).
+
+    Pytree attributes are synced with tensor broadcasts (not pickle), so
+    large weights ride the collective data plane.
+    """
+
+    def __init__(self, params=None, opt_state=None, **kwargs):
+        self.params = params
+        self.opt_state = opt_state
+        self._tree_attrs = ["params", "opt_state"]
+        super().__init__(**kwargs)
+
+    def save(self):
+        super().save()
+        self._saved_trees = {
+            k: jax.tree.map(np.asarray, getattr(self, k))
+            for k in self._tree_attrs
+            if getattr(self, k) is not None
+        }
+
+    def restore(self):
+        super().restore()
+        for k, v in getattr(self, "_saved_trees", {}).items():
+            setattr(self, k, jax.tree.map(lambda a: a, v))
+
+    def sync(self):
+        for k in self._tree_attrs:
+            v = getattr(self, k)
+            if v is not None:
+                setattr(self, k, broadcast_parameters(v, root_rank=0))
+        super().sync()
+
+
+# Alias for users coming from flax TrainState-centric code.
+TrainStateState = JaxState

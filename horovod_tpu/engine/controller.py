@@ -1,0 +1,425 @@
+"""Coordinator protocol: rank-0 master/worker negotiation of ready tensors.
+
+Re-implementation of the reference controller (ref: horovod/common/
+controller.{h,cc}; protocol documented at controller.h:66-100):
+
+  * every cycle, workers send a RequestList of newly-ready tensors to the
+    coordinator (rank 0); the coordinator counts requests per tensor name
+    (``IncrementTensorCount``, ref: controller.cc:837-860) — a tensor is
+    ready when all ``size - joined_size`` ranks have requested it;
+  * the coordinator validates cross-rank consistency (dtype/shape/op/root,
+    ref: ConstructResponse, controller.cc:380-657) and answers with a
+    (fused) ResponseList, or an ERROR response carrying the mismatch text;
+  * responses are fused up to the fusion threshold
+    (ref: FuseResponses, controller.cc:686-809);
+  * a bit-vector response cache short-circuits negotiation for
+    steady-state tensors (ref: ComputeResponseList fast path,
+    controller.cc:63-358).
+
+The transport is abstract (ref: controller.h:45-59 virtuals); the TCP
+full-mesh backend provides gather/bcast/bitwise ops the way
+MPIController does with MPI_Gather/Bcast (ref: mpi_controller.cc:88-199).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..common.message import (
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+from ..common.types import DataType, dtype_size
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .response_cache import CacheState, ResponseCache
+from .stall import StallInspector
+
+logger = get_logger()
+
+# Flag bits carried in the first word of the cache-coordination bitvector
+# (ref: response_cache.h CacheCoordinator flags).
+_FLAG_HAS_UNCACHED = 1 << 0
+_FLAG_SHUTDOWN = 1 << 1
+
+
+class ControllerTransport:
+    """Abstract control-plane transport (ref: controller.h:45-59,133-146)."""
+
+    rank: int
+    size: int
+
+    def gather_bytes(self, payload: bytes) -> Optional[List[bytes]]:
+        """Workers → coordinator. Returns all payloads on rank 0, None elsewhere."""
+        raise NotImplementedError
+
+    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+        """Coordinator → workers."""
+        raise NotImplementedError
+
+    def allreduce_words(self, words: List[int], op: str) -> List[int]:
+        """Element-wise bitwise 'and'/'or' across ranks
+        (ref: CrossRankBitwiseAnd/Or, controller.h:141-143)."""
+        raise NotImplementedError
+
+    def barrier(self):
+        raise NotImplementedError
+
+
+@dataclass
+class _TensorRecord:
+    requests: List[Request] = field(default_factory=list)
+    ranks: Set[int] = field(default_factory=set)
+
+
+class Controller:
+    def __init__(self, transport: ControllerTransport, size: int, rank: int):
+        self.transport = transport
+        self.size = size
+        self.rank = rank
+        self.is_coordinator = rank == 0
+        self.response_cache = ResponseCache(env_cfg.cache_capacity())
+        self.cache_enabled = env_cfg.get_int(env_cfg.CACHE_CAPACITY, 1) != 0
+        self.fusion_threshold = env_cfg.fusion_threshold_bytes()
+        self.stall_inspector = StallInspector(size)
+        # Coordinator state
+        self.message_table: Dict[str, _TensorRecord] = {}
+        # Join state (ref: global_state.h:103-107, controller.cc:220-308)
+        self.joined_ranks: Set[int] = set()
+        self.joined = False  # this rank called join
+        # Tensor metadata cache for fusion byte accounting
+        self._pending_cached_bits: Set[int] = set()
+        self._sizes_by_name: Dict[str, int] = {}
+        # This rank's in-flight requests, kept until their response arrives
+        # so cache entries can be keyed on the full request signature.
+        self._my_pending_requests: Dict[str, Request] = {}
+
+    # ------------------------------------------------------------------
+    def compute_response_list(
+        self, messages: List[Request], shutdown: bool = False
+    ) -> Tuple[ResponseList, bool]:
+        """One negotiation cycle. Returns (responses, should_shutdown).
+
+        Mirrors Controller::ComputeResponseList (controller.cc:63-358):
+        cache fast path first, then full negotiation for uncached tensors.
+        """
+        # --- split messages into cache hits and misses -----------------
+        uncached: List[Request] = []
+        for req in messages:
+            if req.request_type == RequestType.JOIN:
+                self.joined = True
+                uncached.append(req)
+                continue
+            state = (
+                self.response_cache.cached(req) if self.cache_enabled else CacheState.MISS
+            )
+            if state == CacheState.HIT:
+                self._pending_cached_bits.add(self.response_cache.peek_bit(req.tensor_name))
+            else:
+                if state == CacheState.INVALID:
+                    self.response_cache.erase(req.tensor_name)
+                uncached.append(req)
+                self._my_pending_requests[req.tensor_name] = req
+
+        responses: List[Response] = []
+
+        # --- cache coordination (bitvector AND across ranks) -----------
+        if self.cache_enabled:
+            nwords = 1 + (max(self.response_cache.num_bits(), 1) + 63) // 64
+            flags = 0
+            if uncached:
+                flags |= _FLAG_HAS_UNCACHED
+            if shutdown:
+                flags |= _FLAG_SHUTDOWN
+            if self.joined:
+                # A joined rank participates in every cached collective
+                # with zeros, so it must not veto the AND — mark all bits
+                # (ref: CacheCoordinator joined handling, response_cache.cc).
+                hit_words = [~0 & 0xFFFFFFFFFFFFFFFF] * (nwords - 1)
+            else:
+                hit_words = self.response_cache.bits_to_vector(
+                    self._pending_cached_bits, nwords - 1
+                )
+            # AND of hit bits; OR of flags: send flags complemented through
+            # the AND then recover with a second OR pass, exactly the
+            # two-pass scheme of CacheCoordinator::sync
+            # (ref: response_cache.cc bitvector sync).
+            and_words = self.transport.allreduce_words(hit_words, "and")
+            or_words = self.transport.allreduce_words([flags], "or")
+            flags = or_words[0]
+            common_bits = ResponseCache.vector_to_bits(and_words)
+            shutdown = bool(flags & _FLAG_SHUTDOWN)
+            any_uncached = bool(flags & _FLAG_HAS_UNCACHED)
+
+            # Emit cached responses common to all ranks, in stable bit
+            # order. A joined rank emits them too — it must take part in
+            # the data plane (with zero contributions) or peers block.
+            for bit in sorted(common_bits):
+                if bit in self._pending_cached_bits or (
+                    self.joined and self.response_cache.has_bit(bit)
+                ):
+                    responses.append(self.response_cache.get_response_by_bit(bit))
+                    self._pending_cached_bits.discard(bit)
+        else:
+            any_uncached = True
+
+        # --- full negotiation for uncached tensors ---------------------
+        if any_uncached or not self.cache_enabled:
+            req_list = RequestList(uncached, shutdown=shutdown)
+            gathered = self.transport.gather_bytes(req_list.serialize())
+            if self.is_coordinator:
+                negotiated: List[Response] = []
+                ready_names: List[str] = []
+                joined_before = len(self.joined_ranks)
+                for payload in gathered:
+                    rl = RequestList.deserialize(payload)
+                    shutdown = shutdown or rl.shutdown
+                    for req in rl.requests:
+                        if req.request_type == RequestType.JOIN:
+                            self.joined_ranks.add(req.request_rank)
+                            continue
+                        if self._increment_tensor_count(req):
+                            ready_names.append(req.tensor_name)
+                if len(self.joined_ranks) != joined_before:
+                    # A new join lowers the readiness bar; re-check pending
+                    # tensors (ref: controller.cc:220-231).
+                    need = self.size - len(self.joined_ranks)
+                    for n, rec in self.message_table.items():
+                        if n not in ready_names and len(rec.ranks) >= need:
+                            ready_names.append(n)
+                # All ranks joined → emit JOIN response resetting state
+                # (ref: controller.cc:263-308).
+                if self.joined_ranks and len(self.joined_ranks) == self.size:
+                    negotiated.append(
+                        Response(ResponseType.JOIN, last_joined_rank=max(self.joined_ranks))
+                    )
+                    self.joined_ranks.clear()
+                new_responses = [self._construct_response(n) for n in ready_names]
+                negotiated.extend(self._fuse_responses(new_responses))
+                if self.stall_inspector.check():
+                    shutdown = True
+                # Broadcast only the negotiated responses; every rank
+                # prepends its (identical) cached fast-path list locally.
+                self.transport.bcast_bytes(
+                    ResponseList(negotiated, shutdown=shutdown).serialize()
+                )
+                resp_list = ResponseList(responses + negotiated, shutdown)
+            else:
+                recv = ResponseList.deserialize(self.transport.bcast_bytes(None))
+                resp_list = ResponseList(responses + recv.responses, recv.shutdown)
+            # Populate cache from negotiated responses on every rank so
+            # cache bit assignment stays rank-consistent.
+            if self.cache_enabled:
+                for resp in resp_list.responses:
+                    self._maybe_cache(resp)
+            if any(
+                r.response_type == ResponseType.JOIN for r in resp_list.responses
+            ):
+                self.joined = False
+            return resp_list, resp_list.shutdown
+
+        return ResponseList(responses, shutdown=shutdown), shutdown
+
+    # ------------------------------------------------------------------
+    def _increment_tensor_count(self, req: Request) -> bool:
+        """(ref: IncrementTensorCount, controller.cc:837-860)"""
+        rec = self.message_table.setdefault(req.tensor_name, _TensorRecord())
+        if req.request_rank not in rec.ranks:
+            rec.requests.append(req)
+            rec.ranks.add(req.request_rank)
+        self.stall_inspector.record(req.tensor_name, req.request_rank)
+        return len(rec.ranks) == self.size - len(self.joined_ranks)
+
+    # ------------------------------------------------------------------
+    def _construct_response(self, name: str) -> Response:
+        """Validate cross-rank consistency and build the Response
+        (ref: ConstructResponse, controller.cc:380-657)."""
+        rec = self.message_table.pop(name)
+        self.stall_inspector.remove(name)
+        reqs = rec.requests
+        first = reqs[0]
+
+        def error(msg: str) -> Response:
+            return Response(ResponseType.ERROR, [name], error_message=msg)
+
+        for r in reqs[1:]:
+            if r.request_type != first.request_type:
+                return error(
+                    f"Mismatched collective operations: One rank requested "
+                    f"{first.request_type.name}, another {r.request_type.name}."
+                )
+            if r.tensor_type != first.tensor_type:
+                return error(
+                    f"Mismatched data types: One rank had type "
+                    f"{DataType(first.tensor_type).name}, another "
+                    f"{DataType(r.tensor_type).name}."
+                )
+            if (
+                r.prescale_factor != first.prescale_factor
+                or r.postscale_factor != first.postscale_factor
+            ):
+                return error("Mismatched prescale/postscale factors.")
+
+        rt = first.request_type
+        # Join compatibility gate FIRST: with joined ranks, not every rank
+        # has a request, so per-rank validation below would miss entries
+        # (ref: controller.cc:487-494,568-571 — only allreduce/barrier
+        # support join; Adasum's power-of-2 requirement also breaks).
+        if self.joined_ranks and rt not in (
+            RequestType.ALLREDUCE,
+            RequestType.BARRIER,
+        ):
+            return error(
+                f"{rt.name} is not supported while some ranks have joined."
+            )
+
+        tensor_sizes: List[int] = []
+        if rt == RequestType.ALLREDUCE or rt == RequestType.ADASUM:
+            for r in reqs[1:]:
+                if tuple(r.tensor_shape) != tuple(first.tensor_shape):
+                    return error(
+                        f"Mismatched allreduce tensor shapes: One rank sent "
+                        f"{list(first.tensor_shape)}, another {list(r.tensor_shape)}."
+                    )
+            resp_type = (
+                ResponseType.ADASUM if rt == RequestType.ADASUM else ResponseType.ALLREDUCE
+            )
+        elif rt == RequestType.ALLGATHER:
+            # First dim may differ; trailing dims must match
+            # (ref: controller.cc allgather shape checks).
+            by_rank = {r.request_rank: r for r in reqs}
+            for r in reqs[1:]:
+                if r.tensor_shape[1:] != first.tensor_shape[1:]:
+                    return error(
+                        "Mismatched allgather tensor shapes: all dimensions "
+                        "except the first must match."
+                    )
+                if len(r.tensor_shape) != len(first.tensor_shape):
+                    return error("Mismatched allgather tensor ranks.")
+            tensor_sizes = [
+                int(by_rank[i].tensor_shape[0]) if by_rank[i].tensor_shape else 0
+                for i in range(self.size)
+            ]
+            resp_type = ResponseType.ALLGATHER
+        elif rt == RequestType.BROADCAST:
+            for r in reqs[1:]:
+                if r.root_rank != first.root_rank:
+                    return error(
+                        f"Mismatched broadcast root ranks: One rank sent root "
+                        f"{first.root_rank}, another {r.root_rank}."
+                    )
+                if r.request_rank != first.root_rank and tuple(r.tensor_shape) != tuple(
+                    first.tensor_shape
+                ):
+                    # Non-root shapes must match root's.
+                    pass  # output allocated from root shape; tolerate
+            resp_type = ResponseType.BROADCAST
+        elif rt == RequestType.ALLTOALL:
+            resp_type = ResponseType.ALLTOALL
+        elif rt == RequestType.BARRIER:
+            resp_type = ResponseType.BARRIER
+        else:
+            return error(f"Unsupported request type {rt}")
+
+        return Response(
+            response_type=resp_type,
+            tensor_names=[name],
+            devices=[r.device for r in reqs],
+            tensor_sizes=tensor_sizes,
+            tensor_type=first.tensor_type,
+            prescale_factor=first.prescale_factor,
+            postscale_factor=first.postscale_factor,
+            tensor_shapes=[tuple(first.tensor_shape)],
+        )
+
+    # ------------------------------------------------------------------
+    def _response_bytes(self, resp: Response, req: Request) -> int:
+        n = 1
+        for d in req.tensor_shape:
+            n *= d
+        return n * dtype_size(DataType(resp.tensor_type))
+
+    def _fuse_responses(self, responses: List[Response]) -> List[Response]:
+        """Greedy fusion of same-type/dtype allreduce responses up to the
+        fusion threshold (ref: FuseResponses, controller.cc:686-809, with
+        the dtype look-ahead collapsed into a full scan)."""
+        fused: List[Response] = []
+        pending = [r for r in responses]
+        while pending:
+            base = pending.pop(0)
+            if base.response_type not in (ResponseType.ALLREDUCE,):
+                fused.append(base)
+                continue
+            base_bytes = sum(self._byte_size(base, n) for n in base.tensor_names)
+            i = 0
+            while i < len(pending):
+                cand = pending[i]
+                if (
+                    cand.response_type == base.response_type
+                    and cand.tensor_type == base.tensor_type
+                    and cand.devices == base.devices
+                    and cand.prescale_factor == base.prescale_factor
+                    and cand.postscale_factor == base.postscale_factor
+                    and not cand.error_message
+                ):
+                    cand_bytes = sum(self._byte_size(cand, n) for n in cand.tensor_names)
+                    if base_bytes + cand_bytes <= self.fusion_threshold:
+                        base.tensor_names.extend(cand.tensor_names)
+                        base.tensor_sizes.extend(cand.tensor_sizes)
+                        base.tensor_shapes.extend(cand.tensor_shapes)
+                        base_bytes += cand_bytes
+                        pending.pop(i)
+                        continue
+                i += 1
+            fused.append(base)
+        return fused
+
+    def _byte_size(self, resp: Response, name: str) -> int:
+        # Byte size recorded at request time; fall back to 0.
+        return self._sizes_by_name.get(name, 0)
+
+    def record_tensor_size(self, name: str, nbytes: int):
+        self._sizes_by_name[name] = nbytes
+
+    # ------------------------------------------------------------------
+    def _maybe_cache(self, resp: Response):
+        """Populate the cache from a freshly negotiated response. The key
+        is built purely from Response fields so every rank — including
+        joined ranks that never issued the request — assigns identical
+        cache bits (ref: response_cache.cc put-from-response). Single-
+        tensor responses only: the reference caches pre-fusion responses
+        and re-fuses cached hits (ref: controller.cc:174-203); fused
+        groups here re-negotiate."""
+        for name in resp.tensor_names:
+            self._my_pending_requests.pop(name, None)
+        if resp.response_type in (
+            ResponseType.ALLREDUCE,
+            ResponseType.ADASUM,
+        ) and not resp.error_message and len(resp.tensor_names) == 1:
+            key_req = Request(
+                request_rank=0,
+                request_type=RequestType.ADASUM
+                if resp.response_type == ResponseType.ADASUM
+                else RequestType.ALLREDUCE,
+                tensor_type=DataType(resp.tensor_type),
+                tensor_name=resp.tensor_names[0],
+                root_rank=0,
+                tensor_shape=tuple(resp.tensor_shapes[0])
+                if resp.tensor_shapes
+                else (),
+                prescale_factor=resp.prescale_factor,
+                postscale_factor=resp.postscale_factor,
+            )
+            self.response_cache.put(key_req, resp)
+
+    def synchronize_parameters(self, params: bytes) -> bytes:
+        """Coordinator broadcasts autotuner parameters
+        (ref: Controller::SynchronizeParameters, controller.cc:34-48)."""
+        return self.transport.bcast_bytes(params if self.is_coordinator else None)

@@ -1,0 +1,403 @@
+"""The asynchronous collective engine: background thread + cycle loop.
+
+Re-implementation of the reference's core runtime (ref: horovod/common/
+operations.cc): `Engine.start` spawns the background thread
+(ref: InitializeHorovodOnce, operations.cc:620-666); each cycle sleeps
+``HOROVOD_CYCLE_TIME`` ms, negotiates ready tensors through the
+controller, and executes the resulting (fused) responses
+(ref: RunLoopOnce, operations.cc:566-616; PerformOperation,
+operations.cc:253-330). Framework threads enqueue work and wait on
+handles (ref: EnqueueTensorAllreduce..., operations.cc:840-1068;
+HandleManager, horovod/torch/handle_manager.h).
+
+On TPU this engine serves the *eager* path (process mode). The traced
+path (ops/traced.py) needs none of it: under jit, XLA plays the role of
+the background thread, the fusion buffer and the response cache at once.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.exceptions import HorovodInternalError
+from ..common.message import Request, RequestType, Response, ResponseType
+from ..common.types import ReduceOp, Status, StatusType, to_wire_dtype
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .controller import Controller
+from .tensor_queue import TensorQueue, TensorTableEntry
+from .timeline import (
+    MEMCPY_IN_FUSION_BUFFER,
+    MEMCPY_OUT_FUSION_BUFFER,
+    Timeline,
+)
+
+logger = get_logger()
+
+
+def _scale_np(arr: np.ndarray, factor: float) -> np.ndarray:
+    """Scale preserving dtype; integer tensors scale in float64 then cast
+    back so AVERAGE (postscale 1/size) doesn't zero them out
+    (ref: ScaleBuffer dispatches int types through double,
+    collective_operations.h:89-125)."""
+    if np.issubdtype(arr.dtype, np.integer):
+        return (arr.astype(np.float64) * factor).astype(arr.dtype)
+    return arr * np.asarray(factor, dtype=arr.dtype)
+
+
+class HandleManager:
+    """(ref: horovod/torch/handle_manager.{h,cc})"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Tuple[Status, Optional[np.ndarray]]] = {}
+        self._events: Dict[int, threading.Event] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._events[h] = threading.Event()
+            return h
+
+    def mark_done(self, handle: int, status: Status, result: Optional[np.ndarray]):
+        with self._lock:
+            ev = self._events.get(handle)
+            self._results[handle] = (status, result)
+        if ev is not None:
+            ev.set()
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            return handle in self._results
+
+    def wait(self, handle: int, timeout: Optional[float] = None):
+        ev = self._events.get(handle)
+        if ev is not None and not ev.wait(timeout):
+            raise TimeoutError(f"handle {handle} did not complete")
+        with self._lock:
+            status, result = self._results.pop(handle)
+            self._events.pop(handle, None)
+        if not status.ok():
+            raise HorovodInternalError(status.reason)
+        return result
+
+
+class Engine:
+    def __init__(
+        self,
+        rank: int = 0,
+        size: int = 1,
+        local_rank: int = 0,
+        local_size: int = 1,
+        cross_rank: int = 0,
+        cross_size: int = 1,
+        backend=None,
+    ):
+        self.rank = rank
+        self.size = size
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
+        self._explicit_backend = backend
+        self.backend = None
+        self.controller: Optional[Controller] = None
+        self.tensor_queue = TensorQueue()
+        self.handles = HandleManager()
+        self.timeline = Timeline() if rank == 0 else Timeline(use_env=False)
+        self.cycle_time_s = env_cfg.cycle_time_ms() / 1000.0
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown_requested = threading.Event()
+        self._initialized = threading.Event()
+        self._init_error: Optional[BaseException] = None
+        self._op_counter: Dict[str, int] = {}
+        self._counter_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._background_loop, name="hvd-background", daemon=True
+        )
+        self._thread.start()
+        # Caller spins until initialization completes
+        # (ref: operations.cc:662-664).
+        self._initialized.wait()
+        if self._init_error is not None:
+            raise self._init_error
+
+    def _background_loop(self):
+        try:
+            if self._explicit_backend is not None:
+                self.backend = self._explicit_backend
+            elif self.size == 1:
+                from ..backend.local import LocalBackend
+
+                self.backend = LocalBackend()
+            else:
+                from ..backend.tcp import TcpBackend
+
+                self.backend = TcpBackend(self.rank, self.size)
+            self.controller = Controller(self.backend, self.size, self.rank)
+        except BaseException as e:  # surface rendezvous failures to init()
+            self._init_error = e
+            self._initialized.set()
+            return
+        self._initialized.set()
+        try:
+            while self._run_loop_once():
+                pass
+        except BaseException as e:
+            logger.error("background loop failed: %s", e)
+            self.tensor_queue.finalize(Status.UnknownError(str(e)))
+        finally:
+            self.timeline.shutdown()
+            if self.backend is not None:
+                self.backend.shutdown()
+
+    # ------------------------------------------------------------------
+    def _run_loop_once(self) -> bool:
+        """(ref: RunLoopOnce, operations.cc:566-616)"""
+        time.sleep(self.cycle_time_s)
+        self.timeline.mark_cycle()
+        messages = self.tensor_queue.pop_messages_from_queue()
+        want_shutdown = self._shutdown_requested.is_set()
+        resp_list, should_shutdown = self.controller.compute_response_list(
+            messages, shutdown=want_shutdown
+        )
+        for resp in resp_list.responses:
+            self._perform_operation(resp)
+        if should_shutdown:
+            self.tensor_queue.finalize(Status.Aborted("Horovod has been shut down."))
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    def _perform_operation(self, resp: Response):
+        """(ref: PerformOperation, operations.cc:253-330)"""
+        entries = self.tensor_queue.get_tensor_entries(resp.tensor_names)
+        try:
+            if resp.response_type == ResponseType.ERROR:
+                for e in entries:
+                    self._finish(e, Status.PreconditionError(resp.error_message), None)
+                return
+            if resp.response_type in (ResponseType.ALLREDUCE, ResponseType.ADASUM):
+                self._do_allreduce(resp, entries)
+            elif resp.response_type == ResponseType.ALLGATHER:
+                for e in entries:
+                    out = self.backend.allgatherv(e.tensor, list(resp.tensor_sizes))
+                    self._finish(e, Status.OK(), out)
+            elif resp.response_type == ResponseType.BROADCAST:
+                for e in entries:
+                    arr = e.tensor if self.rank == e.root_rank else None
+                    out = self.backend.broadcast(arr, e.root_rank)
+                    self._finish(e, Status.OK(), out)
+            elif resp.response_type == ResponseType.ALLTOALL:
+                for e in entries:
+                    out, recv_splits = self.backend.alltoallv(e.tensor, e.splits)
+                    e.output = out
+                    self._finish(e, Status.OK(), (out, recv_splits))
+            elif resp.response_type == ResponseType.BARRIER:
+                self.backend.barrier()
+                for e in entries:
+                    self._finish(e, Status.OK(), None)
+            elif resp.response_type == ResponseType.JOIN:
+                # All ranks joined; complete this rank's pending join
+                # entry (the JOIN response carries no tensor names).
+                for e in entries + self.tensor_queue.pop_entries_by_prefix("join."):
+                    self._finish(e, Status.OK(), np.asarray(resp.last_joined_rank))
+            else:
+                for e in entries:
+                    self._finish(
+                        e, Status.UnknownError(f"bad response {resp.response_type}"), None
+                    )
+        except Exception as exc:
+            for e in entries:
+                self._finish(e, Status.UnknownError(str(exc)), None)
+
+    def _do_allreduce(self, resp: Response, entries: List[TensorTableEntry]):
+        adasum = resp.response_type == ResponseType.ADASUM
+        pre, post = resp.prescale_factor, resp.postscale_factor
+        if not entries:
+            # This rank joined: contribute nothing; star data plane treats
+            # missing contributions as zeros (ref: JoinOp semantics,
+            # controller.cc:220-231).
+            if self.size > 1:
+                if adasum:
+                    self.backend.adasum_allreduce_all(np.zeros(0, np.float32))
+                else:
+                    self.backend.allreduce(np.zeros(0, np.float32), ReduceOp.SUM)
+            return
+        name0 = entries[0].tensor_name
+        if len(entries) == 1:
+            buf = entries[0].tensor
+            shapes = None
+        else:
+            # Fusion buffer: flatten + concat (ref: MemcpyInFusionBuffer,
+            # collective_operations.cc).
+            self.timeline.activity_start(name0, MEMCPY_IN_FUSION_BUFFER)
+            shapes = [e.tensor.shape for e in entries]
+            buf = np.concatenate([np.ravel(e.tensor) for e in entries])
+            self.timeline.activity_end(name0)
+        if pre != 1.0:
+            buf = _scale_np(buf, pre)
+        op_name = "ADASUM" if adasum else "ALLREDUCE"
+        self.timeline.activity_start(name0, op_name)
+        if adasum:
+            red = self.backend.adasum_allreduce_all(np.asarray(buf))
+        else:
+            red = self.backend.allreduce(np.asarray(buf), ReduceOp.SUM)
+        self.timeline.activity_end(name0)
+        if post != 1.0:
+            red = _scale_np(red, post)
+        if shapes is None:
+            self._finish(entries[0], Status.OK(), red.reshape(entries[0].tensor.shape))
+        else:
+            self.timeline.activity_start(name0, MEMCPY_OUT_FUSION_BUFFER)
+            off = 0
+            for e, shape in zip(entries, shapes):
+                n = int(np.prod(shape)) if shape else 1
+                self._finish(e, Status.OK(), red[off : off + n].reshape(shape))
+                off += n
+            self.timeline.activity_end(name0)
+
+    def _finish(self, entry: TensorTableEntry, status: Status, result):
+        self.timeline.end(entry.tensor_name, entry.tensor_name.split(".")[0])
+        if entry.callback is not None:
+            entry.callback(status, result)
+
+    # ------------------------------------------------------------------
+    # Enqueue API (ref: EnqueueTensor*, operations.cc:840-1068)
+    def _auto_name(self, op: str, name: Optional[str]) -> str:
+        if name is not None:
+            return f"{op}.{name}"
+        with self._counter_lock:
+            c = self._op_counter.get(op, 0)
+            self._op_counter[op] = c + 1
+        return f"{op}.noname.{c}"
+
+    def _enqueue(
+        self,
+        req_type: RequestType,
+        arr: Optional[np.ndarray],
+        name: str,
+        root_rank: int = 0,
+        prescale: float = 1.0,
+        postscale: float = 1.0,
+        splits: Optional[List[int]] = None,
+    ) -> int:
+        handle = self.handles.allocate()
+        req = Request(
+            request_rank=self.rank,
+            request_type=req_type,
+            tensor_type=to_wire_dtype(arr.dtype) if arr is not None else 0,
+            tensor_name=name,
+            root_rank=root_rank,
+            device=-1,
+            tensor_shape=tuple(arr.shape) if arr is not None else (),
+            prescale_factor=prescale,
+            postscale_factor=postscale,
+        )
+        if arr is not None and self.controller is not None:
+            self.controller.record_tensor_size(name, arr.nbytes)
+
+        def callback(status: Status, result):
+            self.handles.mark_done(handle, status, result)
+
+        entry = TensorTableEntry(
+            tensor_name=name,
+            tensor=arr,
+            root_rank=root_rank,
+            callback=callback,
+            splits=splits,
+        )
+        self.timeline.negotiate_start(name, req_type.name)
+        status = self.tensor_queue.add_to_tensor_queue(entry, req)
+        if not status.ok():
+            self.handles.mark_done(handle, status, None)
+        return handle
+
+    def enqueue_allreduce(
+        self,
+        arr: np.ndarray,
+        name: Optional[str] = None,
+        op: ReduceOp = ReduceOp.SUM,
+        prescale: float = 1.0,
+        postscale: float = 1.0,
+    ) -> int:
+        # AVERAGE lowers to SUM + postscale 1/size
+        # (ref: operations.cc:851-858).
+        if op == ReduceOp.AVERAGE:
+            postscale = postscale / self.size
+            op = ReduceOp.SUM
+        rt = RequestType.ADASUM if op == ReduceOp.ADASUM else RequestType.ALLREDUCE
+        if op == ReduceOp.ADASUM and self.size & (self.size - 1):
+            raise ValueError("Adasum requires a power-of-2 number of ranks")
+        if op in (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT):
+            raise NotImplementedError(
+                "MIN/MAX/PRODUCT eager allreduce lands with the C++ engine; "
+                "use the traced path"
+            )
+        return self._enqueue(
+            rt, np.asarray(arr), self._auto_name("allreduce", name), 0, prescale, postscale
+        )
+
+    def enqueue_allgather(self, arr: np.ndarray, name: Optional[str] = None) -> int:
+        return self._enqueue(
+            RequestType.ALLGATHER, np.asarray(arr), self._auto_name("allgather", name)
+        )
+
+    def enqueue_broadcast(
+        self, arr: np.ndarray, root_rank: int, name: Optional[str] = None
+    ) -> int:
+        return self._enqueue(
+            RequestType.BROADCAST,
+            np.asarray(arr),
+            self._auto_name("broadcast", name),
+            root_rank,
+        )
+
+    def enqueue_alltoall(
+        self, arr: np.ndarray, splits: Optional[List[int]], name: Optional[str] = None
+    ) -> int:
+        arr = np.asarray(arr)
+        if splits is None:
+            if arr.shape[0] % self.size:
+                raise ValueError("tensor dim 0 must be divisible by size when splits=None")
+            splits = [arr.shape[0] // self.size] * self.size
+        if sum(splits) != arr.shape[0]:
+            raise ValueError("splits must sum to tensor dim 0")
+        return self._enqueue(
+            RequestType.ALLTOALL,
+            arr,
+            self._auto_name("alltoall", name),
+            splits=list(splits),
+        )
+
+    def enqueue_join(self) -> int:
+        return self._enqueue(RequestType.JOIN, None, self._auto_name("join", None))
+
+    def enqueue_barrier(self) -> int:
+        return self._enqueue(
+            RequestType.BARRIER,
+            np.zeros(0, np.uint8),
+            self._auto_name("barrier", None),
+        )
+
+    # ------------------------------------------------------------------
+    def poll(self, handle: int) -> bool:
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None):
+        return self.handles.wait(handle, timeout)
+
+    def shutdown(self):
+        if self._thread is None:
+            return
+        self._shutdown_requested.set()
+        self._thread.join(timeout=60)
+        self._thread = None

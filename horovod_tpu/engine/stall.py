@@ -1,0 +1,65 @@
+"""Stall inspector: coordinator-side watchdog for stuck negotiations
+(ref: horovod/common/stall_inspector.{h,cc}:30-96).
+
+Warns when a tensor has been submitted by some ranks but is missing on
+others for > HOROVOD_STALL_CHECK_TIME_SECONDS (default 60); optionally
+aborts after HOROVOD_STALL_SHUTDOWN_TIME_SECONDS.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Set, Tuple
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+
+class StallInspector:
+    def __init__(self, size: int):
+        self.size = size
+        self.enabled = not env_cfg.get_bool(env_cfg.STALL_CHECK_DISABLE, False)
+        self.warning_time = env_cfg.get_float(
+            env_cfg.STALL_CHECK_TIME, env_cfg.DEFAULT_STALL_WARNING_SECONDS
+        )
+        self.shutdown_time = env_cfg.get_float(env_cfg.STALL_SHUTDOWN_TIME, 0.0)
+        self.last_check = time.monotonic()
+        # tensor name -> (first-seen time, set of ready ranks)
+        self.pending: Dict[str, Tuple[float, Set[int]]] = {}
+        self.warned: Set[str] = set()
+
+    def record(self, name: str, rank: int):
+        now = time.monotonic()
+        if name not in self.pending:
+            self.pending[name] = (now, set())
+        self.pending[name][1].add(rank)
+
+    def remove(self, name: str):
+        self.pending.pop(name, None)
+        self.warned.discard(name)
+
+    def check(self) -> bool:
+        """Returns True if the job should abort (stall past shutdown time)."""
+        if not self.enabled:
+            return False
+        now = time.monotonic()
+        if now - self.last_check < min(self.warning_time, 10.0):
+            return False
+        self.last_check = now
+        abort = False
+        for name, (t0, ready) in self.pending.items():
+            age = now - t0
+            if age > self.warning_time and name not in self.warned:
+                missing = sorted(set(range(self.size)) - ready)
+                logger.warning(
+                    "One or more tensors were submitted to be reduced/gathered "
+                    "but were not ready on all ranks for %.0fs. Stalled op: %s "
+                    "[ready ranks: %s] [missing ranks: %s]",
+                    age, name, sorted(ready), missing,
+                )
+                self.warned.add(name)
+            if self.shutdown_time > 0 and age > self.shutdown_time:
+                logger.error("Stall shutdown time exceeded for %s; aborting.", name)
+                abort = True
+        return abort

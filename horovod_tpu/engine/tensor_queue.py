@@ -1,0 +1,88 @@
+"""Pending-tensor queue shared between framework threads and the engine's
+background thread (ref: horovod/common/tensor_queue.{h,cc}:28-63).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..common.message import Request
+from ..common.types import Status
+
+DUPLICATE_NAME_ERROR = (
+    "Requested to collective-op a tensor with the same name as another tensor "
+    "that is currently being processed. "
+    "(ref: horovod/common/common.h:163-166)"
+)
+
+
+@dataclass
+class TensorTableEntry:
+    """(ref: horovod/common/common.h TensorTableEntry)"""
+
+    tensor_name: str
+    tensor: Optional[np.ndarray]
+    output: Optional[np.ndarray] = None
+    root_rank: int = 0
+    device: int = -1  # -1 = host
+    callback: Optional[Callable[[Status, Optional[np.ndarray]], None]] = None
+    # Alltoall splits (ref: operations.cc:979-1042)
+    splits: Optional[List[int]] = None
+
+
+class TensorQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tensor_table: Dict[str, TensorTableEntry] = {}
+        self._message_queue: List[Request] = []
+
+    def add_to_tensor_queue(self, entry: TensorTableEntry, request: Request) -> Status:
+        with self._lock:
+            if entry.tensor_name in self._tensor_table:
+                return Status.InvalidArgument(DUPLICATE_NAME_ERROR)
+            self._tensor_table[entry.tensor_name] = entry
+            self._message_queue.append(request)
+            return Status.OK()
+
+    def pop_messages_from_queue(self) -> List[Request]:
+        with self._lock:
+            msgs, self._message_queue = self._message_queue, []
+            return msgs
+
+    def get_tensor_entries(self, names: List[str]) -> List[TensorTableEntry]:
+        """Remove and return the entries for a response's tensors
+        (ref: tensor_queue.cc GetTensorEntriesFromResponse)."""
+        with self._lock:
+            out = []
+            for n in names:
+                e = self._tensor_table.pop(n, None)
+                if e is not None:
+                    out.append(e)
+            return out
+
+    def get_tensor_entry(self, name: str) -> Optional[TensorTableEntry]:
+        with self._lock:
+            return self._tensor_table.get(name)
+
+    def pop_entries_by_prefix(self, prefix: str) -> List[TensorTableEntry]:
+        """Used to complete local JOIN entries when the all-joined response
+        arrives (the JOIN Response carries no tensor names)."""
+        with self._lock:
+            names = [n for n in self._tensor_table if n.startswith(prefix)]
+            return [self._tensor_table.pop(n) for n in names]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._tensor_table)
+
+    def finalize(self, status: Status):
+        """Abort all pending entries (ref: tensor_queue.cc FinalizeTensorQueue)."""
+        with self._lock:
+            for e in self._tensor_table.values():
+                if e.callback:
+                    e.callback(status, None)
+            self._tensor_table.clear()
+            self._message_queue.clear()

@@ -1,0 +1,119 @@
+"""Chrome-tracing timeline writer.
+
+Re-design of the reference timeline (ref: horovod/common/timeline.{h,cc}
+:47-126): per-tensor lanes with a NEGOTIATING phase (per-rank ready
+ticks), then the op phase with nested activities (QUEUE,
+MEMCPY_IN_FUSION_BUFFER, <BACKEND>_ALLREDUCE, ...). Records are pushed to
+a writer thread through a queue so the hot path never blocks on file IO
+(the reference uses a boost lock-free SPSC ring; a stdlib queue fills the
+same role at Python speeds). Enabled by HOROVOD_TIMELINE=<file> and
+written by the coordinator only (ref: operations.cc:416-429).
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils import env as env_cfg
+
+# Activity names (ref: horovod/common/common.h:32-62)
+QUEUE = "QUEUE"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+NEGOTIATE = "NEGOTIATE"
+
+
+class Timeline:
+    def __init__(self, filename: Optional[str] = None, use_env: bool = True):
+        # use_env=False on non-coordinator ranks: only rank 0 writes
+        # (ref: operations.cc:416-429).
+        if filename is None and use_env:
+            filename = env_cfg.get_str(env_cfg.TIMELINE) or None
+        self.filename = filename
+        self.enabled = bool(self.filename)
+        self.mark_cycles = env_cfg.get_bool(env_cfg.TIMELINE_MARK_CYCLES, False)
+        self._q: "queue.Queue" = queue.Queue(maxsize=1 << 20)
+        self._tids: Dict[str, int] = {}
+        self._writer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._t0 = time.monotonic_ns()
+        if self.enabled:
+            self._writer = threading.Thread(
+                target=self._write_loop, name="hvd-timeline", daemon=True
+            )
+            self._writer.start()
+
+    def _ts(self) -> float:
+        return (time.monotonic_ns() - self._t0) / 1e3  # microseconds
+
+    def _tid(self, tensor_name: str) -> int:
+        if tensor_name not in self._tids:
+            self._tids[tensor_name] = len(self._tids) + 1
+        return self._tids[tensor_name]
+
+    def _emit(self, ev: dict):
+        if not self.enabled:
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            pass
+
+    # -- per-tensor state machine (ref: timeline.h:81-126) --------------
+    def negotiate_start(self, name: str, op_name: str):
+        self._emit({"ph": "B", "name": f"NEGOTIATE_{op_name}", "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts()})
+
+    def negotiate_rank_ready(self, name: str, rank: int):
+        self._emit({"ph": "i", "name": str(rank), "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts(), "s": "t"})
+
+    def negotiate_end(self, name: str, op_name: str):
+        self._emit({"ph": "E", "name": f"NEGOTIATE_{op_name}", "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts()})
+
+    def start(self, name: str, op_name: str):
+        self._emit({"ph": "B", "name": op_name, "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts()})
+
+    def activity_start(self, name: str, activity: str):
+        self._emit({"ph": "B", "name": activity, "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts()})
+
+    def activity_end(self, name: str):
+        self._emit({"ph": "E", "pid": 0, "tid": self._tid(name), "ts": self._ts()})
+
+    def end(self, name: str, op_name: str):
+        self._emit({"ph": "E", "name": op_name, "pid": 0,
+                    "tid": self._tid(name), "ts": self._ts()})
+
+    def mark_cycle(self):
+        if self.mark_cycles:
+            self._emit({"ph": "i", "name": "CYCLE", "pid": 0, "tid": 0,
+                        "ts": self._ts(), "s": "g"})
+
+    # -------------------------------------------------------------------
+    def _write_loop(self):
+        with open(self.filename, "w") as f:
+            f.write("[\n")
+            first = True
+            while not self._stop.is_set() or not self._q.empty():
+                try:
+                    ev = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if not first:
+                    f.write(",\n")
+                f.write(json.dumps(ev))
+                first = False
+                f.flush()
+            f.write("\n]\n")
+
+    def shutdown(self):
+        if self.enabled and self._writer is not None:
+            self._stop.set()
+            self._writer.join(timeout=5)
+            self.enabled = False

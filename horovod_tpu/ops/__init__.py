@@ -1,0 +1,302 @@
+"""Public collective-op API: hvd.allreduce / allgather / broadcast /
+alltoall / join / barrier (+ async variants).
+
+Dispatch (TPU-first design):
+
+* **Traced inputs** (jax tracers inside jit/shard_map): lower directly to
+  XLA collectives over the bound mesh axis (ops/traced.py) — the hot
+  path; zero host involvement.
+* **Concrete inputs, process mode**: the asynchronous name-negotiated
+  engine (ref: horovod/torch/mpi_ops.py:83-219 handle API).
+* **Concrete inputs, mesh mode** (single-controller SPMD): in a single-
+  controller program every "rank" holds the same logical value, so
+  collectives have closed forms (sum = x·size, gather = tile, bcast =
+  identity). This keeps unmodified single-process scripts correct before
+  they are scaled out — the same property `horovodrun -np 1` has in the
+  reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..common import basics
+from ..common.exceptions import HorovodInternalError
+from ..common.types import ReduceOp
+from . import traced as _traced
+
+
+def _is_tracer(x) -> bool:
+    try:
+        import jax.core
+
+        return isinstance(x, jax.core.Tracer)
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _axis_bound(name: str) -> bool:
+    """True when `name` is a live named axis in the current trace
+    (inside shard_map/pmap). Under plain jit/pjit no axis is bound —
+    there, arrays are global and collectives take their closed forms."""
+    try:
+        from jax._src.core import get_axis_env
+
+        return name in get_axis_env().axis_sizes
+    except Exception:  # pragma: no cover — private-API drift
+        return True
+
+
+def _use_traced(x, axis_name: Optional[str]) -> bool:
+    if not _is_tracer(x):
+        return False
+    if axis_name is not None:
+        return True
+    an = basics.axis_name() if basics.is_initialized() else None
+    return an is not None and _axis_bound(an)
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    if axis_name is not None:
+        return axis_name
+    an = basics.axis_name()
+    if an is None:
+        raise ValueError(
+            "no mesh axis bound; pass axis_name= or init() in mesh mode"
+        )
+    return an
+
+
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    # Back-compat `average=` kwarg (ref: horovod/torch/mpi_ops.py:83-110).
+    if op is not None and average is not None:
+        raise ValueError("specify either op= or the legacy average=, not both")
+    if op is None:
+        op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    return op
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+def allreduce(
+    tensor,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    axis_name: Optional[str] = None,
+):
+    """All-reduce across ranks (ref: horovod/tensorflow/__init__.py:52-149,
+    horovod/torch/mpi_ops.py allreduce)."""
+    rop = _resolve_op(op, average)
+    if _use_traced(tensor, axis_name):
+        return _traced.allreduce(
+            tensor, _axis(axis_name), rop, prescale_factor, postscale_factor
+        )
+    if _is_tracer(tensor) and basics.mode() == "process":
+        raise ValueError(
+            "collectives inside jit require a bound mesh axis in process "
+            "mode; wrap the step in shard_map or use the eager API"
+        )
+    if basics.mode() == "process":
+        h = allreduce_async(tensor, name=name, op=rop,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+        return synchronize(h)
+    # mesh mode, concrete
+    import jax.numpy as jnp
+
+    n = basics.size()
+    x = tensor * prescale_factor if prescale_factor != 1.0 else tensor
+    if rop == ReduceOp.SUM:
+        out = x * n
+    elif rop == ReduceOp.AVERAGE:
+        out = x
+    elif rop in (ReduceOp.MIN, ReduceOp.MAX):
+        out = x
+    elif rop == ReduceOp.PRODUCT:
+        out = x**n
+    elif rop == ReduceOp.ADASUM:
+        # n identical vectors adasum-combine to x (pairwise combine of
+        # (v, v) gives v: coefficients (1 - 1/2) + (1 - 1/2) = 1).
+        out = x
+    else:
+        raise ValueError(f"unsupported op {rop}")
+    return out * postscale_factor if postscale_factor != 1.0 else out
+
+
+def allreduce_async(
+    tensor,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> int:
+    """(ref: horovod/torch/mpi_ops.py:117-161)"""
+    rop = _resolve_op(op, average)
+    eng = basics.engine()
+    if eng is None:
+        raise HorovodInternalError("async API requires process mode (hvdrun)")
+    return eng.enqueue_allreduce(
+        np.asarray(tensor), name=name, op=rop,
+        prescale=prescale_factor, postscale=postscale_factor,
+    )
+
+
+def grouped_allreduce(
+    tensors: Sequence,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    axis_name: Optional[str] = None,
+):
+    rop = _resolve_op(op, average)
+    if tensors and _use_traced(tensors[0], axis_name):
+        return _traced.grouped_allreduce(
+            tensors, _axis(axis_name), rop, prescale_factor, postscale_factor
+        )
+    if basics.mode() == "process":
+        base = name or "grouped"
+        handles = [
+            allreduce_async(t, name=f"{base}.{i}", op=rop,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+            for i, t in enumerate(tensors)
+        ]
+        return [synchronize(h) for h in handles]
+    return [
+        allreduce(t, op=rop, prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor)
+        for t in tensors
+    ]
+
+
+# ---------------------------------------------------------------------------
+# allgather
+def allgather(tensor, name: Optional[str] = None, axis_name: Optional[str] = None):
+    """Concatenate ranks' tensors along dim 0; first dims may differ in
+    eager mode (ref: collective_operations.h:148-185)."""
+    if _use_traced(tensor, axis_name):
+        return _traced.allgather(tensor, _axis(axis_name))
+    if basics.mode() == "process":
+        return synchronize(allgather_async(tensor, name=name))
+    import jax.numpy as jnp
+
+    x = jnp.asarray(tensor)
+    reps = (basics.size(),) + (1,) * (x.ndim - 1) if x.ndim else (basics.size(),)
+    return jnp.tile(x if x.ndim else x[None], reps)
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    eng = basics.engine()
+    if eng is None:
+        raise HorovodInternalError("async API requires process mode (hvdrun)")
+    return eng.enqueue_allgather(np.asarray(tensor), name=name)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+def broadcast(
+    tensor, root_rank: int = 0, name: Optional[str] = None,
+    axis_name: Optional[str] = None,
+):
+    """(ref: horovod/torch/mpi_ops.py broadcast)"""
+    if _use_traced(tensor, axis_name):
+        return _traced.broadcast(tensor, root_rank, _axis(axis_name))
+    if basics.mode() == "process":
+        return synchronize(broadcast_async(tensor, root_rank, name=name))
+    return tensor
+
+
+def broadcast_async(tensor, root_rank: int = 0, name: Optional[str] = None) -> int:
+    eng = basics.engine()
+    if eng is None:
+        raise HorovodInternalError("async API requires process mode (hvdrun)")
+    return eng.enqueue_broadcast(np.asarray(tensor), root_rank, name=name)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+def alltoall(
+    tensor, splits: Optional[Sequence[int]] = None, name: Optional[str] = None,
+    axis_name: Optional[str] = None,
+):
+    """(ref: operations.cc:979-1042; uneven splits eager-only — dynamic
+    shapes don't jit). Returns (output, recv_splits) in eager mode to
+    match hvd.alltoall's splits return."""
+    if _use_traced(tensor, axis_name):
+        if splits is not None:
+            raise ValueError("uneven alltoall splits are eager-only on TPU")
+        return _traced.alltoall(tensor, _axis(axis_name))
+    if basics.mode() == "process":
+        return synchronize(alltoall_async(tensor, splits, name=name))
+    import jax.numpy as jnp
+
+    x = jnp.asarray(tensor)
+    return x, [int(s) for s in (splits if splits is not None
+                                else [x.shape[0] // basics.size()] * basics.size())]
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    eng = basics.engine()
+    if eng is None:
+        raise HorovodInternalError("async API requires process mode (hvdrun)")
+    return eng.enqueue_alltoall(
+        np.asarray(tensor), list(splits) if splits is not None else None, name=name
+    )
+
+
+# ---------------------------------------------------------------------------
+# reducescatter (TPU-native addition; the hierarchical building block)
+def reducescatter(tensor, op: Optional[ReduceOp] = None,
+                  axis_name: Optional[str] = None):
+    rop = op or ReduceOp.SUM
+    if _use_traced(tensor, axis_name):
+        return _traced.reducescatter(tensor, _axis(axis_name), rop)
+    if basics.mode() == "process":
+        # Allreduce then take this rank's slice.
+        full = allreduce(tensor, op=rop if rop != ReduceOp.SUM else None,
+                         average=None if rop != ReduceOp.SUM else False)
+        n = basics.size()
+        r = basics.rank()
+        per = full.shape[0] // n
+        return full[r * per : (r + 1) * per]
+    return tensor
+
+
+# ---------------------------------------------------------------------------
+# join / barrier
+def join() -> int:
+    """Signal this rank has exhausted its data; it participates in
+    subsequent allreduces with zeros until every rank joins
+    (ref: operations.cc:1044-1068, controller.cc:220-308). Returns the
+    last joined rank."""
+    if basics.mode() == "process":
+        eng = basics.engine()
+        out = eng.synchronize(eng.enqueue_join())
+        return int(out) if out is not None else -1
+    return basics.size() - 1
+
+
+def barrier():
+    """(ref: horovod barrier op)"""
+    if basics.mode() == "process":
+        eng = basics.engine()
+        eng.synchronize(eng.enqueue_barrier())
+
+
+# ---------------------------------------------------------------------------
+# handle API
+def poll(handle: int) -> bool:
+    """(ref: horovod/torch/mpi_ops.py:poll)"""
+    return basics.engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """(ref: horovod/torch/mpi_ops.py:synchronize)"""
+    return basics.engine().synchronize(handle)

@@ -1,0 +1,72 @@
+"""Gradient compression (ref: horovod/torch/compression.py:20-74,
+horovod/tensorflow/compression.py:46-64).
+
+The reference ships a none-compressor and an fp16 compressor. On TPU the
+natural compressed wire type is bfloat16 (same byte savings as fp16,
+wider exponent range, native MXU type), so `Compression.fp16` maps to
+bf16 by default; `Compression.true_fp16` keeps IEEE fp16 for parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """(ref: compression.py NoneCompressor)"""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """Compress float tensors to bfloat16 for the wire."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and tensor.dtype != jnp.bfloat16:
+            tensor = tensor.astype(jnp.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None and tensor.dtype != ctx else tensor
+
+
+class FP16Compressor(Compressor):
+    """(ref: compression.py FP16Compressor)"""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and tensor.dtype != jnp.float16:
+            tensor = tensor.astype(jnp.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None and tensor.dtype != ctx else tensor
+
+
+class Compression:
+    """(ref: compression.py Compression namespace)"""
+
+    none = NoneCompressor
+    fp16 = BF16Compressor  # TPU-native default: bf16 on the wire
+    true_fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,210 @@
+"""Traced collectives: the XLA/ICI data plane.
+
+These run *inside* jit/pjit/shard_map over a named mesh axis and lower
+directly to XLA collectives on ICI — the TPU-native replacement for the
+reference's NCCL/MPI/Gloo data ops (ref: horovod/common/ops/
+nccl_operations.cc:126-187, mpi_operations.cc:26-186,
+gloo_operations.cc:119-330).
+
+Design note: the reference needs an asynchronous engine because GPU
+frameworks issue ops in nondeterministic order across ranks
+(ref: operations.cc:332-351). Under jit the collective sequence is static
+and identical on every chip, so XLA can schedule, fuse and overlap them —
+the negotiation phase disappears and what remains is exactly these ops.
+Tensor fusion (ref: controller.cc:686-809) maps to XLA's collective
+combiner plus our grouped_* ops which concatenate flat buffers explicitly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.types import ReduceOp
+
+
+def _scale(x, factor):
+    if factor is None or factor == 1.0:
+        return x
+    # Float tensors scale in their own dtype; integer tensors go through
+    # f32 so AVERAGE's 1/size postscale doesn't truncate to zero
+    # (ref: ScaleBuffer int dispatch, collective_operations.h:89-125).
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return (x.astype(jnp.float32) * factor).astype(x.dtype)
+    return x * jnp.asarray(factor, dtype=x.dtype)
+
+
+def allreduce(
+    tensor,
+    axis_name: str,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """All-reduce over a named mesh axis.
+
+    AVERAGE is implemented as SUM with postscale 1/size, matching the
+    reference (ref: operations.cc:851-858); Adasum uses the scaling-
+    insensitive VHDD combination (see ops/adasum.py).
+    """
+    x = _scale(tensor, prescale_factor)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        out = lax.psum(x, axis_name)
+        if op == ReduceOp.AVERAGE:
+            n = lax.axis_size(axis_name)
+            out = _scale(out, 1.0 / n)
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(x, axis_name)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(x, axis_name)
+    elif op == ReduceOp.PRODUCT:
+        gathered = lax.all_gather(x, axis_name)
+        out = jnp.prod(gathered, axis=0)
+    elif op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce
+
+        out = adasum_allreduce(x, axis_name)
+    else:
+        raise ValueError(f"unsupported reduce op: {op}")
+    return _scale(out, postscale_factor)
+
+
+def grouped_allreduce(
+    tensors: Sequence,
+    axis_name: str,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Fused all-reduce of a list of tensors (ref: grouped allreduce,
+    horovod/torch/mpi_ops.py grouped_allreduce; fusion semantics of
+    controller.cc:686-809).
+
+    Under XLA a single psum over a flat concatenated buffer produces one
+    large ICI all-reduce — the same wire behavior the reference's fusion
+    buffer achieves with explicit memcpys, minus the copies when XLA
+    elides them.
+    """
+    if not tensors:
+        return []
+    shapes = [t.shape for t in tensors]
+    sizes = [int(jnp.size(t)) for t in tensors]
+    dtypes = [t.dtype for t in tensors]
+    widest = jnp.result_type(*dtypes)
+    flat = jnp.concatenate(
+        [jnp.ravel(t).astype(widest) for t in tensors]
+    )
+    red = allreduce(flat, axis_name, op, prescale_factor, postscale_factor)
+    out, off = [], 0
+    for shape, size, dt in zip(shapes, sizes, dtypes):
+        out.append(jnp.reshape(red[off : off + size], shape).astype(dt))
+        off += size
+    return out
+
+
+def allreduce_pytree(
+    tree,
+    axis_name: str,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    fuse: bool = False,
+):
+    """All-reduce every leaf of a pytree (gradient trees)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if fuse:
+        red = grouped_allreduce(leaves, axis_name, op, prescale_factor, postscale_factor)
+    else:
+        red = [allreduce(l, axis_name, op, prescale_factor, postscale_factor) for l in leaves]
+    return jax.tree.unflatten(treedef, red)
+
+
+def allgather(tensor, axis_name: str):
+    """Concatenate each rank's tensor along dim 0 (ref: AllgatherOp,
+    collective_operations.h:148-185; variable first-dim supported in the
+    eager engine; under jit shapes are static so all ranks' first dims are
+    equal by construction)."""
+    return lax.all_gather(tensor, axis_name, tiled=True)
+
+
+def broadcast(tensor, root_rank: int, axis_name: str):
+    """Broadcast root's value to all ranks (ref: BroadcastOp,
+    mpi_operations.cc:357-390). Implemented as a masked psum — a single
+    ICI all-reduce, which XLA lowers efficiently; avoids materializing an
+    all_gather."""
+    idx = lax.axis_index(axis_name)
+    mask = (idx == root_rank).astype(tensor.dtype)
+    return lax.psum(tensor * mask, axis_name).astype(tensor.dtype)
+
+
+def alltoall(tensor, axis_name: str, split_axis: int = 0, concat_axis: int = 0):
+    """Equal-split all-to-all (ref: AlltoallOp, collective_operations.h:
+    206-256). The leading dim must be divisible by the axis size; uneven
+    splits are an eager-engine feature (dynamic shapes don't jit).
+    This is the MoE dispatch / Ulysses sequence-exchange primitive."""
+    n = lax.axis_size(axis_name)
+    if tensor.shape[split_axis] % n != 0:
+        raise ValueError(
+            f"alltoall under jit requires dim {split_axis} divisible by axis size {n}"
+        )
+    return lax.all_to_all(
+        tensor, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def reducescatter(tensor, axis_name: str, op: ReduceOp = ReduceOp.SUM):
+    """Reduce-scatter along dim 0 (tiled). The building block of the
+    hierarchical allreduce (ref: nccl_operations.cc:190-405) and of
+    ZeRO/FSDP-style sharded optimizers."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM/AVERAGE")
+    out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / lax.axis_size(axis_name)
+    return out
+
+
+def barrier(axis_name: str):
+    """(ref: BarrierOp / controller Barrier) — a scalar psum forces a
+    cross-chip sync point in the XLA program."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name)
+
+
+def axis_rank(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def hierarchical_allreduce(
+    tensor,
+    inner_axis: str,
+    outer_axis: str,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Two-level allreduce: reduce-scatter over the fast inner axis (ICI),
+    all-reduce the shard over the slow outer axis (DCN), then all-gather
+    back over ICI — the TPU mapping of NCCLHierarchicalAllreduce
+    (ref: nccl_operations.cc:190-405: intra-node ncclReduceScatter → cross-
+    node MPI_Allreduce → intra-node ncclAllGather)."""
+    x = _scale(tensor, prescale_factor)
+    orig_shape = x.shape
+    flat = jnp.ravel(x)
+    n_inner = lax.axis_size(inner_axis)
+    pad = (-flat.size) % n_inner
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, inner_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, outer_axis)
+    full = lax.all_gather(shard, inner_axis, tiled=True)
+    if pad:
+        full = full[: flat.size - pad]
+    out = jnp.reshape(full, orig_shape)
+    if op == ReduceOp.AVERAGE:
+        total = lax.axis_size(inner_axis) * lax.axis_size(outer_axis)
+        out = _scale(out, 1.0 / total)
+    elif op != ReduceOp.SUM:
+        raise ValueError("hierarchical_allreduce supports SUM/AVERAGE")
+    return _scale(out, postscale_factor)

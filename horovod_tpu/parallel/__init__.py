@@ -1,0 +1,11 @@
+"""Parallelism strategies over TPU device meshes.
+
+The reference implements data parallelism only (SURVEY.md §2.6); this
+layer adds the mesh-axis strategies a TPU framework needs first-class:
+tensor parallel (tp), pipeline parallel (pp), sequence/context parallel
+(sp: ring attention + Ulysses all-to-all), and expert parallel (ep: MoE
+all-to-all dispatch), all composable on one `jax.sharding.Mesh`.
+"""
+from . import mesh
+from .mesh import create_mesh, create_hybrid_mesh, data_parallel_mesh, AXIS_ORDER, HVD_AXIS
+from .step import wrap_step

@@ -1,0 +1,86 @@
+"""wrap_step: run a training-step function SPMD over the mesh.
+
+This is the TPU-native answer to "wrap your optimizer and your script
+scales" (ref: README.rst:80-99): the user writes a single-chip step
+function that calls hvd.allreduce (or uses hvd.DistributedOptimizer);
+`wrap_step` shard_maps it over the data axis so each chip sees its batch
+shard, hvd collectives bind to the mesh axis, and XLA compiles one SPMD
+program with ICI collectives — no background thread, no negotiation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..common import basics
+from ..utils.compat import shard_map
+
+
+def wrap_step(
+    fn: Callable = None,
+    *,
+    mesh=None,
+    axis_name: Optional[str] = None,
+    sharded_argnums: Optional[Sequence[int]] = None,
+    replicated_argnums: Sequence[int] = (0,),
+    out_replicated: bool = True,
+    jit: bool = True,
+    donate_argnums: Tuple[int, ...] = (),
+):
+    """Decorate a step function for SPMD execution.
+
+    By default argument 0 (params / train state) is replicated and every
+    other argument is sharded along its leading (batch) dim; the output
+    is replicated (gradients inside should already be allreduced via
+    hvd.allreduce / DistributedOptimizer — shard_map will verify
+    replication only where cheap).
+
+    Usage:
+        @hvd.wrap_step
+        def train_step(state, batch): ...
+    """
+    if fn is None:
+        return functools.partial(
+            wrap_step,
+            mesh=mesh,
+            axis_name=axis_name,
+            sharded_argnums=sharded_argnums,
+            replicated_argnums=replicated_argnums,
+            out_replicated=out_replicated,
+            jit=jit,
+            donate_argnums=donate_argnums,
+        )
+
+    @functools.wraps(fn)
+    def wrapped(*args):
+        m = mesh if mesh is not None else basics.mesh()
+        an = axis_name if axis_name is not None else basics.axis_name()
+        if m is None:
+            raise RuntimeError("wrap_step requires mesh mode (hvd.init())")
+        repl = set(replicated_argnums)
+        if sharded_argnums is not None:
+            shard = set(sharded_argnums)
+            repl = set(range(len(args))) - shard
+        in_specs = tuple(
+            jax.tree.map(lambda _: P() if i in repl else P(an), args[i])
+            for i in range(len(args))
+        )
+        out_spec = P() if out_replicated else P(an)
+
+        def body(*inner):
+            return fn(*inner)
+
+        sm = shard_map(
+            body, mesh=m,
+            in_specs=in_specs,
+            out_specs=jax.tree.map(lambda _: out_spec,
+                                   jax.eval_shape(fn, *args)),
+        )
+        if jit:
+            sm = jax.jit(sm, donate_argnums=donate_argnums)
+        return sm(*args)
+
+    return wrapped

@@ -1,0 +1,106 @@
+"""Threaded HTTP key-value rendezvous server.
+
+Re-design of the reference's rendezvous KV store
+(ref: horovod/runner/http/http_server.py:35-242): workers PUT/GET small
+values (socket addresses, rank assignments) under scoped keys; the Gloo-
+equivalent TCP backend uses it to build its full mesh, and the elastic
+driver uses it to hand out new rank assignments on membership changes
+(ref: horovod/runner/elastic/rendezvous.py:28-52).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def _key(self) -> str:
+        return self.path.lstrip("/")
+
+    def do_GET(self):
+        server: RendezvousServer = self.server.rendezvous  # type: ignore
+        val = server.handle_get(self._key())
+        if val is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(val)))
+            self.end_headers()
+            self.wfile.write(val)
+
+    def do_PUT(self):
+        server: RendezvousServer = self.server.rendezvous  # type: ignore
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        server.handle_put(self._key(), body)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        # Scope finalization (ref: http_server.py RendezvousHandler DELETE)
+        server: RendezvousServer = self.server.rendezvous  # type: ignore
+        server.handle_delete(self._key())
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer:
+    def __init__(self, verbose: int = 0):
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # Optional hook for elastic rank reassignment
+        # (key -> value or None to fall through to the store).
+        self.get_hook: Optional[Callable[[str], Optional[bytes]]] = None
+        self.put_hook: Optional[Callable[[str, bytes], None]] = None
+
+    def start(self, port: int = 0) -> int:
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._httpd.rendezvous = self  # type: ignore
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rendezvous", daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def handle_get(self, key: str) -> Optional[bytes]:
+        if self.get_hook is not None:
+            v = self.get_hook(key)
+            if v is not None:
+                return v
+        with self._lock:
+            return self._store.get(key)
+
+    def handle_put(self, key: str, value: bytes):
+        if self.put_hook is not None:
+            self.put_hook(key, value)
+        with self._lock:
+            self._store[key] = value
+
+    def handle_delete(self, key: str):
+        with self._lock:
+            prefix = key.rstrip("/") + "/"
+            for k in [k for k in self._store if k == key or k.startswith(prefix)]:
+                del self._store[k]
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

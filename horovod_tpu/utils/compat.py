@@ -1,0 +1,38 @@
+"""Version-compat shims for jax APIs used throughout the framework."""
+from __future__ import annotations
+
+import jax
+
+# shard_map moved from jax.experimental to the jax namespace.
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.4.35ish
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        try:
+            return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_vma=check_rep)
+        except TypeError:
+            return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_rep=check_rep)
+
+
+def tree_map(f, *trees):
+    return jax.tree.map(f, *trees)
+
+
+def tree_leaves(tree):
+    return jax.tree.leaves(tree)
+
+
+def tree_flatten(tree):
+    return jax.tree.flatten(tree)
+
+
+def tree_unflatten(treedef, leaves):
+    return jax.tree.unflatten(treedef, leaves)

@@ -1,0 +1,90 @@
+"""Environment-variable config knobs.
+
+The reference funnels all runtime configuration through HOROVOD_* env vars
+(ref: horovod/common/common.h:64-90, operations.cc:416-513,
+horovod/runner/common/util/config_parser.py). We honor the same names so
+reference users' launch scripts keep working, with HVD_TPU_* accepted as
+an alias prefix.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Knob names (ref: horovod/common/common.h:64-90)
+FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+AUTOTUNE = "HOROVOD_AUTOTUNE"
+AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+TIMELINE = "HOROVOD_TIMELINE"
+TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+STALL_CHECK_TIME = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+STALL_SHUTDOWN_TIME = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+CONTROLLER = "HOROVOD_CONTROLLER"
+CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+ADASUM_NUM_STREAMS = "HOROVOD_NUM_NCCL_STREAMS"
+
+# Rank topology env set by the launcher (ref: gloo_run.py:65-198)
+RANK = "HOROVOD_RANK"
+SIZE = "HOROVOD_SIZE"
+LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+CROSS_RANK = "HOROVOD_CROSS_RANK"
+CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOSTNAME = "HOROVOD_HOSTNAME"
+SECRET_KEY = "HOROVOD_SECRET_KEY"
+ELASTIC = "HOROVOD_ELASTIC"
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # ref: operations.cc:432
+DEFAULT_CYCLE_TIME_MS = 5.0  # ref: operations.cc:442
+DEFAULT_CACHE_CAPACITY = 1024  # ref: global_state.h:88
+DEFAULT_STALL_WARNING_SECONDS = 60.0  # ref: stall_inspector.h
+
+
+def _get(name: str) -> Optional[str]:
+    v = os.environ.get(name)
+    if v is None:
+        v = os.environ.get(name.replace("HOROVOD_", "HVD_TPU_", 1))
+    return v
+
+
+def get_int(name: str, default: int) -> int:
+    v = _get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def get_float(name: str, default: float) -> float:
+    v = _get(name)
+    return float(v) if v not in (None, "") else default
+
+
+def get_str(name: str, default: str = "") -> str:
+    v = _get(name)
+    return v if v is not None else default
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = _get(name)
+    if v in (None, ""):
+        return default
+    return v.lower() not in ("0", "false", "no", "off")
+
+
+def fusion_threshold_bytes() -> int:
+    # HOROVOD_FUSION_THRESHOLD is in bytes (ref: operations.cc:432-440)
+    return get_int(FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
+
+
+def cycle_time_ms() -> float:
+    return get_float(CYCLE_TIME, DEFAULT_CYCLE_TIME_MS)
+
+
+def cache_capacity() -> int:
+    return get_int(CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)

@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU mesh (SURVEY.md §4
+lesson: every distributed test must run without TPU hardware, the way the
+reference's tests run under `horovodrun -np 2` on one CPU machine).
+
+The environment's sitecustomize imports jax and registers a TPU plugin
+before pytest starts, so env-var forcing is too late; instead we switch
+platform via jax config and clear any already-created backends.
+"""
+import os
+
+# For any worker subprocesses spawned by tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import jax.extend.backend as _jeb
+
+_jeb.clear_backends()
+assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu"
+
+import pytest
+
+
+@pytest.fixture
+def hvd_mesh():
+    """Fresh mesh-mode init for a test, torn down after."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

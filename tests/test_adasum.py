@@ -1,0 +1,79 @@
+"""Adasum numerics: traced (ppermute VHDD) vs the NumPy oracle
+(ref test model: test/test_adasum_pytorch.py compares against a NumPy
+reference implementation)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.adasum import adasum_numpy
+from horovod_tpu.utils.compat import shard_map
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.shutdown()
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+N = 8
+
+
+def _traced_adasum(per_rank: np.ndarray):
+    """per_rank: [N, d] — rank r's vector in row r."""
+    x = jnp.asarray(per_rank.reshape(-1))
+
+    def f(v):
+        return hvd.allreduce(v, op=hvd.Adasum)
+
+    out = shard_map(f, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P("hvd"))(x)
+    return np.asarray(out).reshape(per_rank.shape)
+
+
+def test_identical_vectors_fixed_point():
+    v = np.array([1.0, -2.0, 3.0, 4.0], np.float32)
+    per_rank = np.tile(v, (N, 1))
+    out = _traced_adasum(per_rank)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], v, rtol=1e-5)
+
+
+def test_orthogonal_vectors_sum():
+    per_rank = np.eye(N, dtype=np.float32) * 3.0
+    out = _traced_adasum(per_rank)
+    expected = np.full(N, 3.0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5)
+
+
+def test_matches_numpy_oracle_random():
+    rng = np.random.RandomState(42)
+    per_rank = rng.randn(N, 16).astype(np.float32)
+    got = _traced_adasum(per_rank)
+    want = adasum_numpy([per_rank[r] for r in range(N)])
+    for r in range(N):
+        np.testing.assert_allclose(got[r], want[r], rtol=1e-4, atol=1e-5)
+    # All ranks converge to the identical combined vector.
+    for r in range(1, N):
+        np.testing.assert_allclose(got[0], got[r], rtol=1e-5)
+
+
+def test_scaling_insensitivity():
+    # Adasum's defining property: scaling one rank's gradient by a large
+    # factor doesn't blow up the combination the way SUM does
+    # (ref: docs/adasum_user_guide.rst motivation).
+    rng = np.random.RandomState(0)
+    v = rng.randn(8).astype(np.float64)
+    a, b = v.copy(), v.copy() * 1000.0
+    out = adasum_numpy([a, b])[0]
+    # result stays O(||b||): combination ≈ b when b dominates
+    assert np.linalg.norm(out) < np.linalg.norm(a) + np.linalg.norm(b)
+    assert np.linalg.norm(out) > 0.4 * np.linalg.norm(b)
+
+
+def test_numpy_oracle_power_of_two_only():
+    with pytest.raises(AssertionError):
+        adasum_numpy([np.ones(2)] * 3)

@@ -1,0 +1,46 @@
+"""Init/rank/size introspection tests (ref: reference test/test_torch.py
+rank/size fixtures + basics API)."""
+import numpy as np
+import pytest
+
+
+def test_init_mesh_mode(hvd_mesh):
+    hvd = hvd_mesh
+    assert hvd.is_initialized()
+    assert hvd.mode() == "mesh"
+    assert hvd.size() == 8  # virtual CPU devices
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.mesh() is not None
+    assert hvd.axis_name() == "hvd"
+
+
+def test_double_init_is_noop(hvd_mesh):
+    hvd = hvd_mesh
+    m = hvd.mesh()
+    hvd.init()
+    assert hvd.mesh() is m
+
+
+def test_shutdown_and_reinit():
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    assert hvd.is_initialized()
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    with pytest.raises(RuntimeError):
+        hvd.rank()
+    hvd.init()
+    assert hvd.size() == 8
+    hvd.shutdown()
+
+
+def test_builtins_introspection(hvd_mesh):
+    hvd = hvd_mesh
+    assert hvd.xla_built()
+    assert hvd.gloo_built()  # TCP backend is the gloo-equivalent
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert hvd.is_homogeneous()

@@ -1,0 +1,79 @@
+"""Wire-format round-trip tests (ref: horovod/common/message.cc
+serialization via FlatBuffers — ours is the struct-packed codec that the
+C++ engine mirrors)."""
+import numpy as np
+
+from horovod_tpu.common.message import (
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+from horovod_tpu.common.types import DataType, TensorShape, to_wire_dtype
+
+
+def test_request_roundtrip():
+    r = Request(
+        request_rank=3,
+        request_type=RequestType.ALLGATHER,
+        tensor_type=DataType.BFLOAT16,
+        tensor_name="layer1/weights.grad",
+        root_rank=1,
+        device=7,
+        tensor_shape=(4, 1024, 3),
+        prescale_factor=0.25,
+        postscale_factor=2.0,
+    )
+    r2, off = Request.deserialize(r.serialize())
+    assert r2 == r
+    assert off == len(r.serialize())
+
+
+def test_request_list_roundtrip():
+    rl = RequestList(
+        [Request(tensor_name=f"t{i}", tensor_shape=(i,)) for i in range(5)],
+        shutdown=True,
+    )
+    rl2 = RequestList.deserialize(rl.serialize())
+    assert rl2.shutdown
+    assert [r.tensor_name for r in rl2.requests] == [f"t{i}" for i in range(5)]
+
+
+def test_response_roundtrip():
+    resp = Response(
+        response_type=ResponseType.ERROR,
+        tensor_names=["a", "b"],
+        error_message="Mismatched shapes",
+        devices=[0, 1],
+        tensor_sizes=[3, 9],
+        tensor_type=DataType.FLOAT64,
+        prescale_factor=1.5,
+        postscale_factor=0.5,
+        last_joined_rank=2,
+    )
+    r2, _ = Response.deserialize(resp.serialize())
+    assert r2 == resp
+
+
+def test_response_list_roundtrip():
+    rl = ResponseList([Response(tensor_names=["x"]), Response(tensor_names=["y"])])
+    rl2 = ResponseList.deserialize(rl.serialize())
+    assert len(rl2.responses) == 2
+    assert not rl2.shutdown
+
+
+def test_dtype_mapping():
+    for np_dt in [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]:
+        wire = to_wire_dtype(np.dtype(np_dt))
+        assert isinstance(wire, DataType)
+    import jax.numpy as jnp
+
+    assert to_wire_dtype(jnp.bfloat16) == DataType.BFLOAT16
+
+
+def test_tensor_shape():
+    s = TensorShape.of(np.zeros((2, 3, 4)))
+    assert s.num_elements() == 24
+    assert s.to_string() == "[2, 3, 4]"

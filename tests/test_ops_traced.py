@@ -1,0 +1,155 @@
+"""Traced collective tests on the 8-device CPU mesh — the analogue of the
+reference's per-op × dtype × fused/unfused matrix (ref: test/
+test_tensorflow.py:218+ test_horovod_allreduce_* family)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.compat import shard_map
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.shutdown()
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def _run(fn, x, out_spec=P("hvd")):
+    return shard_map(
+        fn, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=out_spec
+    )(x)
+
+
+N = 8  # device count
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+def test_allreduce_sum(dtype):
+    x = jnp.arange(N * 4).astype(dtype)
+    out = _run(lambda v: hvd.allreduce(v, op=hvd.Sum), x)
+    shards = np.asarray(x, dtype=np.float64).reshape(N, 4)
+    expected = np.tile(shards.sum(0), N)
+    np.testing.assert_allclose(np.asarray(out, np.float64), expected, rtol=1e-2)
+
+
+def test_allreduce_average():
+    x = jnp.arange(N * 4, dtype=jnp.float32)
+    out = _run(lambda v: hvd.allreduce(v), x)
+    expected = np.tile(np.asarray(x).reshape(N, 4).mean(0), N)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_allreduce_min_max():
+    x = jnp.arange(N * 4, dtype=jnp.float32)
+    mn = _run(lambda v: hvd.allreduce(v, op=hvd.Min), x)
+    mx = _run(lambda v: hvd.allreduce(v, op=hvd.Max), x)
+    shards = np.asarray(x).reshape(N, 4)
+    np.testing.assert_allclose(np.asarray(mn), np.tile(shards.min(0), N))
+    np.testing.assert_allclose(np.asarray(mx), np.tile(shards.max(0), N))
+
+
+def test_allreduce_prescale_postscale():
+    # (ref: test_tensorflow.py prescale/postscale tests; operations.cc:851-858)
+    x = jnp.ones(N * 4, dtype=jnp.float32)
+    out = _run(
+        lambda v: hvd.allreduce(v, op=hvd.Sum, prescale_factor=2.0,
+                                postscale_factor=0.5),
+        x,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.full(N * 4, N * 1.0))
+
+
+def test_grouped_allreduce_matches_individual():
+    xs = [jnp.arange(N * 2, dtype=jnp.float32),
+          jnp.ones((N, 3), dtype=jnp.float32)]
+
+    def grouped(a, b):
+        r = hvd.grouped_allreduce([a, b], op=hvd.Sum)
+        return tuple(r)
+
+    got = shard_map(grouped, mesh=hvd.mesh(),
+                    in_specs=(P("hvd"), P("hvd")),
+                    out_specs=(P("hvd"), P("hvd")))(*xs)
+    want0 = np.tile(np.asarray(xs[0]).reshape(N, 2).sum(0), N)
+    np.testing.assert_allclose(np.asarray(got[0]), want0)
+    np.testing.assert_allclose(np.asarray(got[1]), np.full((N, 3), float(N)))
+
+
+def test_allgather():
+    x = jnp.arange(N * 2, dtype=jnp.float32)
+    out = _run(lambda v: hvd.allgather(v), x)
+    # Each shard gathers all: result is x tiled per shard.
+    assert out.shape == (N * N * 2,)
+    np.testing.assert_allclose(np.asarray(out)[: N * 2], np.asarray(x))
+
+
+def test_broadcast_root_value():
+    x = jnp.arange(N, dtype=jnp.float32)
+    for root in (0, 3, 7):
+        out = _run(lambda v: hvd.broadcast(v, root), x)
+        np.testing.assert_allclose(np.asarray(out), np.full(N, float(root)))
+
+
+def test_alltoall_transpose():
+    # Classic property: alltoall of [rank]*N yields [0..N-1] on every rank.
+    x = jnp.repeat(jnp.arange(N, dtype=jnp.float32), N)
+
+    def f(v):
+        return hvd.alltoall(v)
+
+    out = _run(f, x)
+    np.testing.assert_allclose(np.asarray(out)[:N], np.arange(N, dtype=np.float32))
+
+
+def test_reducescatter():
+    x = jnp.ones((N * N,), dtype=jnp.float32)
+
+    def f(v):
+        return hvd.reducescatter(v, op=hvd.Sum)
+
+    out = _run(f, x)
+    assert out.shape == (N,)
+    np.testing.assert_allclose(np.asarray(out), np.full(N, float(N)))
+
+
+def test_hierarchical_allreduce_equals_flat():
+    from horovod_tpu.ops.traced import hierarchical_allreduce
+    from horovod_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    x = jnp.arange(8 * 3, dtype=jnp.float32).reshape(8, 3)
+
+    def f(v):
+        return hierarchical_allreduce(v, inner_axis="tp", outer_axis="dp",
+                                      op=hvd.Sum)
+
+    got = shard_map(f, mesh=mesh, in_specs=P(("dp", "tp")),
+                    out_specs=P(("dp", "tp")))(x)
+    want = np.tile(np.asarray(x).reshape(8, 1, 3).sum(0), (8, 1)).reshape(8, 3)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_barrier_compiles():
+    out = _run(lambda v: v + hvd.barrier() if False else v, jnp.ones(N))
+    assert out.shape == (N,)
+
+
+def test_allreduce_of_gradients():
+    # The DistributedOptimizer hot path: per-shard grads, averaged by
+    # allreduce (ref: horovod/tensorflow/__init__.py:242-274).
+    mesh = hvd.mesh()
+
+    def step(w, x):
+        g = jax.grad(lambda w_: jnp.sum(w_ * x))(w)
+        return hvd.allreduce(g)  # AVERAGE over ranks
+
+    g = shard_map(step, mesh=mesh, in_specs=(P(), P("hvd")),
+                  out_specs=P())(jnp.float32(1.0),
+                                 jnp.arange(N, dtype=jnp.float32))
+    # local grad on shard r = x_r; average over ranks = mean(0..7) = 3.5
+    np.testing.assert_allclose(np.asarray(g), 3.5)

@@ -1,0 +1,144 @@
+"""DistributedOptimizer / distributed_value_and_grad tests
+(ref test model: test/test_torch.py optimizer tests — distributed SGD
+equals serial SGD on the combined batch)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.compat import shard_map
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.shutdown()
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+N = 8
+
+
+def _loss(w, x, y):
+    pred = x @ w
+    return jnp.mean((pred - y) ** 2)
+
+
+def test_distributed_sgd_equals_global_sgd():
+    # DP-trained step (grads averaged over shards) must equal single-chip
+    # SGD on the full batch (ref: the core Horovod contract, README.rst:80-99).
+    rng = np.random.RandomState(0)
+    w0 = jnp.asarray(rng.randn(4).astype(np.float32))
+    X = jnp.asarray(rng.randn(N * 2, 4).astype(np.float32))
+    Y = jnp.asarray(rng.randn(N * 2).astype(np.float32))
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    opt_state = tx.init(w0)
+
+    def step(w, state, x, y):
+        grads = jax.grad(_loss)(w, x, y)
+        red = hvd.allreduce(grads)  # average across shards
+        updates, state = optax.sgd(0.1).update(red, state, w)
+        return optax.apply_updates(w, updates), state
+
+    w_dp, _ = shard_map(
+        lambda w, s, x, y: step(w, s, x, y),
+        mesh=hvd.mesh(),
+        in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P()),
+    )(w0, opt_state, X, Y)
+
+    # serial: full-batch grad = mean of shard grads (each shard has equal
+    # element count, and _loss is a mean)
+    shard_grads = [
+        jax.grad(_loss)(w0, X[i * 2 : (i + 1) * 2], Y[i * 2 : (i + 1) * 2])
+        for i in range(N)
+    ]
+    g_serial = jnp.mean(jnp.stack(shard_grads), axis=0)
+    w_serial = w0 - 0.1 * g_serial
+    np.testing.assert_allclose(np.asarray(w_dp), np.asarray(w_serial), rtol=1e-5)
+
+
+def test_distributed_optimizer_transform():
+    # The optax-wrapper form: tx.update allreduces grads internally.
+    w0 = jnp.ones(3)
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0))
+    state = tx.init(w0)
+
+    def upd(g, s, w):
+        updates, s2 = tx.update(g, s, w)
+        return optax.apply_updates(w, updates)
+
+    # per-shard grads = axis index → average = 3.5
+    g = jnp.repeat(jnp.arange(N, dtype=jnp.float32), 3)
+    out = shard_map(
+        lambda g_, s, w: upd(g_.reshape(3), s, w),
+        mesh=hvd.mesh(),
+        in_specs=(P("hvd"), P(), P()),
+        out_specs=P(),
+    )(g, state, w0)
+    np.testing.assert_allclose(np.asarray(out), np.ones(3) - 3.5, rtol=1e-6)
+
+
+def test_distributed_value_and_grad():
+    vg = hvd.distributed_value_and_grad(lambda w, x: jnp.sum(w * x))
+    x = jnp.arange(N, dtype=jnp.float32)
+
+    def f(w, x_):
+        val, g = vg(w, x_)
+        return val[None], g  # per-shard loss, replicated grad
+
+    vals, g = shard_map(
+        f, mesh=hvd.mesh(), in_specs=(P(), P("hvd")), out_specs=(P("hvd"), P()),
+    )(jnp.float32(2.0), x)
+    np.testing.assert_allclose(np.asarray(g), 3.5)  # mean of 0..7
+    np.testing.assert_allclose(np.asarray(vals), 2.0 * np.arange(N))
+
+
+def test_grouped_fused_matches_unfused():
+    params = {"a": jnp.ones((2, 2)), "b": jnp.zeros(5)}
+
+    def loss(p, x):
+        return jnp.sum(p["a"]) * jnp.mean(x) + jnp.sum(p["b"] * 2) * jnp.mean(x)
+
+    vg_f = hvd.distributed_value_and_grad(loss, fuse=True)
+    vg_u = hvd.distributed_value_and_grad(loss, fuse=False)
+    x = jnp.arange(N, dtype=jnp.float32)
+    run = lambda f: shard_map(
+        f, mesh=hvd.mesh(), in_specs=(P(), P("hvd")), out_specs=(P(), P()),
+    )(params, x)
+    (_, gf), (_, gu) = run(vg_f), run(vg_u)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(gu[k]), rtol=1e-6)
+
+
+def test_compression_bf16_roundtrip():
+    from horovod_tpu.ops.compression import Compression
+
+    x = jnp.asarray(np.random.RandomState(1).randn(32).astype(np.float32))
+    c, ctx = Compression.fp16.compress(x)
+    assert c.dtype == jnp.bfloat16
+    out = Compression.fp16.decompress(c, ctx)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-2, atol=1e-2)
+
+
+def test_backward_passes_per_step_accumulates():
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=2)
+    w = jnp.zeros(2)
+    state = tx.init(w)
+
+    def apply(g, s, w):
+        u, s2 = tx.update(g, s, w)
+        return optax.apply_updates(w, u), s2
+
+    g1 = jnp.ones(2)
+    w, state = jax.jit(apply)(g1, state, w)
+    np.testing.assert_allclose(np.asarray(w), 0.0)  # accumulating, no step yet
+    w, state = jax.jit(apply)(g1, state, w)
+    # MultiSteps averages accumulated grads → update = -1.0 * 1.0
+    np.testing.assert_allclose(np.asarray(w), -1.0)
