@@ -147,6 +147,30 @@ class StarCollectivesMixin(Backend):
         fallback; transports override with true point-to-point."""
         raise NotImplementedError
 
+    def allreduce_words(self, words: List[int], op: str) -> List[int]:
+        """Bitwise and/or of 64-bit word vectors across ranks (the cache
+        coordinator's control collective; ref: CrossRankBitwiseAnd/Or,
+        mpi_controller.cc:88-106). Ranks may disagree on vector length
+        for a cycle (cache sizes converge lazily): a missing word is 0,
+        so 'and' zero-fills and 'or' extends to the longest vector."""
+        payload = struct.pack(f"<{len(words)}Q", *words)
+        gathered = self.gather_bytes(payload)
+        if self.rank == 0:
+            acc = list(words)
+            for buf in gathered[1:]:
+                other = struct.unpack(f"<{len(buf) // 8}Q", buf)
+                if op == "or" and len(other) > len(acc):
+                    acc.extend([0] * (len(other) - len(acc)))
+                for i in range(min(len(acc), len(other))):
+                    acc[i] = (acc[i] & other[i]) if op == "and" else (acc[i] | other[i])
+                if op == "and" and len(other) < len(acc):
+                    for i in range(len(other), len(acc)):
+                        acc[i] = 0
+            self.bcast_bytes(struct.pack(f"<{len(acc)}Q", *acc))
+            return acc
+        buf = self.bcast_bytes(None)
+        return list(struct.unpack(f"<{len(buf) // 8}Q", buf))
+
     def barrier(self):
         self.gather_bytes(b"")
         self.bcast_bytes(b"" if self.rank == 0 else None)

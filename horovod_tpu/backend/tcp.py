@@ -138,24 +138,6 @@ class TcpBackend(StarCollectivesMixin):
             return payloads[0]
         return _recv_frame(self.peers[0])
 
-    def allreduce_words(self, words: List[int], op: str) -> List[int]:
-        payload = struct.pack(f"<{len(words)}Q", *words)
-        gathered = self.gather_bytes(payload)
-        if self.rank == 0:
-            acc = list(words)
-            for buf in gathered[1:]:
-                other = struct.unpack(f"<{len(buf) // 8}Q", buf)
-                for i in range(min(len(acc), len(other))):
-                    acc[i] = (acc[i] & other[i]) if op == "and" else (acc[i] | other[i])
-                if op == "and" and len(other) < len(acc):
-                    # Peer has fewer cache bits: treat missing as 0.
-                    for i in range(len(other), len(acc)):
-                        acc[i] = 0
-            self.bcast_bytes(struct.pack(f"<{len(acc)}Q", *acc))
-            return acc
-        buf = self.bcast_bytes(None)
-        return list(struct.unpack(f"<{len(buf) // 8}Q", buf))
-
     # ------------------------------------------------------------------
     def shutdown(self):
         for s in self.peers.values():

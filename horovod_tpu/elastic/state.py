@@ -26,6 +26,7 @@ class State:
     def __init__(self, **kwargs):
         self._reset_callbacks: List[Callable[[], None]] = []
         self._host_messages: List[Any] = []
+        self._last_updated_timestamp = 0
 
     def register_reset_callbacks(self, callbacks):
         self._reset_callbacks.extend(callbacks)
@@ -47,17 +48,27 @@ class State:
 
     def check_host_updates(self):
         """Raise HostsUpdatedInterrupt on all ranks together
-        (ref: common/elastic.py:73-93 — broadcast of the update timestamp
-        keeps ranks in lockstep)."""
+        (ref: common/elastic.py:73-93). The broadcast runs UNCONDITIONALLY
+        every commit — notifications arrive per-worker and asynchronously,
+        so an early-out on ranks without a queued message would leave the
+        notified ranks alone inside the collective, hanging them. Rank 0's
+        view decides; a message rank 0 hasn't seen yet fires on a later
+        commit."""
         from ..common.exceptions import HostsUpdatedInterrupt
 
-        if not self._host_messages:
-            return
-        # Synchronize the decision across ranks.
-        ts, res = self._host_messages[-1]
-        agreed = broadcast_object((ts, res), root_rank=0, name="host_update_ts")
+        prev = last = self._last_updated_timestamp
+        res = 0
+        for ts, update in self._host_messages:
+            if ts > last:
+                last = ts
+                res = update
         self._host_messages.clear()
-        raise HostsUpdatedInterrupt(skip_sync=bool(agreed[1]))
+        prev, last, res = broadcast_object(
+            (prev, last, res), root_rank=0, name="host_update_ts"
+        )
+        self._last_updated_timestamp = last
+        if last > prev:
+            raise HostsUpdatedInterrupt(skip_sync=bool(res))
 
     # subclass interface
     def save(self):

@@ -94,12 +94,11 @@ class Controller:
         # Join state (ref: global_state.h:103-107, controller.cc:220-308)
         self.joined_ranks: Set[int] = set()
         self.joined = False  # this rank called join
+        # This cycle's cache hits, parked by cache bit so non-intersecting
+        # hits can be re-queued into full negotiation.
+        self._pending_cached: Dict[int, Request] = {}
         # Tensor metadata cache for fusion byte accounting
-        self._pending_cached_bits: Set[int] = set()
         self._sizes_by_name: Dict[str, int] = {}
-        # This rank's in-flight requests, kept until their response arrives
-        # so cache entries can be keyed on the full request signature.
-        self._my_pending_requests: Dict[str, Request] = {}
 
     # ------------------------------------------------------------------
     def compute_response_list(
@@ -112,6 +111,7 @@ class Controller:
         """
         # --- split messages into cache hits and misses -----------------
         uncached: List[Request] = []
+        local_invalid_bits: Set[int] = set()
         for req in messages:
             if req.request_type == RequestType.JOIN:
                 self.joined = True
@@ -121,52 +121,84 @@ class Controller:
                 self.response_cache.cached(req) if self.cache_enabled else CacheState.MISS
             )
             if state == CacheState.HIT:
-                self._pending_cached_bits.add(self.response_cache.peek_bit(req.tensor_name))
+                self._pending_cached[
+                    self.response_cache.peek_bit(req.tensor_name)
+                ] = req
             else:
                 if state == CacheState.INVALID:
+                    # Signature changed (e.g. new shape). Announce the old
+                    # bit in the OR pass so every rank drops its entry in
+                    # the same cycle (ref: CacheCoordinator invalid-bit
+                    # second pass, response_cache.cc) — otherwise peers
+                    # that HIT on the stale entry would park the request
+                    # forever while this rank re-negotiates it.
+                    local_invalid_bits.add(
+                        self.response_cache.peek_bit(req.tensor_name)
+                    )
                     self.response_cache.erase(req.tensor_name)
                 uncached.append(req)
-                self._my_pending_requests[req.tensor_name] = req
 
         responses: List[Response] = []
 
-        # --- cache coordination (bitvector AND across ranks) -----------
+        # --- cache coordination: two bitvector passes ------------------
         if self.cache_enabled:
-            nwords = 1 + (max(self.response_cache.num_bits(), 1) + 63) // 64
+            nwords = (max(self.response_cache.num_bits(), 1) + 63) // 64
+            if self.joined:
+                # A joined rank participates in every cached collective
+                # with zeros, so it must not veto the AND — mark all bits
+                # (ref: CacheCoordinator joined handling, response_cache.cc).
+                hit_words = [~0 & 0xFFFFFFFFFFFFFFFF] * nwords
+            else:
+                hit_words = self.response_cache.bits_to_vector(
+                    set(self._pending_cached), nwords
+                )
+            # Pass 1: AND of (cached ∧ pending) bits. A bit survives only
+            # when every rank has that tensor queued and cached this cycle.
+            and_words = self.transport.allreduce_words(hit_words, "and")
+            common_bits = ResponseCache.vector_to_bits(and_words)
+
+            # Hits that did not intersect go back to full negotiation
+            # (the cache entry stays; peers simply weren't ready).
+            for bit in sorted(set(self._pending_cached) - common_bits):
+                uncached.append(self._pending_cached.pop(bit))
+
+            # Pass 2: OR of status flags + invalid bits, computed *after*
+            # the requeue so HAS_UNCACHED reflects it.
             flags = 0
             if uncached:
                 flags |= _FLAG_HAS_UNCACHED
             if shutdown:
                 flags |= _FLAG_SHUTDOWN
-            if self.joined:
-                # A joined rank participates in every cached collective
-                # with zeros, so it must not veto the AND — mark all bits
-                # (ref: CacheCoordinator joined handling, response_cache.cc).
-                hit_words = [~0 & 0xFFFFFFFFFFFFFFFF] * (nwords - 1)
-            else:
-                hit_words = self.response_cache.bits_to_vector(
-                    self._pending_cached_bits, nwords - 1
-                )
-            # AND of hit bits; OR of flags: send flags complemented through
-            # the AND then recover with a second OR pass, exactly the
-            # two-pass scheme of CacheCoordinator::sync
-            # (ref: response_cache.cc bitvector sync).
-            and_words = self.transport.allreduce_words(hit_words, "and")
-            or_words = self.transport.allreduce_words([flags], "or")
+            or_words = self.transport.allreduce_words(
+                [flags] + self.response_cache.bits_to_vector(
+                    local_invalid_bits, nwords
+                ),
+                "or",
+            )
             flags = or_words[0]
-            common_bits = ResponseCache.vector_to_bits(and_words)
+            global_invalid = ResponseCache.vector_to_bits(or_words[1:])
             shutdown = bool(flags & _FLAG_SHUTDOWN)
             any_uncached = bool(flags & _FLAG_HAS_UNCACHED)
+
+            # Drop globally-invalidated entries everywhere; a parked hit
+            # on an invalidated bit re-negotiates instead.
+            for bit in global_invalid:
+                common_bits.discard(bit)
+                if bit in self._pending_cached:
+                    uncached.append(self._pending_cached.pop(bit))
+                    any_uncached = True
+                if self.response_cache.has_bit(bit):
+                    self.response_cache.erase_bit(bit)
 
             # Emit cached responses common to all ranks, in stable bit
             # order. A joined rank emits them too — it must take part in
             # the data plane (with zero contributions) or peers block.
             for bit in sorted(common_bits):
-                if bit in self._pending_cached_bits or (
+                if bit in self._pending_cached or (
                     self.joined and self.response_cache.has_bit(bit)
                 ):
                     responses.append(self.response_cache.get_response_by_bit(bit))
-                    self._pending_cached_bits.discard(bit)
+                    self._pending_cached.pop(bit, None)
         else:
             any_uncached = True
 
@@ -397,8 +429,6 @@ class Controller:
         tensor responses only: the reference caches pre-fusion responses
         and re-fuses cached hits (ref: controller.cc:174-203); fused
         groups here re-negotiate."""
-        for name in resp.tensor_names:
-            self._my_pending_requests.pop(name, None)
         if resp.response_type in (
             ResponseType.ALLREDUCE,
             ResponseType.ADASUM,

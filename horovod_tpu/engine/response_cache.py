@@ -92,6 +92,11 @@ class ResponseCache:
             self._free_bits.append(ent[0])
             self._lru.pop(name, None)
 
+    def erase_bit(self, bit: int):
+        name = self._by_bit.get(bit)
+        if name is not None:
+            self.erase(name)
+
     def bits_to_vector(self, bits: Set[int], nwords: int) -> List[int]:
         """Pack bit set into 64-bit words (ref: response_cache.h bitvector
         layout — 2 words per 64 entries)."""

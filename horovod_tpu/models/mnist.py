@@ -1,0 +1,45 @@
+"""MNIST models — parity target: the reference's first-run examples
+(`examples/tensorflow2_mnist.py`, `examples/pytorch_mnist.py`; PR1 config
+in BASELINE.json is the 2-process CPU MNIST equivalent)."""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistMLP(nn.Module):
+    features: Sequence[int] = (128, 64)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class MnistCNN(nn.Module):
+    """The LeNet-ish conv net the reference's torch MNIST example uses
+    (examples/pytorch_mnist.py Net: conv 10 → conv 20 → fc 50 → fc 10)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        if not deterministic:
+            x = nn.Dropout(0.5)(x, deterministic=False)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

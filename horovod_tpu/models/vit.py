@@ -1,0 +1,103 @@
+"""Vision Transformer — parity target: reference north-star "ViT-L/16
+ImageNet DP" (BASELINE.json). Reuses the transformer encoder stack; the
+patch embedding is a strided conv (one big MXU matmul per image)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import (
+    TransformerConfig,
+    TransformerStack,
+    functools_partial_ln,
+    default_kernel_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    scan_layers: bool = False
+
+    def transformer(self) -> TransformerConfig:
+        n_patches = (self.image_size // self.patch_size) ** 2
+        return TransformerConfig(
+            vocab_size=self.num_classes,
+            d_model=self.d_model,
+            n_heads=self.n_heads,
+            n_layers=self.n_layers,
+            d_ff=self.d_ff,
+            max_len=n_patches + 1,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            causal=False,
+            remat=self.remat,
+            scan_layers=self.scan_layers,
+        )
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        cfg = self.cfg
+        tcfg = cfg.transformer()
+        p = cfg.patch_size
+        x = nn.Conv(
+            cfg.d_model, (p, p), strides=(p, p), padding="VALID",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                default_kernel_init, (None, None, None, "embed")
+            ),
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        B, H, W, D = x.shape
+        x = x.reshape(B, H * W, D)
+        cls = self.param(
+            "cls",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(),
+                                         (None, None, "embed")),
+            (1, 1, D), cfg.param_dtype,
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(cfg.dtype), (B, 1, 1)), x],
+                            axis=1)
+        pos = self.param(
+            "pos_embedding",
+            nn.with_logical_partitioning(default_kernel_init, (None, "embed")),
+            (H * W + 1, D), cfg.param_dtype,
+        )
+        x = x + pos[None].astype(cfg.dtype)
+        x = TransformerStack(tcfg, name="stack")(x, None, deterministic)
+        x = functools_partial_ln(tcfg)(name="ln_f")(x)
+        x = x[:, 0]  # CLS token
+        logits = nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(default_kernel_init,
+                                                     ("embed", "vocab")),
+            name="head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+VIT_CONFIGS = {
+    "vit-tiny": ViTConfig(image_size=32, patch_size=4, num_classes=10,
+                          d_model=64, n_heads=4, n_layers=2, d_ff=256),
+    "vit-s16": ViTConfig(d_model=384, n_heads=6, n_layers=12, d_ff=1536),
+    "vit-b16": ViTConfig(d_model=768, n_heads=12, n_layers=12, d_ff=3072),
+    "vit-l16": ViTConfig(d_model=1024, n_heads=16, n_layers=24, d_ff=4096),
+}

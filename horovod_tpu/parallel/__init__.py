@@ -8,4 +8,6 @@ all-to-all dispatch), all composable on one `jax.sharding.Mesh`.
 """
 from . import mesh
 from .mesh import create_mesh, create_hybrid_mesh, data_parallel_mesh, AXIS_ORDER, HVD_AXIS
+from .sharding import DEFAULT_RULES, FSDP_RULES, batch_spec, init_sharded, logical_sharding
 from .step import wrap_step
+from .train import TrainState, lm_loss, make_train_step, softmax_xent

@@ -54,33 +54,44 @@ def wrap_step(
             donate_argnums=donate_argnums,
         )
 
+    # Compiled-step cache: jax.jit caches on function identity, so the
+    # shard_map/jit construction must happen once per (mesh, arg
+    # structure/shape/dtype) signature, not per call — otherwise every
+    # training step would re-trace.
+    cache = {}
+
     @functools.wraps(fn)
     def wrapped(*args):
         m = mesh if mesh is not None else basics.mesh()
         an = axis_name if axis_name is not None else basics.axis_name()
         if m is None:
             raise RuntimeError("wrap_step requires mesh mode (hvd.init())")
-        repl = set(replicated_argnums)
-        if sharded_argnums is not None:
-            shard = set(sharded_argnums)
-            repl = set(range(len(args))) - shard
-        in_specs = tuple(
-            jax.tree.map(lambda _: P() if i in repl else P(an), args[i])
-            for i in range(len(args))
+        leaves, treedef = jax.tree.flatten(args)
+        key = (
+            id(m), treedef,
+            tuple((getattr(l, "shape", ()), str(getattr(l, "dtype", type(l))))
+                  for l in leaves),
         )
-        out_spec = P() if out_replicated else P(an)
-
-        def body(*inner):
-            return fn(*inner)
-
-        sm = shard_map(
-            body, mesh=m,
-            in_specs=in_specs,
-            out_specs=jax.tree.map(lambda _: out_spec,
-                                   jax.eval_shape(fn, *args)),
-        )
-        if jit:
-            sm = jax.jit(sm, donate_argnums=donate_argnums)
+        sm = cache.get(key)
+        if sm is None:
+            repl = set(replicated_argnums)
+            if sharded_argnums is not None:
+                shard = set(sharded_argnums)
+                repl = set(range(len(args))) - shard
+            in_specs = tuple(
+                jax.tree.map(lambda _: P() if i in repl else P(an), args[i])
+                for i in range(len(args))
+            )
+            out_spec = P() if out_replicated else P(an)
+            sm = shard_map(
+                fn, mesh=m,
+                in_specs=in_specs,
+                out_specs=jax.tree.map(lambda _: out_spec,
+                                       jax.eval_shape(fn, *args)),
+            )
+            if jit:
+                sm = jax.jit(sm, donate_argnums=donate_argnums)
+            cache[key] = sm
         return sm(*args)
 
     return wrapped

@@ -318,3 +318,55 @@ def test_allgather_rejected_after_join():
         return True
 
     assert all(run_ranks(2, fn))
+
+
+def test_cache_invalidation_shape_change_no_deadlock():
+    """Regression: after a tensor is cached (steady state), one rank
+    re-submits it with a NEW shape (INVALID) while peers still see a HIT.
+    The invalid bit must propagate through the OR pass so every rank
+    drops the stale entry and renegotiates — previously the HIT ranks
+    parked the request forever (deadlock)."""
+
+    def fn(eng, rank):
+        # Warm the cache: two identical-signature cycles.
+        for _ in range(2):
+            out = eng.synchronize(
+                eng.enqueue_allreduce(
+                    np.full(4, 1.0, np.float32), name="t"), timeout=30)
+        # Same name, new shape on ALL ranks (a legal re-shape, e.g. last
+        # batch of an epoch). Every rank flips HIT->INVALID here; the
+        # cross-rank case is exercised below.
+        out = eng.synchronize(
+            eng.enqueue_allreduce(np.full(8, 2.0, np.float32), name="t"),
+            timeout=30,
+        )
+        return out
+
+    out = run_ranks(2, fn)
+    for o in out:
+        np.testing.assert_allclose(o, np.full(8, 4.0))
+
+
+def test_cache_hit_invalid_divergence_renegotiates():
+    """The cross-rank divergence: rank 0 re-enqueues the cached name with
+    the OLD shape (HIT), rank 1 with a NEW shape (INVALID). The negotiated
+    result must surface the shape-mismatch error on both ranks rather
+    than hanging."""
+
+    def fn(eng, rank):
+        for _ in range(2):
+            eng.synchronize(
+                eng.enqueue_allreduce(
+                    np.full(4, 1.0, np.float32), name="t"), timeout=30)
+        shape = 4 if rank == 0 else 8
+        try:
+            eng.synchronize(
+                eng.enqueue_allreduce(
+                    np.full(shape, 2.0, np.float32), name="t"), timeout=30)
+            return None
+        except HorovodInternalError as e:
+            return str(e)
+
+    out = run_ranks(2, fn)
+    for o in out:
+        assert o is not None and "Mismatched allreduce tensor shapes" in o
