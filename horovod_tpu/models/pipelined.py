@@ -1,0 +1,85 @@
+"""PipelinedLM: the flagship causal LM with its layer stack run as a
+GPipe pipeline over the pp mesh axis.
+
+Pipeline parallelism is absent from the reference (SURVEY.md §2.6); this
+is the TPU-native construction: the scan-stacked layer parameters
+("layers" leading dim) are regrouped into pp stages, sharded over the pp
+axis, and driven by `parallel.pipeline.gpipe` (shard_map manual on pp
+only — dp/sp/tp inside each stage remain GSPMD). Duck-types the flax
+`init/apply` pair so `make_train_step` drives it unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.pipeline import gpipe, stack_stage_params
+from .transformer import (
+    Embedder,
+    TransformerBlock,
+    TransformerConfig,
+    TransformerLM,
+    functools_partial_ln,
+)
+
+
+class PipelinedLM:
+    """Wraps TransformerLM (scan_layers=True, dense FFN) with a pipelined
+    apply. Parameters are bit-identical to the unpipelined model, so
+    checkpoints interchange."""
+
+    def __init__(self, cfg: TransformerConfig, mesh, axis: str = "pp",
+                 num_microbatches: Optional[int] = None):
+        if not cfg.scan_layers or cfg.n_experts:
+            raise ValueError(
+                "PipelinedLM needs scan_layers=True and a dense FFN "
+                "(stage params must stack homogeneously)"
+            )
+        if cfg.n_layers % mesh.shape[axis] != 0:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by pp="
+                f"{mesh.shape[axis]}"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.num_microbatches = num_microbatches
+        self.inner = TransformerLM(cfg)
+
+    def init(self, rng, ids, **kwargs):
+        return self.inner.init(rng, ids, **kwargs)
+
+    def apply(self, variables, ids, **kwargs):
+        cfg = self.cfg
+        params = variables["params"]
+        S = self.mesh.shape[self.axis]
+
+        x = Embedder(cfg, name=None).apply({"params": params["embed"]}, ids)
+
+        stage_params = stack_stage_params(params["stack"]["layers"], S)
+        block = TransformerBlock(cfg, use_moe=False, scanned=True)
+
+        def stage_fn(p, act):
+            # p: per-stage layer stack (L/S leading dim); scan the local
+            # layers sequentially.
+            def body(carry, pl):
+                y, _ = block.apply({"params": pl}, carry, None, True)
+                return y, None
+
+            act, _ = jax.lax.scan(body, act, p)
+            return act
+
+        x = gpipe(stage_fn, stage_params, x, mesh=self.mesh, axis=self.axis,
+                  num_microbatches=self.num_microbatches)
+
+        ln = functools_partial_ln(cfg)()
+        x = ln.apply({"params": params["ln_f"]}, x)
+        if cfg.logits_via_embedding:
+            emb = params["embed"]["embedding"]
+            logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(x.dtype))
+        else:
+            kernel = params["lm_head"]["kernel"]
+            logits = jnp.einsum("bsd,dv->bsv", x, kernel.astype(x.dtype))
+        return logits.astype(jnp.float32)

@@ -63,6 +63,13 @@ class TransformerConfig:
     logits_via_embedding: bool = False
     # Learned (gpt2/bert/vit) vs fixed sinusoidal positions.
     learned_pos: bool = True
+    # Attention implementation: "dense", or the sequence-parallel kernels
+    # "ring" (blockwise ppermute) / "ulysses" (all-to-all head exchange).
+    # The sp kernels require an ambient mesh (jax.sharding.set_mesh /
+    # make_train_step) containing `sp_axis`; they fall back to dense when
+    # the axis is absent or trivial.
+    attn_impl: str = "dense"
+    sp_axis: str = "sp"
 
     @property
     def head_dim(self) -> int:
@@ -82,6 +89,54 @@ def _dense(features, cfg: TransformerConfig, name: str, logical_axes,
         ),
         name=name,
     )
+
+
+def _dense_attention_masked(cfg: TransformerConfig, q, k, v, mask):
+    Hd = q.shape[-1]
+    S = q.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Hd)
+    scores = scores.astype(jnp.float32)
+    if cfg.causal:
+        causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(causal[None, None], scores, -1e30)
+    if mask is not None:
+        # mask: (B, S) 1 = attend, 0 = pad.
+        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
+    """Choose dense vs sequence-parallel attention. The sp kernels run in
+    a nested shard_map that manualizes only `cfg.sp_axis`; batch/head
+    sharding stays under GSPMD."""
+    if cfg.attn_impl not in ("ring", "ulysses"):
+        return _dense_attention_masked(cfg, q, k, v, mask)
+    am = jax.sharding.get_abstract_mesh()
+    if am is None or cfg.sp_axis not in am.axis_names \
+            or am.shape[cfg.sp_axis] == 1:
+        return _dense_attention_masked(cfg, q, k, v, mask)
+    if mask is not None:
+        raise NotImplementedError(
+            "padding masks are not supported by the sp attention kernels"
+        )
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.ring import ring_attention
+    from ..parallel.ulysses import ulysses_attention
+    from ..utils.compat import shard_map
+
+    impl = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+    spec = P(None, cfg.sp_axis)
+
+    fn = shard_map(
+        lambda q, k, v: impl(q, k, v, cfg.sp_axis, causal=cfg.causal),
+        mesh=am,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={cfg.sp_axis},
+    )
+    return fn(q, k, v)
 
 
 class MultiHeadAttention(nn.Module):
@@ -121,16 +176,7 @@ class MultiHeadAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Hd)
-        scores = scores.astype(jnp.float32)
-        if cfg.causal:
-            causal = jnp.tril(jnp.ones((S, S), dtype=bool))
-            scores = jnp.where(causal[None, None], scores, -1e30)
-        if mask is not None:
-            # mask: (B, S) 1 = attend, 0 = pad.
-            scores = jnp.where(mask[:, None, None, :].astype(bool), scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        ctx = _attention_dispatch(cfg, q, k, v, mask)
         ctx = nn.with_logical_constraint(ctx, ("batch", "seq", "heads", "kv"))
 
         out = nn.DenseGeneral(

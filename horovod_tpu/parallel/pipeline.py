@@ -1,0 +1,128 @@
+"""Pipeline parallelism: GPipe schedule over the pp mesh axis.
+
+A capability the reference lacks (SURVEY.md §2.6: PP absent). TPU-native
+construction: `shard_map` manualizes ONLY the pp axis (dp/tp/sp/ep stay
+under GSPMD inside each stage), layer-stacked parameters are sharded
+over pp on their stage dim, and activations flow stage-to-stage with
+`lax.ppermute` — neighbor ICI hops on the torus. The schedule is the
+classic GPipe fill/drain: T = M + S - 1 ticks for M microbatches over S
+stages, bubble fraction (S-1)/(M+S-1). Fully differentiable, so one
+jitted train step backprops through the whole pipeline.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.compat import shard_map
+
+
+def _pvary(x, axis):
+    """Mark x as varying over `axis` for shard_map's VMA tracking."""
+    try:
+        return jax.lax.pcast(x, to="varying", axes=axis)
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axis)
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    num_microbatches: Optional[int] = None,
+):
+    """Run `x` through S pipeline stages.
+
+    stage_fn(params_slice, act) -> act: applies one stage's layers; must
+      preserve the activation shape.
+    stage_params: pytree whose leaves have a leading stage dim of size S
+      (= mesh.shape[axis]), sharded over `axis`.
+    x: full batch (B, ...); B must divide into `num_microbatches`
+      (default S) microbatches.
+
+    Returns the full-batch output with x's shape.
+    """
+    S = mesh.shape[axis]
+    if S == 1:
+        return stage_fn(jax.tree.map(lambda a: a[0], stage_params), x)
+    M = num_microbatches or S
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    act_dtype = x.dtype
+    # The shard_map boundary stays f32 in both directions: the cross-pp
+    # all-reduces it implies (output psum; backward, the transpose of the
+    # input pvary) must not be low-precision — bf16 all-reduce inside a
+    # partial-manual region crashes XLA CPU's AllReducePromotion pass
+    # (observed jax 0.9), and f32 summation is numerically safer anyway.
+    xs = x.reshape(M, mb, *x.shape[1:]).astype(jnp.float32)
+
+    def worker(params_local, xs):
+        # params_local leading stage dim is 1 locally.
+        p = jax.tree.map(lambda a: jnp.squeeze(a, 0), params_local)
+        stage = jax.lax.axis_index(axis)
+        # Mark pp-varying up front: carries become varying inside the
+        # loop (ppermute / per-stage masks) and the explicit pvary pins
+        # the backward psum of xs at f32.
+        xs = _pvary(xs, axis)
+        state = _pvary(jnp.zeros(xs.shape[1:], act_dtype), axis)
+        outs = _pvary(jnp.zeros(xs.shape, jnp.float32), axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outs = carry
+            # Stage 0 injects microbatch t (clamped during drain).
+            inject = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            ).astype(act_dtype)
+            cur = jnp.where(stage == 0, inject, state)
+            y = stage_fn(p, cur)
+            # Last stage banks microbatch t-(S-1) (clamped during fill;
+            # the mask kills out-of-range writes).
+            oi = t - (S - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outs, y.astype(jnp.float32), jnp.clip(oi, 0, M - 1), 0
+            )
+            keep = (stage == S - 1) & (oi >= 0)
+            outs = jnp.where(keep, banked, outs)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, outs), None
+
+        (state, outs), _ = jax.lax.scan(
+            tick, (state, outs), jnp.arange(M + S - 1)
+        )
+        # Only the last stage holds real outputs; psum over the masked
+        # buffers replicates them to every stage (outs elsewhere are 0).
+        masked = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(masked, axis)
+
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    out = shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_rep=True,
+    )(stage_params, xs)
+    return out.reshape(B, *x.shape[1:]).astype(act_dtype)
+
+
+def stack_stage_params(layer_params: Any, n_stages: int) -> Any:
+    """Reshape layer-stacked params (leading dim L) into stage-stacked
+    params (leading dims (S, L/S) collapsed to S with L/S layers inside):
+    returns a tree with leading dims (S, L/S, ...)."""
+    def reshape(a):
+        L = a.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return a.reshape(n_stages, L // n_stages, *a.shape[1:])
+
+    return jax.tree.map(reshape, layer_params)

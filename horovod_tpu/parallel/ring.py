@@ -1,0 +1,94 @@
+"""Ring attention: blockwise context parallelism over the sp mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5.7 —
+its `alltoall` is the closest primitive). Sequence is sharded across sp
+ranks; K/V blocks rotate around the ring via `lax.ppermute` while each
+rank folds them into a streaming-softmax accumulator (flash-attention
+style m/l/o state), so attention memory is O(S/n) per chip and the
+K/V transfer rides ICI neighbor links — the layout ppermute maps to
+natively on a TPU torus.
+
+Use inside shard_map with the sp axis manual, e.g. via
+`horovod_tpu.parallel.step.wrap_step` or a custom shard_map; q/k/v enter
+as local sequence blocks (B, S/n, H, D).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale, causal):
+    """Fold one K/V block into the streaming-softmax state.
+
+    o: (B, Sq, H, D) f32 accumulated (unnormalized) output
+    m, l: (B, H, Sq) f32 running max / normalizer
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]  # (Sq, Sk)
+        s = jnp.where(mask[None, None], s, -1e30)
+    m_blk = jnp.max(s, axis=-1)                      # (B,H,Sq)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])                # (B,H,Sq,Sk)
+    corr = jnp.exp(m - m_new)                        # (B,H,Sq)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Attention over the global sequence with q/k/v sharded on dim 1
+    across `axis_name`. Returns the local output block (B, S/n, H, D) in
+    q.dtype. Differentiable (used in training steps)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    qpos = idx * Sq + jnp.arange(Sq)
+
+    o = jnp.zeros((B, Sq, H, D), jnp.float32)
+    m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sq), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, t):
+        o, m, l, k, v = carry
+        # After t rotations this rank holds the block that started at
+        # rank (idx - t) mod n.
+        src = (idx - t) % n
+        kpos = src * Sk + jnp.arange(Sk)
+        o, m, l = _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale,
+                                      causal)
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return (o, m, l, k, v), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
+                                      jnp.arange(n))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def dense_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Single-device reference attention (same layout, no sharding)."""
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Sk)[None, :] <= jnp.arange(Sq)[:, None]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)

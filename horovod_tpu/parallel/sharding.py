@@ -35,6 +35,12 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("stage", ("pp",)),
 )
 
+# Pipeline variant: scan-stacked layer params shard over pp on their
+# leading "layers" axis (PipelinedLM regroups them into stages).
+PIPELINE_RULES: Tuple[Tuple[str, Any], ...] = tuple(
+    ("layers", ("pp",)) if k == "layers" else (k, v) for k, v in DEFAULT_RULES
+)
+
 # FSDP-style variant: shard the big replicated dims over dp as well
 # (ZeRO-3 analogue — the reference has no equivalent; TPU-native bonus).
 FSDP_RULES: Tuple[Tuple[str, Any], ...] = (

@@ -217,18 +217,30 @@ def make_train_step(
 
     def build(rng, *example_batch):
         model_inputs = example_batch[:1]
-        ssh = state_shardings(rng, *model_inputs)
-        bsh = tuple(_batch_sharding(a) for a in example_batch)
+        with jax.sharding.set_mesh(mesh):
+            ssh = state_shardings(rng, *model_inputs)
         init_jit = jax.jit(
             lambda r: init_state(r, *model_inputs), out_shardings=ssh
         )
+        bsh = tuple(_batch_sharding(a) for a in example_batch)
         step_jit = jax.jit(
             train_step,
             in_shardings=(ssh,) + bsh,
             out_shardings=(ssh, repl),
             donate_argnums=(0,) if donate else (),
         )
-        return init_jit, step_jit, ssh
+
+        # The ambient mesh makes sp/pp kernels (nested shard_maps inside
+        # the model) resolve their axes at trace time.
+        def with_mesh(fn):
+            @functools.wraps(fn)
+            def run(*a, **kw):
+                with jax.sharding.set_mesh(mesh):
+                    return fn(*a, **kw)
+
+            return run
+
+        return with_mesh(init_jit), with_mesh(step_jit), ssh
 
     return build
 
@@ -236,7 +248,10 @@ def make_train_step(
 def _accepts_deterministic(model: nn.Module) -> bool:
     import inspect
 
+    call = getattr(model, "__call__", None)
+    if call is None:
+        return False
     try:
-        return "deterministic" in inspect.signature(model.__call__).parameters
+        return "deterministic" in inspect.signature(call).parameters
     except (TypeError, ValueError):  # pragma: no cover
         return False

@@ -1,0 +1,46 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+DeepSpeed-Ulysses pattern built on the collective the reference exposes
+as `hvd.alltoall` (SURVEY.md §2.6 names it the enabling primitive for
+SP): q/k/v arrive sharded on the sequence dim; one all-to-all re-shards
+them on the head dim with the full sequence local, dense attention runs
+per head group, and a second all-to-all restores sequence sharding.
+Cheaper than ring attention when heads ≥ sp and the sequence fits HBM;
+ring attention wins at extreme context lengths.
+
+Use inside shard_map with the sp axis manual.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ring import dense_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """q/k/v: local blocks (B, S/n, H, D); H must divide by the axis
+    size. Returns (B, S/n, H, D)."""
+    n = jax.lax.axis_size(axis_name)
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(f"n_heads={H} must be divisible by sp={n}")
+
+    def seq_to_heads(x):
+        # (B, S/n, H, D) → (B, S, H/n, D): split heads, gather sequence.
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = dense_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)

@@ -7,19 +7,29 @@ import jax
 try:
     from jax import shard_map as _shard_map  # jax >= 0.4.35ish
 
-    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False,
+                  axis_names=None):
+        kw = {}
+        if axis_names is not None:
+            # Partial manualization: only these axes become manual;
+            # the rest stay under GSPMD inside the body.
+            kw["axis_names"] = frozenset(axis_names)
         try:
             return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                              check_vma=check_rep)
+                              check_vma=check_rep, **kw)
         except TypeError:
-            return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              **kw)
 
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_old
 
-    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False,
+                  axis_names=None):
+        kw = {"auto": frozenset(set(mesh.axis_names) - set(axis_names))} \
+            if axis_names is not None else {}
         return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                              check_rep=check_rep)
+                              check_rep=check_rep, **kw)
 
 
 def tree_map(f, *trees):
