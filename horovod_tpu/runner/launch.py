@@ -1,0 +1,308 @@
+"""`hvdrun` — the horovodrun-equivalent launcher.
+
+(ref: horovod/runner/launch.py:715 CLI, gloo_run.py:65-258 worker
+spawn/env contract.) Static launch path:
+
+    hvdrun -np 2 python train.py
+    hvdrun -np 4 -H h1:2,h2:2 python train.py
+
+Per slot, the launcher exports the HOROVOD_RANK/SIZE/LOCAL_*/CROSS_* env
+(exactly the reference's gloo env contract so `hvd.init()` picks process
+mode), plus the rendezvous address of the driver's HTTP KV server the
+TCP backend full-meshes through. Remote hosts launch over ssh; TPU-VM
+slices are discovered from jax process topology instead of NIC probing
+(SURVEY.md §5.8). Elastic mode (`--min-np/--max-np/--host-discovery-
+script`) is driven by runner.elastic.driver.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..utils import env as env_cfg
+from . import config_parser
+from .hosts import HostInfo, SlotInfo, get_host_assignments, parse_hostfile, parse_hosts
+from .rendezvous_server import RendezvousServer
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local_host(hostname: str) -> bool:
+    if hostname in _LOCAL_NAMES or hostname.startswith("process-"):
+        return True
+    try:
+        return hostname in (socket.gethostname(), socket.getfqdn())
+    except OSError:  # pragma: no cover
+        return False
+
+
+def slot_env(
+    slot: SlotInfo,
+    rendezvous_addr: str,
+    rendezvous_port: int,
+    extra_env: Optional[Dict[str, str]] = None,
+    elastic: bool = False,
+) -> Dict[str, str]:
+    """The worker env contract (ref: gloo_run.py:65-198 _slot_info_to_command)."""
+    env = {
+        env_cfg.RANK: str(slot.rank),
+        env_cfg.SIZE: str(slot.size),
+        env_cfg.LOCAL_RANK: str(slot.local_rank),
+        env_cfg.LOCAL_SIZE: str(slot.local_size),
+        env_cfg.CROSS_RANK: str(slot.cross_rank),
+        env_cfg.CROSS_SIZE: str(slot.cross_size),
+        env_cfg.RENDEZVOUS_ADDR: rendezvous_addr,
+        env_cfg.RENDEZVOUS_PORT: str(rendezvous_port),
+        env_cfg.HOSTNAME: slot.hostname,
+        env_cfg.CONTROLLER: "tcp",
+        env_cfg.CPU_OPERATIONS: "tcp",
+    }
+    if elastic:
+        env[env_cfg.ELASTIC] = "1"
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def build_ssh_command(
+    hostname: str, command: Sequence[str], env: Dict[str, str],
+    ssh_port: Optional[int] = None, ssh_identity_file: Optional[str] = None,
+) -> List[str]:
+    """ssh invocation for a remote slot (ref: runner/util/remote.py)."""
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+    )
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
+    remote_cmd = f"cd {shlex.quote(os.getcwd())} && env {exports} " + " ".join(
+        shlex.quote(c) for c in command
+    )
+    return ssh + [hostname, remote_cmd]
+
+
+class WorkerHandle:
+    def __init__(self, slot: SlotInfo, proc: subprocess.Popen):
+        self.slot = slot
+        self.proc = proc
+        self.threads: List[threading.Thread] = []
+
+
+def _forward_stream(stream, sink, prefix: str):
+    for line in iter(stream.readline, b""):
+        try:
+            sink.write(f"{prefix}{line.decode(errors='replace')}")
+            sink.flush()
+        except ValueError:  # sink closed
+            break
+    stream.close()
+
+
+def spawn_worker(
+    slot: SlotInfo,
+    command: Sequence[str],
+    env: Dict[str, str],
+    verbose: bool = False,
+    prefix_output: bool = True,
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+) -> WorkerHandle:
+    full_env = dict(os.environ)
+    full_env.update(env)
+    if is_local_host(slot.hostname):
+        argv = list(command)
+    else:
+        argv = build_ssh_command(slot.hostname, command, env, ssh_port,
+                                 ssh_identity_file)
+    proc = subprocess.Popen(
+        argv,
+        env=full_env,
+        stdout=subprocess.PIPE if prefix_output else None,
+        stderr=subprocess.PIPE if prefix_output else None,
+        start_new_session=True,  # own process group for clean teardown
+    )
+    handle = WorkerHandle(slot, proc)
+    if prefix_output:
+        # Rank-prefixed output forwarding, reference format "[1]<stdout>:"
+        # (ref: gloo_run.py:149-162, safe_shell_exec.py:81-120).
+        for stream, sink, tag in (
+            (proc.stdout, sys.stdout, "stdout"),
+            (proc.stderr, sys.stderr, "stderr"),
+        ):
+            t = threading.Thread(
+                target=_forward_stream,
+                args=(stream, sink, f"[{slot.rank}]<{tag}>:"),
+                daemon=True,
+            )
+            t.start()
+            handle.threads.append(t)
+    return handle
+
+
+def terminate_workers(handles: List[WorkerHandle]):
+    for h in handles:
+        if h.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(h.proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+    for h in handles:
+        try:
+            h.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(h.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def launch_static(
+    slots: List[SlotInfo],
+    command: Sequence[str],
+    extra_env: Optional[Dict[str, str]] = None,
+    verbose: bool = False,
+    rendezvous: Optional[RendezvousServer] = None,
+    prefix_output: bool = True,
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+) -> int:
+    """Run one process per slot; first failure tears everything down
+    (ref: gloo_run.py:243-258). Returns the first nonzero exit code or 0."""
+    own_server = rendezvous is None
+    server = rendezvous or RendezvousServer()
+    port = server.start() if own_server else server.port
+    addr = (
+        "127.0.0.1"
+        if all(is_local_host(s.hostname) for s in slots)
+        else _driver_addr()
+    )
+    handles = [
+        spawn_worker(
+            slot, command,
+            slot_env(slot, addr, port, extra_env),
+            verbose, prefix_output, ssh_port, ssh_identity_file,
+        )
+        for slot in slots
+    ]
+    exit_code = 0
+    try:
+        pending = set(range(len(handles)))
+        while pending:
+            for i in sorted(pending):
+                rc = handles[i].proc.poll()
+                if rc is None:
+                    continue
+                pending.discard(i)
+                if rc != 0:
+                    exit_code = exit_code or rc
+                    if verbose:
+                        print(
+                            f"hvdrun: rank {handles[i].slot.rank} exited "
+                            f"with {rc}; terminating remaining workers",
+                            file=sys.stderr,
+                        )
+                    terminate_workers([handles[j] for j in pending])
+                    for j in list(pending):
+                        pending.discard(j)
+                    break
+            else:
+                import time
+
+                time.sleep(0.05)
+    finally:
+        for h in handles:
+            for t in h.threads:
+                t.join(timeout=5)
+        if own_server:
+            server.stop()
+    return exit_code
+
+
+def _driver_addr() -> str:
+    # Workers must reach the driver's rendezvous server. For local-only
+    # launches 127.0.0.1 works; for remote hosts use the routable name.
+    return os.environ.get("HVDRUN_DRIVER_ADDR") or socket.gethostname()
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job "
+        "(horovodrun equivalent)",
+    )
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="total number of processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='comma list "host1:slots,host2:slots"')
+    p.add_argument("--hostfile", default=None,
+                   help="mpirun-style hostfile")
+    p.add_argument("--network-interface", default=None,
+                   help="NIC to bind (informational; TCP mesh binds all)")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--ssh-identity-file", default=None)
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--disable-output-prefix", action="store_true",
+                   help="don't prefix worker output with [rank]<>")
+    # Elastic (ref: launch.py elastic flags)
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--slots-per-host", type=int, default=None)
+    p.add_argument("--reset-limit", type=int, default=None)
+    config_parser.add_engine_args(p)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command, e.g. python train.py")
+    return p
+
+
+def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+
+    extra_env = config_parser.args_to_env(args)
+
+    if args.host_discovery_script or (args.min_np is not None):
+        from .elastic.launcher import launch_elastic
+
+        return launch_elastic(args, command, extra_env)
+
+    if args.hostfile:
+        hosts = parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = parse_hosts(args.hosts)
+    else:
+        np_ = args.num_proc or 1
+        hosts = [HostInfo("localhost", np_)]
+    np_ = args.num_proc or sum(h.slots for h in hosts)
+    slots = get_host_assignments(hosts, np_, np_)
+    if args.verbose:
+        for s in slots:
+            print(f"hvdrun: rank {s.rank} -> {s.hostname} "
+                  f"(local {s.local_rank}/{s.local_size})")
+    return launch_static(
+        slots, command, extra_env, args.verbose,
+        prefix_output=not args.disable_output_prefix,
+        ssh_port=args.ssh_port, ssh_identity_file=args.ssh_identity_file,
+    )
+
+
+def main():  # console entry point
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Worker-side entry for `run(func)` mode: load the pickled function,
+execute it under hvd, PUT the pickled result to the rendezvous KV
+(ref: horovod/runner/launch.py:552-574 --run-func result collection)."""
+from __future__ import annotations
+
+import pickle
+import sys
+
+
+def main(func_path: str):
+    import os
+
+    # CPU-only workers unless the user's function sets up devices itself.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with open(func_path, "rb") as f:
+        fn = pickle.load(f)
+
+    import horovod_tpu as hvd
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+    from horovod_tpu.utils import env as env_cfg
+
+    result = fn()
+
+    client = RendezvousClient(
+        env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR, "127.0.0.1"),
+        env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0),
+    )
+    rank = env_cfg.get_int(env_cfg.RANK, 0)
+    client.put("results", str(rank), pickle.dumps(result))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
