@@ -21,7 +21,6 @@ from .rendezvous import RendezvousClient
 
 logger = get_logger()
 
-RANK_AND_SIZE_SCOPE = "rank_and_size"
 NOTIFY_SCOPE = "workers_notify"
 
 
@@ -33,16 +32,53 @@ def _rendezvous() -> Optional[RendezvousClient]:
     return RendezvousClient(addr, port)
 
 
-def refresh_topology_from_rendezvous():
-    """Update HOROVOD_RANK/SIZE/... env from the driver's latest slot
-    assignment (ref: gloo_context.cc:157-200)."""
+def spawn_identity() -> str:
+    """Stable worker identity across resets: hostname + the local slot
+    it was SPAWNED into (HOROVOD_LOCAL_RANK changes with reassignment;
+    the spawn slot does not)."""
+    hostname = env_cfg.get_str(env_cfg.HOSTNAME, "localhost")
+    spawn_lr = env_cfg.get_str("HOROVOD_SPAWN_LOCAL_RANK") or str(
+        env_cfg.get_int(env_cfg.LOCAL_RANK, 0)
+    )
+    return f"{hostname}:{spawn_lr}"
+
+
+def _current_epoch() -> Optional[int]:
+    scope = env_cfg.get_str(env_cfg.MESH_SCOPE)
+    if scope.startswith("hvd_mesh_e"):
+        try:
+            return int(scope[len("hvd_mesh_e"):])
+        except ValueError:
+            return None
+    return None
+
+
+def refresh_topology_from_rendezvous(timeout: float = 600.0):
+    """Update HOROVOD_RANK/SIZE/... env from the driver's next epoch
+    assignment (ref: gloo_context.cc:157-200; epoch protocol documented
+    in runner/elastic/driver.py). Announces readiness, waits for an epoch
+    newer than the one this worker was last in, then reads its row; an
+    INVALID row (rank -1) means this worker lost its slot and exits."""
     rdv = _rendezvous()
     if rdv is None:
         return
-    hostname = env_cfg.get_str(env_cfg.HOSTNAME, "localhost")
-    local_rank = env_cfg.get_int(env_cfg.LOCAL_RANK, 0)
-    key = f"{hostname}:{local_rank}"
-    data = rdv.wait_get(RANK_AND_SIZE_SCOPE, key).decode()
+    key = spawn_identity()
+    my_epoch = _current_epoch()
+    # Tell the driver this worker is parked at the reset barrier.
+    rdv.put(f"ready_e{my_epoch if my_epoch is not None else 0}", key, b"1")
+
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = rdv.get("meta", "epoch")
+        if raw is not None:
+            epoch = int(raw.decode())
+            if my_epoch is None or epoch > my_epoch:
+                break
+        if time.monotonic() > deadline:
+            raise TimeoutError("no new topology epoch from elastic driver")
+        time.sleep(0.1)
+
+    data = rdv.wait_get(f"rank_and_size_e{epoch}", key).decode()
     vals = [int(v) for v in data.split(",")]
     rank, size, lrank, lsize, crank, csize = vals
     if rank == -1:
@@ -54,6 +90,9 @@ def refresh_topology_from_rendezvous():
     os.environ[env_cfg.LOCAL_SIZE] = str(lsize)
     os.environ[env_cfg.CROSS_RANK] = str(crank)
     os.environ[env_cfg.CROSS_SIZE] = str(csize)
+    # Epoch-scoped mesh rendezvous so the new full mesh never reuses
+    # stale peer addresses from before the reset.
+    os.environ[env_cfg.MESH_SCOPE] = f"hvd_mesh_e{epoch}"
 
 
 class _NotifyHandler(BaseHTTPRequestHandler):
@@ -97,9 +136,16 @@ class WorkerNotificationManager:
                                  name="hvd-notify", daemon=True)
             t.start()
             port = self._httpd.server_address[1]
-            host = env_cfg.get_str(env_cfg.HOSTNAME, "127.0.0.1") or "127.0.0.1"
-            rank = env_cfg.get_int(env_cfg.RANK, 0)
-            rdv.put(NOTIFY_SCOPE, str(rank), f"{host}:{port}".encode())
+            # Register by stable spawn identity (ranks change per epoch).
+            hostname = env_cfg.get_str(env_cfg.HOSTNAME, "localhost")
+            reach = (
+                "127.0.0.1"
+                if hostname in ("localhost", "127.0.0.1", "")
+                or hostname.startswith("process-")
+                or os.environ.get("HVDRUN_FORCE_LOCAL")
+                else hostname
+            )
+            rdv.put(NOTIFY_SCOPE, spawn_identity(), f"{reach}:{port}".encode())
             self._initialized = True
 
     def register_listener(self, state):

@@ -58,10 +58,14 @@ class TcpBackend(StarCollectivesMixin):
         rank: int,
         size: int,
         rendezvous: Optional[RendezvousClient] = None,
-        scope: str = "hvd_mesh",
+        scope: Optional[str] = None,
     ):
         self.rank = rank
         self.size = size
+        if scope is None:
+            # Elastic re-init: the driver bumps HOROVOD_MESH_SCOPE per
+            # topology epoch (stale peer addresses must not be reused).
+            scope = env_cfg.get_str(env_cfg.MESH_SCOPE, "hvd_mesh")
         self.peers: Dict[int, socket.socket] = {}
         if size == 1:
             return
@@ -85,6 +89,9 @@ class TcpBackend(StarCollectivesMixin):
         listener.listen(self.size)
         my_port = listener.getsockname()[1]
         my_host = os.environ.get(env_cfg.HOSTNAME) or "127.0.0.1"
+        if os.environ.get("HVDRUN_FORCE_LOCAL") or my_host in (
+            "localhost", "") or my_host.startswith("process-"):
+            my_host = "127.0.0.1"
         self._rendezvous.put(scope, str(self.rank), f"{my_host}:{my_port}".encode())
 
         # Connect to all lower ranks; accept from all higher ranks.

@@ -68,7 +68,15 @@ class State:
         )
         self._last_updated_timestamp = last
         if last > prev:
-            raise HostsUpdatedInterrupt(skip_sync=bool(res))
+            # Sync is skippable only for removal-only updates: nobody new
+            # needs the state (ref: common/elastic.py HostUpdateResult —
+            # `all_update == HostUpdateResult.removed`). An ADDED update
+            # must sync or the joining worker hangs in state.sync().
+            from ..runner.elastic.discovery import HostUpdateResult
+
+            raise HostsUpdatedInterrupt(
+                skip_sync=(res == HostUpdateResult.REMOVED)
+            )
 
     # subclass interface
     def save(self):

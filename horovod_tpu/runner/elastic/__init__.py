@@ -1,0 +1,10 @@
+"""Elastic launcher subsystem (ref: horovod/runner/elastic/)."""
+from .discovery import (
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+    HostUpdateResult,
+)
+from .driver import ElasticDriver
+from .registration import WorkerStateRegistry

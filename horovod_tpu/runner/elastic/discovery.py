@@ -1,0 +1,131 @@
+"""Host discovery for elastic training.
+
+(ref: horovod/runner/elastic/discovery.py — HostDiscoveryScript runs a
+user script that prints `hostname[:slots]` lines; HostManager keeps a
+stable host ordering (oldest first) and a blacklist.)
+"""
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ...utils.logging import get_logger
+
+logger = get_logger()
+
+
+class HostUpdateResult:
+    NO_UPDATE = 0
+    REMOVED = 1
+    ADDED = 2
+    MIXED = REMOVED | ADDED
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """hostname → slots."""
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """(ref: discovery.py:130-152)"""
+
+    def __init__(self, discovery_script: str, slots: Optional[int] = None):
+        self.script = discovery_script
+        self.default_slots = slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(
+            self.script, shell=True, timeout=60
+        ).decode()
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                name, slots = line.rsplit(":", 1)
+                hosts[name] = int(slots)
+            else:
+                if self.default_slots is None:
+                    raise ValueError(
+                        f"discovery line {line!r} has no slot count and no "
+                        "--slots-per-host default was given"
+                    )
+                hosts[line] = self.default_slots
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """(ref: discovery.py FixedHosts)"""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def set(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Stable-ordered view of available hosts with blacklisting
+    (ref: discovery.py:79-121 — order preserves host age so rank 0 stays
+    on the oldest surviving host, which carries state through resets)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._order: List[str] = []          # first-seen order
+        self._current: Dict[str, int] = {}
+        self._blacklist: set = set()
+        self._lock = threading.Lock()
+
+    def update_available_hosts(self) -> int:
+        new = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            res = HostUpdateResult.NO_UPDATE
+            prev_active = {
+                h: s for h, s in self._current.items()
+                if h not in self._blacklist
+            }
+            for h in new:
+                if h not in self._order:
+                    self._order.append(h)
+            active = {h: s for h, s in new.items() if h not in self._blacklist}
+            if set(active) - set(prev_active) or any(
+                active.get(h, 0) > prev_active.get(h, 0) for h in active
+            ):
+                res |= HostUpdateResult.ADDED
+            if set(prev_active) - set(active) or any(
+                active.get(h, 0) < prev_active.get(h, 0)
+                for h in prev_active if h in active
+            ):
+                res |= HostUpdateResult.REMOVED
+            self._current = new
+            return res
+
+    @property
+    def current_hosts(self) -> List[Tuple[str, int]]:
+        """Active (hostname, slots), oldest first."""
+        with self._lock:
+            return [
+                (h, self._current[h])
+                for h in self._order
+                if h in self._current and h not in self._blacklist
+                and self._current[h] > 0
+            ]
+
+    def blacklist(self, host: str):
+        with self._lock:
+            if host not in self._blacklist:
+                logger.warning("blacklisting host %s", host)
+                self._blacklist.add(host)
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    def available_slots(self) -> int:
+        return sum(s for _, s in self.current_hosts)

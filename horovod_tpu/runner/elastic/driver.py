@@ -1,0 +1,282 @@
+"""Elastic driver: discovery polling, stable rank reassignment, worker
+lifecycle (ref: horovod/runner/elastic/driver.py:30-308).
+
+Topology changes are versioned by an **epoch**. Each activation the
+driver publishes, into the rendezvous KV:
+
+    rank_and_size_e<E>/<host>:<spawn_local_rank> -> "rank,size,..." rows
+        (INVALID row = the worker lost its slot and should exit;
+         ref: gloo_context.cc:157-200 rank==-1 contract)
+    meta/epoch -> E        (written last: epoch visible ⇒ rows complete)
+
+Workers re-initializing (elastic_env.refresh_topology_from_rendezvous)
+announce `ready_e<E>/<key>`, wait for a newer epoch, then read their row.
+The epoch also scopes the TCP full-mesh bootstrap (HOROVOD_MESH_SCOPE)
+so a re-formed mesh never sees stale peer addresses.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...utils import env as env_cfg
+from ...utils.logging import get_logger
+from ..hosts import HostInfo, SlotInfo, get_host_assignments
+from ..rendezvous_server import RendezvousServer
+from .discovery import HostManager, HostUpdateResult
+from .registration import WorkerStateRegistry
+
+logger = get_logger()
+
+INVALID_ROW = "-1,-1,-1,-1,-1,-1"
+READY_PREFIX = "ready_e"
+
+
+class _WorkerRecord:
+    def __init__(self, key: Tuple[str, int], proc):
+        self.key = key
+        self.proc = proc
+        self.thread: Optional[threading.Thread] = None
+
+
+class ElasticDriver:
+    def __init__(
+        self,
+        rendezvous: RendezvousServer,
+        discovery,
+        min_np: int,
+        max_np: Optional[int] = None,
+        reset_limit: Optional[int] = None,
+        poll_interval: Optional[float] = None,
+    ):
+        if poll_interval is None:
+            # 1s default (ref: driver.py:30); tests shrink it via env.
+            poll_interval = env_cfg.get_float(
+                "HOROVOD_ELASTIC_DISCOVERY_INTERVAL", 1.0
+            )
+        self.rendezvous = rendezvous
+        self.host_manager = HostManager(discovery)
+        self.registry = WorkerStateRegistry(self, self.host_manager,
+                                            reset_limit)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.poll_interval = poll_interval
+        self.epoch = -1
+        self._create_worker: Optional[Callable] = None
+        self._workers: Dict[Tuple[str, int], _WorkerRecord] = {}
+        self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        self._lock = threading.RLock()
+        self._finished = threading.Event()
+        self.exit_code: Optional[int] = None
+        self._discovery_thread: Optional[threading.Thread] = None
+        rendezvous.put_hook = self._observe_put
+
+    # ------------------------------------------------------------------
+    @property
+    def finished(self) -> bool:
+        return self._finished.is_set()
+
+    def finish(self, code: int):
+        with self._lock:
+            if not self._finished.is_set():
+                self.exit_code = code
+                self._finished.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        self._finished.wait(timeout)
+        return self.exit_code
+
+    # ------------------------------------------------------------------
+    def start(self, create_worker: Callable):
+        """create_worker(slot: SlotInfo, extra_env: dict) -> Popen."""
+        self._create_worker = create_worker
+        self.wait_for_available_slots(self.min_np)
+        self._activate()
+        self._discovery_thread = threading.Thread(
+            target=self._discover_loop, name="elastic-discovery", daemon=True
+        )
+        self._discovery_thread.start()
+
+    def wait_for_available_slots(self, min_np: int, timeout: float = 600.0):
+        """(ref: driver.py:145 wait_for_available_slots)"""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.host_manager.update_available_hosts()
+            if self.host_manager.available_slots() >= min_np:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {min_np} slots; available: "
+                    f"{self.host_manager.current_hosts}"
+                )
+            time.sleep(self.poll_interval)
+
+    # ------------------------------------------------------------------
+    def _discover_loop(self):
+        """(ref: driver.py:176-195 — poll every second)"""
+        while not self._finished.is_set():
+            time.sleep(self.poll_interval)
+            try:
+                res = self.host_manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup
+                logger.warning("host discovery failed: %s", e)
+                continue
+            if res != HostUpdateResult.NO_UPDATE and not self._finished.is_set():
+                if self.host_manager.available_slots() < self.min_np:
+                    logger.warning(
+                        "hosts dropped below min_np=%d; waiting", self.min_np
+                    )
+                    continue
+                logger.info("host changes detected (%d); re-assigning", res)
+                self._activate(notify_update=res)
+
+    # ------------------------------------------------------------------
+    def resume(self):
+        """Post-failure reactivation (ref: registration.py barrier action
+        → driver.resume)."""
+        if self.host_manager.available_slots() >= self.min_np:
+            self._activate()
+        else:
+            # Stay parked; discovery loop reactivates once enough hosts
+            # return.
+            logger.warning("resume deferred: not enough slots")
+
+    def _activate(self, notify_update: int = 0):
+        with self._lock:
+            if self._finished.is_set():
+                return
+            hosts = [
+                HostInfo(h, s) for h, s in self.host_manager.current_hosts
+            ]
+            slots = get_host_assignments(
+                hosts, self.min_np, self.max_np
+            )
+            self.epoch += 1
+            new_assignments: Dict[Tuple[str, int], SlotInfo] = {
+                (s.hostname, s.local_rank): s for s in slots
+            }
+
+            # Publish rows: assigned slots + INVALID rows for live workers
+            # that lost their slot; epoch key LAST.
+            scope = f"rank_and_size_e{self.epoch}"
+            for (host, idx), slot in new_assignments.items():
+                self.rendezvous.handle_put(
+                    f"{scope}/{host}:{idx}", slot.to_response_string().encode()
+                )
+            for key in self._workers:
+                if key not in new_assignments:
+                    self.rendezvous.handle_put(
+                        f"{scope}/{key[0]}:{key[1]}", INVALID_ROW.encode()
+                    )
+            self.rendezvous.handle_put("meta/epoch", str(self.epoch).encode())
+            self._assignments = new_assignments
+
+            # Spawn processes for slots with no live worker.
+            self._prune_dead_workers()
+            for key, slot in new_assignments.items():
+                if key not in self._workers:
+                    self._spawn(key, slot)
+
+            self.registry.reset(len(new_assignments))
+        if notify_update:
+            self._notify_workers(notify_update)
+
+    def _prune_dead_workers(self):
+        for key in [k for k, w in self._workers.items()
+                    if w.proc.poll() is not None]:
+            del self._workers[key]
+
+    def _spawn(self, key: Tuple[str, int], slot: SlotInfo):
+        extra_env = {
+            env_cfg.ELASTIC: "1",
+            env_cfg.MESH_SCOPE: f"hvd_mesh_e{self.epoch}",
+            "HOROVOD_SPAWN_LOCAL_RANK": str(slot.local_rank),
+        }
+        proc = self._create_worker(slot, extra_env)
+        rec = _WorkerRecord(key, proc)
+        rec.thread = threading.Thread(
+            target=self._monitor, args=(rec,), daemon=True,
+            name=f"worker-{key[0]}:{key[1]}",
+        )
+        self._workers[key] = rec
+        rec.thread.start()
+
+    def _monitor(self, rec: _WorkerRecord):
+        """Wait for process exit; record the verdict
+        (ref: driver.py worker exit handling + registration)."""
+        rc = rec.proc.wait()
+        if self._finished.is_set():
+            return
+        host, idx = rec.key
+        with self._lock:
+            cur = self._workers.get(rec.key)
+            if cur is rec:
+                del self._workers[rec.key]
+        if rc == 0:
+            if rec.key in self._assignments:
+                self.registry.record_success(host, idx)
+            # else: worker exited after an INVALID row — expected.
+        else:
+            logger.warning("worker %s:%d exited with %d", host, idx, rc)
+            self.registry.record_failure(host, idx)
+
+    # ------------------------------------------------------------------
+    def _observe_put(self, key: str, value: bytes):
+        """Rendezvous put hook: READY announcements from resetting
+        workers feed the registry barrier."""
+        if key.startswith(READY_PREFIX):
+            epoch_part, _, ident = key[len(READY_PREFIX):].partition("/")
+            try:
+                epoch = int(epoch_part)
+            except ValueError:
+                return
+            if epoch == self.epoch and ident:
+                host, _, idx = ident.rpartition(":")
+                try:
+                    self.registry.record_ready(host, int(idx))
+                except ValueError:
+                    pass
+
+    def _notify_workers(self, update_res: int):
+        """Ping every live worker's notification endpoint
+        (ref: runner/elastic/worker.py HostsUpdatedRequest)."""
+        import http.client
+
+        ts = time.time()
+        with self._lock:
+            keys = list(self._workers)
+        for host, idx in keys:
+            addr = self.rendezvous.handle_get(f"workers_notify/{host}:{idx}")
+            if addr is None:
+                continue
+            h, _, p = addr.decode().rpartition(":")
+            try:
+                c = http.client.HTTPConnection(h or "127.0.0.1", int(p),
+                                               timeout=5)
+                c.request("PUT", "/hosts_updated", body=f"{ts},{update_res}")
+                c.getresponse().read()
+                c.close()
+            except OSError as e:
+                logger.debug("notify %s:%s failed: %s", host, idx, e)
+
+    # ------------------------------------------------------------------
+    def stop(self):
+        self.finish(self.exit_code if self.exit_code is not None else 1)
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for w in workers:
+            if w.proc.poll() is None:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+        for w in workers:
+            try:
+                w.proc.wait(timeout=10)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass

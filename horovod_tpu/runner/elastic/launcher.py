@@ -1,0 +1,70 @@
+"""Elastic launch path for hvdrun (--min-np/--max-np/
+--host-discovery-script), wiring ElasticDriver + RendezvousServer +
+worker subprocesses (ref: horovod/runner/gloo_run.py:274-309
+launch_gloo_elastic).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ...utils import env as env_cfg
+from ..launch import is_local_host, slot_env, spawn_worker
+from ..rendezvous_server import RendezvousServer
+from .discovery import FixedHosts, HostDiscoveryScript
+from .driver import ElasticDriver
+
+
+def launch_elastic(args, command: Sequence[str],
+                   extra_env: Dict[str, str]) -> int:
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        args.slots_per_host)
+    elif args.hosts:
+        from ..hosts import parse_hosts
+
+        discovery = FixedHosts({
+            h.hostname: h.slots for h in parse_hosts(args.hosts)
+        })
+    else:
+        print("hvdrun: elastic mode needs --host-discovery-script or -H",
+              file=sys.stderr)
+        return 2
+
+    np_ = args.num_proc or args.min_np or 1
+    min_np = args.min_np or np_
+    max_np = args.max_np or args.num_proc
+
+    server = RendezvousServer()
+    port = server.start()
+    driver = ElasticDriver(
+        server, discovery, min_np=min_np, max_np=max_np,
+        reset_limit=args.reset_limit,
+    )
+
+    def create_worker(slot, worker_extra_env):
+        env = slot_env(slot, "127.0.0.1" if is_local_host(slot.hostname)
+                       else _driver_addr(), port, extra_env, elastic=True)
+        env.update(worker_extra_env)
+        handle = spawn_worker(
+            slot, list(command), env,
+            verbose=args.verbose,
+            prefix_output=not getattr(args, "disable_output_prefix", False),
+            ssh_port=args.ssh_port, ssh_identity_file=args.ssh_identity_file,
+        )
+        return handle.proc
+
+    try:
+        driver.start(create_worker)
+        code = driver.wait()
+        return code if code is not None else 1
+    finally:
+        driver.stop()
+        server.stop()
+
+
+def _driver_addr() -> str:
+    import socket
+
+    return os.environ.get("HVDRUN_DRIVER_ADDR") or socket.gethostname()

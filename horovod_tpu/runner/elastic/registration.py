@@ -1,0 +1,91 @@
+"""Worker state registry: tracks per-slot READY/SUCCESS/FAILURE and
+drives the reset decision.
+
+(ref: horovod/runner/elastic/registration.py — barrier over world size;
+on completion: stop on success or all-failure, blacklist failed hosts,
+enforce reset_limit, else driver.resume().)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ...utils.logging import get_logger
+
+logger = get_logger()
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+
+class WorkerStateRegistry:
+    def __init__(self, driver, host_manager, reset_limit: Optional[int] = None):
+        self._driver = driver
+        self._hosts = host_manager
+        self._lock = threading.Condition()
+        self._states: Dict[str, str] = {}      # "host:local_rank" -> state
+        self._reset_count = 0
+        self._reset_limit = reset_limit
+        self._world: int = 0
+        self._epoch = 0
+
+    def reset(self, world_size: int):
+        """New epoch: expect `world_size` verdicts before acting
+        (ref: registration.py:56 barrier resize)."""
+        with self._lock:
+            self._states = {}
+            self._world = world_size
+            self._epoch += 1
+
+    @property
+    def reset_count(self) -> int:
+        return self._reset_count
+
+    def record(self, key: str, state: str):
+        """Record a slot's verdict; the last verdict triggers the barrier
+        action (ref: registration.py:113-172)."""
+        with self._lock:
+            if self._driver.finished:
+                return
+            self._states[key] = state
+            logger.debug("worker %s -> %s (%d/%d)", key, state,
+                         len(self._states), self._world)
+            if len(self._states) >= self._world:
+                self._barrier_action()
+
+    def record_ready(self, host: str, local_rank: int):
+        self.record(f"{host}:{local_rank}", READY)
+
+    def record_success(self, host: str, local_rank: int):
+        self.record(f"{host}:{local_rank}", SUCCESS)
+
+    def record_failure(self, host: str, local_rank: int):
+        self.record(f"{host}:{local_rank}", FAILURE)
+
+    # ------------------------------------------------------------------
+    def _barrier_action(self):
+        states = dict(self._states)
+        succeeded = [k for k, v in states.items() if v == SUCCESS]
+        failed = [k for k, v in states.items() if v == FAILURE]
+
+        if succeeded and len(succeeded) == len(states):
+            self._driver.finish(0)
+            return
+        if failed and len(failed) == len(states):
+            logger.error("all workers failed; stopping job")
+            self._driver.finish(1)
+            return
+        # Partial failure → blacklist failing hosts and resume with the
+        # survivors (ref: registration.py:132-172).
+        for key in failed:
+            host = key.rsplit(":", 1)[0]
+            self._hosts.blacklist(host)
+        self._reset_count += 1
+        if self._reset_limit is not None and self._reset_count > self._reset_limit:
+            logger.error(
+                "reset limit %d exceeded; stopping job", self._reset_limit
+            )
+            self._driver.finish(1)
+            return
+        self._driver.resume()

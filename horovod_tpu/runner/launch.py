@@ -35,6 +35,12 @@ _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
 
 def is_local_host(hostname: str) -> bool:
+    # HVDRUN_FORCE_LOCAL: treat every host as local — lets elastic tests
+    # use distinct fake hostnames on one machine without ssh (the
+    # reference's elastic integration tests do the same through ssh to
+    # localhost aliases, test/integration/elastic_common.py).
+    if os.environ.get("HVDRUN_FORCE_LOCAL"):
+        return True
     if hostname in _LOCAL_NAMES or hostname.startswith("process-"):
         return True
     try:

@@ -41,6 +41,10 @@ RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 HOSTNAME = "HOROVOD_HOSTNAME"
 SECRET_KEY = "HOROVOD_SECRET_KEY"
 ELASTIC = "HOROVOD_ELASTIC"
+# Rendezvous scope for the TCP full-mesh bootstrap; the elastic driver
+# bumps it per topology epoch so re-initializing workers never collide
+# with stale peer addresses.
+MESH_SCOPE = "HOROVOD_MESH_SCOPE"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # ref: operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0  # ref: operations.cc:442

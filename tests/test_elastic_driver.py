@@ -1,0 +1,197 @@
+"""Elastic driver unit tests (ref: test/test_elastic_driver.py — simulated
+discovery, registry transitions, assignment stability, blacklisting; no
+real worker processes)."""
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (
+    FixedHosts,
+    HostManager,
+    HostUpdateResult,
+)
+from horovod_tpu.runner.elastic.driver import ElasticDriver, INVALID_ROW
+from horovod_tpu.runner.elastic.registration import WorkerStateRegistry
+from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+
+class FakeProc:
+    def __init__(self):
+        self._rc = None
+        self._done = threading.Event()
+
+    def poll(self):
+        return self._rc
+
+    def wait(self, timeout=None):
+        self._done.wait(timeout)
+        return self._rc
+
+    def exit(self, rc):
+        self._rc = rc
+        self._done.set()
+
+    def terminate(self):
+        self.exit(-15)
+
+    def kill(self):
+        self.exit(-9)
+
+
+def make_driver(hosts, min_np, max_np=None, reset_limit=None):
+    server = RendezvousServer()  # not started: driver uses handle_* directly
+    discovery = FixedHosts(hosts)
+    driver = ElasticDriver(server, discovery, min_np, max_np,
+                           reset_limit=reset_limit, poll_interval=0.1)
+    procs = {}
+
+    def create_worker(slot, extra_env):
+        p = FakeProc()
+        procs[(slot.hostname, slot.local_rank)] = p
+        return p
+
+    return server, discovery, driver, procs, create_worker
+
+
+def test_host_manager_update_results():
+    d = FixedHosts({"a": 2})
+    m = HostManager(d)
+    assert m.update_available_hosts() == HostUpdateResult.ADDED
+    assert m.update_available_hosts() == HostUpdateResult.NO_UPDATE
+    d.set({"a": 2, "b": 2})
+    assert m.update_available_hosts() == HostUpdateResult.ADDED
+    d.set({"b": 2})
+    assert m.update_available_hosts() == HostUpdateResult.REMOVED
+    d.set({"a": 1})
+    assert m.update_available_hosts() == HostUpdateResult.MIXED
+
+
+def test_host_manager_blacklist_and_order():
+    d = FixedHosts({"a": 1, "b": 1, "c": 1})
+    m = HostManager(d)
+    m.update_available_hosts()
+    assert [h for h, _ in m.current_hosts] == ["a", "b", "c"]
+    m.blacklist("a")
+    assert [h for h, _ in m.current_hosts] == ["b", "c"]
+    assert m.available_slots() == 2
+    # Oldest-first order is stable across membership churn.
+    d.set({"c": 1, "b": 1, "d": 1})
+    m.update_available_hosts()
+    assert [h for h, _ in m.current_hosts] == ["b", "c", "d"]
+
+
+def test_driver_initial_assignment_published():
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 2, "b": 2}, 4)
+    driver.start(create)
+    try:
+        assert driver.epoch == 0
+        assert len(procs) == 4
+        row = server.handle_get("rank_and_size_e0/a:0")
+        assert row is not None and row.decode().startswith("0,4,")
+        assert server.handle_get("meta/epoch") == b"0"
+    finally:
+        driver.stop()
+
+
+def test_driver_host_added_keeps_old_ranks_stable():
+    server, discovery, driver, procs, create = make_driver({"a": 2}, 2, 8)
+    driver.start(create)
+    try:
+        discovery.set({"a": 2, "b": 2})
+        deadline = time.monotonic() + 5
+        while (driver.epoch < 1 or len(procs) < 4) and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.epoch >= 1
+        e = driver.epoch
+        # Old host keeps ranks 0-1 (oldest-first order, ref driver.py:227-259)
+        assert server.handle_get(f"rank_and_size_e{e}/a:0").decode().startswith("0,4,")
+        assert server.handle_get(f"rank_and_size_e{e}/a:1").decode().startswith("1,4,")
+        assert server.handle_get(f"rank_and_size_e{e}/b:0").decode().startswith("2,4,")
+        assert len(procs) == 4
+    finally:
+        driver.stop()
+
+
+def test_driver_worker_failure_blacklists_and_resumes():
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 1, "b": 1}, 1, 2)
+    driver.start(create)
+    try:
+        # b's worker dies; a's worker parks READY at the barrier.
+        procs[("b", 0)].exit(1)
+        time.sleep(0.1)
+        server.handle_put("ready_e0/a:0", b"1")
+        deadline = time.monotonic() + 5
+        while driver.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.epoch >= 1
+        assert driver.host_manager.is_blacklisted("b")
+        e = driver.epoch
+        # New world is a alone, size 1; b's worker got an INVALID row or
+        # none (it is dead).
+        assert server.handle_get(f"rank_and_size_e{e}/a:0").decode().startswith("0,1,")
+        assert not driver.finished
+    finally:
+        driver.stop()
+
+
+def test_driver_all_failures_finishes_nonzero():
+    server, discovery, driver, procs, create = make_driver({"a": 2}, 2)
+    driver.start(create)
+    procs[("a", 0)].exit(1)
+    procs[("a", 1)].exit(1)
+    assert driver.wait(timeout=5) == 1
+    driver.stop()
+
+
+def test_driver_all_success_finishes_zero():
+    server, discovery, driver, procs, create = make_driver({"a": 2}, 2)
+    driver.start(create)
+    procs[("a", 0)].exit(0)
+    procs[("a", 1)].exit(0)
+    assert driver.wait(timeout=5) == 0
+    driver.stop()
+
+
+def test_reset_limit_enforced():
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 1, "b": 1, "c": 1}, 1, 3, reset_limit=1)
+    driver.start(create)
+    try:
+        # Failure 1: reset_count=1 <= limit → resume.
+        procs[("c", 0)].exit(1)
+        server.handle_put("ready_e0/a:0", b"1")
+        server.handle_put("ready_e0/b:0", b"1")
+        deadline = time.monotonic() + 5
+        while driver.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.epoch >= 1 and not driver.finished
+        # Failure 2: exceeds limit → finish(1).
+        e = driver.epoch
+        procs[("b", 0)].exit(1)
+        server.handle_put(f"ready_e{e}/a:0", b"1")
+        assert driver.wait(timeout=5) == 1
+    finally:
+        driver.stop()
+
+
+def test_registry_invalid_worker_exit_not_counted():
+    """A worker that exits 0 after receiving an INVALID row must not be
+    recorded as a SUCCESS verdict for the new epoch."""
+    server, discovery, driver, procs, create = make_driver({"a": 2}, 1, 2)
+    driver.start(create)
+    try:
+        discovery.set({"a": 1})  # shrink: a:1 loses its slot
+        deadline = time.monotonic() + 5
+        while driver.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        e = driver.epoch
+        assert server.handle_get(f"rank_and_size_e{e}/a:1").decode() == INVALID_ROW
+        procs[("a", 1)].exit(0)  # removed worker exits cleanly
+        time.sleep(0.3)
+        assert not driver.finished  # job keeps running with a:0
+    finally:
+        driver.stop()

@@ -1,0 +1,154 @@
+"""Elastic end-to-end tests with REAL worker processes and a scripted
+discovery whose output changes mid-training (ref test model:
+test/integration/elastic_common.py — hosts added, fault tolerance via
+injected worker death)."""
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+from horovod_tpu.runner.launch import slot_env, spawn_worker
+from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent(
+    """
+    import os, pickle, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.backend.elastic_env import spawn_identity
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+    from horovod_tpu.elastic.state import ObjectState
+    from horovod_tpu.utils import env as env_cfg
+
+    TOTAL = int(os.environ["TEST_TOTAL_BATCHES"])
+    FAIL_KEY = os.environ.get("TEST_FAIL_KEY")
+    FAIL_SENTINEL = os.environ.get("TEST_FAIL_SENTINEL")
+
+    hvd.init()
+    state = ObjectState(batch=0, history=[])
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < TOTAL:
+            if (
+                FAIL_KEY
+                and spawn_identity() == FAIL_KEY
+                and not os.path.exists(FAIL_SENTINEL)
+                and state.batch >= 3
+            ):
+                open(FAIL_SENTINEL, "w").close()
+                os._exit(1)
+            hvd.allreduce(np.ones(2, np.float32), name="g")
+            state.history.append((hvd.rank(), hvd.size()))
+            state.batch += 1
+            state.commit()
+            time.sleep(0.05)
+        return list(state.history)
+
+    hist = train(state)
+    rdv = RendezvousClient(
+        env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR),
+        env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0),
+    )
+    rdv.put("test_results", spawn_identity(), pickle.dumps((hvd.rank(), hist)))
+    print(f"worker {spawn_identity()} done as rank {hvd.rank()}")
+    """
+)
+
+
+def _run_elastic(tmp_path, discovery_script, min_np, max_np, worker_env,
+                 timeout=180):
+    os.environ["HVDRUN_FORCE_LOCAL"] = "1"
+    server = RendezvousServer()
+    port = server.start()
+    driver = ElasticDriver(
+        server, HostDiscoveryScript(discovery_script, 1), min_np, max_np,
+        poll_interval=0.25,
+    )
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+
+    def create_worker(slot, extra_env):
+        env = slot_env(slot, "127.0.0.1", port, dict(worker_env),
+                       elastic=True)
+        env.update(extra_env)
+        env["PYTHONPATH"] = REPO
+        env["HVDRUN_FORCE_LOCAL"] = "1"
+        env["HOROVOD_CYCLE_TIME"] = "1"
+        handle = spawn_worker(slot, [sys.executable, str(script)], env,
+                              prefix_output=False)
+        return handle.proc
+
+    try:
+        driver.start(create_worker)
+        code = driver.wait(timeout=timeout)
+        results = {}
+        for key in ("hostA:0", "hostB:0"):
+            blob = server.handle_get(f"test_results/{key}")
+            if blob is not None:
+                results[key] = pickle.loads(blob)
+        return code, results
+    finally:
+        driver.stop()
+        server.stop()
+        os.environ.pop("HVDRUN_FORCE_LOCAL", None)
+
+
+def test_elastic_host_added_mid_training(tmp_path):
+    """Start with one host; a second appears mid-run. Training must
+    continue through the reset and finish at size 2."""
+    phase2 = tmp_path / "phase2"
+    script = tmp_path / "discover.sh"
+    script.write_text(
+        f"#!/bin/sh\necho hostA:1\n[ -f {phase2} ] && echo hostB:1\nexit 0\n"
+    )
+    script.chmod(0o755)
+
+    import threading
+
+    threading.Timer(4.0, lambda: phase2.touch()).start()
+    code, results = _run_elastic(
+        tmp_path, str(script), min_np=1, max_np=2,
+        worker_env={"TEST_TOTAL_BATCHES": "60"},
+    )
+    assert code == 0, code
+    assert "hostA:0" in results
+    rank, hist = results["hostA:0"]
+    sizes = {s for _, s in hist}
+    assert 1 in sizes and 2 in sizes, sizes
+    assert "hostB:0" in results  # the added worker also finished
+
+
+def test_elastic_fault_tolerance_worker_death(tmp_path):
+    """Two hosts; hostB's worker kills itself mid-run. The driver must
+    blacklist hostB and the survivor finishes alone."""
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho hostA:1\necho hostB:1\n")
+    script.chmod(0o755)
+    sentinel = tmp_path / "failed_once"
+
+    code, results = _run_elastic(
+        tmp_path, str(script), min_np=1, max_np=2,
+        worker_env={
+            "TEST_TOTAL_BATCHES": "30",
+            "TEST_FAIL_KEY": "hostB:0",
+            "TEST_FAIL_SENTINEL": str(sentinel),
+        },
+    )
+    assert code == 0, code
+    assert sentinel.exists()  # the failure really happened
+    assert "hostA:0" in results
+    rank, hist = results["hostA:0"]
+    sizes = [s for _, s in hist]
+    assert 2 in sizes and sizes[-1] == 1, sizes  # shrank to 1 and finished
