@@ -1,0 +1,118 @@
+"""Gaussian-process Bayesian optimization for the autotuner.
+
+NumPy re-implementation of the reference's Eigen-based GP + expected-
+improvement machinery (ref: horovod/common/optim/gaussian_process.{h,cc},
+bayesian_optimization.{h,cc}): RBF-kernel GP posterior, EI acquisition,
+next sample = argmax EI over the bounded box (random multistart instead
+of the reference's LBFGS — same optimum in practice on 2-D boxes, no
+third_party/lbfgs dependency).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """RBF-kernel GP regression (ref: gaussian_process.h)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-6,
+                 signal_var: float = 1.0):
+        self.length_scale = length_scale
+        self.noise = noise
+        self.signal_var = signal_var
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_var * np.exp(-0.5 * d2 / self.length_scale**2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        self._x = np.atleast_2d(np.asarray(x, np.float64))
+        self._y = np.asarray(y, np.float64).reshape(-1)
+        k = self._kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.noise
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, self._y)
+        )
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior mean and std at x."""
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return np.zeros(len(x)), np.full(len(x), np.sqrt(self.signal_var))
+        ks = self._kernel(x, self._x)
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.maximum(
+            self.signal_var - np.sum(v * v, axis=0), 1e-12
+        )
+        return mean, np.sqrt(var)
+
+
+def expected_improvement(
+    gp: GaussianProcess, x: np.ndarray, best_y: float, xi: float = 0.01
+) -> np.ndarray:
+    """(ref: bayesian_optimization.cc ExpectedImprovement)"""
+    from math import erf, sqrt
+
+    mean, std = gp.predict(x)
+    imp = mean - best_y - xi
+    z = imp / std
+    cdf = 0.5 * (1.0 + np.vectorize(erf)(z / np.sqrt(2.0)))
+    pdf = np.exp(-0.5 * z**2) / np.sqrt(2 * np.pi)
+    ei = imp * cdf + std * pdf
+    ei[std < 1e-9] = 0.0
+    return ei
+
+
+class BayesianOptimization:
+    """Sequential model-based search over a bounded box
+    (ref: bayesian_optimization.h — NextSample)."""
+
+    def __init__(self, bounds: Sequence[Tuple[float, float]],
+                 seed: int = 0, n_warmup: int = 3):
+        self.bounds = np.asarray(bounds, np.float64)
+        self.dim = len(bounds)
+        self.rng = np.random.RandomState(seed)
+        self.n_warmup = n_warmup
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+        self.gp = GaussianProcess(length_scale=0.25)
+
+    def _norm(self, x: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (x - lo) / (hi - lo)
+
+    def _denorm(self, u: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + u * (hi - lo)
+
+    def register(self, x: Sequence[float], y: float):
+        self.xs.append(self._norm(np.asarray(x, np.float64)))
+        self.ys.append(float(y))
+
+    def next_sample(self, n_candidates: int = 1000) -> np.ndarray:
+        if len(self.xs) < self.n_warmup:
+            # Space-filling warmup: fixed Halton-ish jittered grid.
+            u = self.rng.rand(self.dim)
+            return self._denorm(u)
+        y = np.asarray(self.ys)
+        # Normalize scores for GP conditioning.
+        mu, sd = y.mean(), max(y.std(), 1e-9)
+        self.gp.fit(np.stack(self.xs), (y - mu) / sd)
+        cands = self.rng.rand(n_candidates, self.dim)
+        ei = expected_improvement(self.gp, cands, float((y.max() - mu) / sd))
+        return self._denorm(cands[int(np.argmax(ei))])
+
+    @property
+    def best(self) -> Tuple[Optional[np.ndarray], float]:
+        if not self.ys:
+            return None, -np.inf
+        i = int(np.argmax(self.ys))
+        return self._denorm(self.xs[i]), self.ys[i]

@@ -107,6 +107,7 @@ class Engine:
         self._explicit_backend = backend
         self.backend = None
         self.controller: Optional[Controller] = None
+        self.param_manager = None
         self.tensor_queue = TensorQueue()
         self.handles = HandleManager()
         self.timeline = Timeline() if rank == 0 else Timeline(use_env=False)
@@ -143,6 +144,11 @@ class Engine:
 
                 self.backend = TcpBackend(self.rank, self.size)
             self.controller = Controller(self.backend, self.size, self.rank)
+            from .parameter_manager import ParameterManager
+
+            self.param_manager = ParameterManager(
+                is_coordinator=(self.rank == 0)
+            )
         except BaseException as e:  # surface rendezvous failures to init()
             self._init_error = e
             self._initialized.set()
@@ -171,6 +177,26 @@ class Engine:
         )
         for resp in resp_list.responses:
             self._perform_operation(resp)
+        # Autotune (ref: operations.cc:592-600): windows are counted in
+        # response cycles, identical on all ranks, so the parameter-sync
+        # broadcast below lines up as a collective.
+        if (self.param_manager is not None and not self.param_manager.done
+                and resp_list.responses):
+            nbytes = sum(
+                self.controller._sizes_by_name.get(n, 0)
+                for resp in resp_list.responses
+                for n in resp.tensor_names
+            )
+            if self.param_manager.update(nbytes):
+                payload = self.controller.synchronize_parameters(
+                    self.param_manager.serialize()
+                )
+                if not self.controller.is_coordinator:
+                    self.param_manager.apply(payload)
+                self.controller.fusion_threshold = (
+                    self.param_manager.fusion_threshold
+                )
+                self.cycle_time_s = self.param_manager.cycle_time_ms / 1000.0
         if should_shutdown:
             self.tensor_queue.finalize(Status.Aborted("Horovod has been shut down."))
             return False

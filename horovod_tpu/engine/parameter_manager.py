@@ -1,0 +1,144 @@
+"""Autotuner: online tuning of fusion threshold + cycle time.
+
+(ref: horovod/common/parameter_manager.{h,cc}:163-228 — joint Bayesian
+optimization of HOROVOD_FUSION_THRESHOLD and HOROVOD_CYCLE_TIME with a
+GP surrogate, categorical toggles, bytes/sec scoring over sample windows
+with warmup discard; best params broadcast to all ranks via
+Controller::SynchronizeParameters, controller.cc:34-48. Enabled by
+HOROVOD_AUTOTUNE, CSV log via HOROVOD_AUTOTUNE_LOG,
+operations.cc:497-507.)
+
+Only rank 0 tunes; every cycle the engine reports processed bytes, and
+at window boundaries rank 0 either registers the score + proposes the
+next sample (still tuning) or pins the best-seen parameters (done).
+Parameter sync rides the existing control plane.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .bayesian import BayesianOptimization
+
+logger = get_logger()
+
+# Tuning box (ref: parameter_manager.cc bounds): fusion 0-64 MB on a
+# log-ish scale via MB directly, cycle 1-25 ms.
+FUSION_MB_BOUNDS = (1.0, 64.0)
+CYCLE_MS_BOUNDS = (1.0, 25.0)
+
+
+class ParameterManager:
+    def __init__(
+        self,
+        is_coordinator: bool,
+        enabled: Optional[bool] = None,
+        warmup_samples: int = 1,
+        cycles_per_sample: int = 10,
+        max_samples: int = 20,
+        log_path: Optional[str] = None,
+    ):
+        self.enabled = (
+            env_cfg.get_bool(env_cfg.AUTOTUNE, False)
+            if enabled is None else enabled
+        )
+        self.is_coordinator = is_coordinator
+        self.warmup_samples = warmup_samples
+        self.cycles_per_sample = cycles_per_sample
+        self.max_samples = max_samples
+        self.done = not self.enabled
+        self._bo = BayesianOptimization(
+            [FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS]
+        )
+        self._samples = 0
+        self._warmups_left = warmup_samples
+        self._cycle_count = 0
+        self._bytes = 0
+        self._window_start = time.monotonic()
+        self.fusion_threshold = env_cfg.fusion_threshold_bytes()
+        self.cycle_time_ms = env_cfg.cycle_time_ms()
+        self._log_path = log_path if log_path is not None else (
+            env_cfg.get_str(env_cfg.AUTOTUNE_LOG) or None
+        )
+        if self.enabled and self.is_coordinator and self._log_path:
+            with open(self._log_path, "w") as f:
+                f.write("sample,fusion_mb,cycle_ms,score_bytes_per_sec\n")
+
+    # ------------------------------------------------------------------
+    def update(self, nbytes: int) -> bool:
+        """Record one engine cycle's processed bytes. Returns True at a
+        sync boundary — the caller must then run the collective
+        parameter sync (coordinator serializes, workers apply) and
+        re-read (fusion_threshold, cycle_time_ms).
+
+        Cycle/window counting is driven by response cycles, which are
+        identical on every rank, so all ranks reach boundaries together
+        and the sync broadcast lines up (ref: ParameterManager::Update +
+        RunLoopOnce autotune block, operations.cc:592-600)."""
+        if self.done:
+            return False
+        self._bytes += nbytes
+        self._cycle_count += 1
+        if self._cycle_count < self.cycles_per_sample:
+            return False
+        elapsed = max(time.monotonic() - self._window_start, 1e-9)
+        score = self._bytes / elapsed
+        self._bytes = 0
+        self._cycle_count = 0
+        self._window_start = time.monotonic()
+        if self._warmups_left > 0:
+            # Discard warmup windows (ref: parameter_manager warmup);
+            # identical countdown on every rank.
+            self._warmups_left -= 1
+            return False
+        if self.is_coordinator:
+            self._on_sample(score)
+        return True
+
+    def _on_sample(self, score: float) -> bool:
+        self._bo.register(
+            [self.fusion_threshold / (1024.0 * 1024.0), self.cycle_time_ms],
+            score,
+        )
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(
+                    f"{self._samples},"
+                    f"{self.fusion_threshold / (1024.0 * 1024.0):.2f},"
+                    f"{self.cycle_time_ms:.2f},{score:.1f}\n"
+                )
+        self._samples += 1
+        if self._samples >= self.max_samples:
+            best, best_y = self._bo.best
+            self.fusion_threshold = int(best[0] * 1024 * 1024)
+            self.cycle_time_ms = float(best[1])
+            self.done = True
+            logger.info(
+                "autotune done: fusion=%.1fMB cycle=%.2fms (%.0f bytes/s)",
+                best[0], best[1], best_y,
+            )
+            return True
+        nxt = self._bo.next_sample()
+        self.fusion_threshold = int(nxt[0] * 1024 * 1024)
+        self.cycle_time_ms = float(nxt[1])
+        return True
+
+    # ------------------------------------------------------------------
+    # Cross-rank parameter sync (ref: Controller::SynchronizeParameters).
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "fusion_threshold": self.fusion_threshold,
+            "cycle_time_ms": self.cycle_time_ms,
+            "done": self.done,
+        }).encode()
+
+    def apply(self, payload: bytes):
+        d = json.loads(payload.decode())
+        self.fusion_threshold = int(d["fusion_threshold"])
+        self.cycle_time_ms = float(d["cycle_time_ms"])
+        self.done = bool(d["done"])

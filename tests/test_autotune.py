@@ -1,0 +1,124 @@
+"""Autotuner tests (ref: the reference exercises its Bayesian machinery
+through HOROVOD_AUTOTUNE runs; here: GP regression sanity, BO
+convergence on a known surface, windowed parameter manager behavior, and
+a live 2-rank engine run with autotuning enabled)."""
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.engine.bayesian import (
+    BayesianOptimization,
+    GaussianProcess,
+    expected_improvement,
+)
+from horovod_tpu.engine.parameter_manager import ParameterManager
+
+
+def test_gp_interpolates_training_points():
+    gp = GaussianProcess(length_scale=0.5, noise=1e-8)
+    x = np.array([[0.0], [0.5], [1.0]])
+    y = np.array([0.0, 1.0, 0.0])
+    gp.fit(x, y)
+    mean, std = gp.predict(x)
+    np.testing.assert_allclose(mean, y, atol=1e-3)
+    assert (std < 0.01).all()
+
+
+def test_gp_uncertainty_grows_away_from_data():
+    gp = GaussianProcess(length_scale=0.2)
+    gp.fit(np.array([[0.0]]), np.array([1.0]))
+    _, std_near = gp.predict(np.array([[0.01]]))
+    _, std_far = gp.predict(np.array([[1.0]]))
+    assert std_far[0] > std_near[0]
+
+
+def test_bo_finds_peak_of_quadratic():
+    """Maximize -(x-0.7)^2-(y-0.3)^2 over [0,1]^2 in 25 samples."""
+    bo = BayesianOptimization([(0.0, 1.0), (0.0, 1.0)], seed=1)
+
+    def f(p):
+        return -((p[0] - 0.7) ** 2) - (p[1] - 0.3) ** 2
+
+    for _ in range(25):
+        x = bo.next_sample()
+        bo.register(x, f(x))
+    best, best_y = bo.best
+    assert abs(best[0] - 0.7) < 0.15 and abs(best[1] - 0.3) < 0.15, best
+
+
+def test_parameter_manager_window_and_convergence(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(
+        is_coordinator=True, enabled=True, warmup_samples=1,
+        cycles_per_sample=5, max_samples=6, log_path=str(log),
+    )
+    initial = (pm.fusion_threshold, pm.cycle_time_ms)
+    syncs = 0
+    for cycle in range(500):
+        if pm.update(1 << 20):
+            syncs += 1
+        if pm.done:
+            break
+    assert pm.done
+    # warmup window is discarded; each subsequent window syncs.
+    assert syncs == 6
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("sample,")
+    assert len(lines) == 1 + 6
+    # Tuned values stay inside the box.
+    assert 1 * 1024 * 1024 <= pm.fusion_threshold <= 64 * 1024 * 1024
+    assert 1.0 <= pm.cycle_time_ms <= 25.0
+
+
+def test_parameter_manager_disabled_noop():
+    pm = ParameterManager(is_coordinator=True, enabled=False)
+    assert pm.done
+    assert pm.update(123) is False
+
+
+def test_parameter_sync_serialization_roundtrip():
+    pm0 = ParameterManager(is_coordinator=True, enabled=True)
+    pm0.fusion_threshold = 12345678
+    pm0.cycle_time_ms = 7.5
+    pm0.done = True
+    pm1 = ParameterManager(is_coordinator=False, enabled=True)
+    pm1.apply(pm0.serialize())
+    assert pm1.fusion_threshold == 12345678
+    assert pm1.cycle_time_ms == 7.5
+    assert pm1.done
+
+
+def test_autotune_live_two_rank_engine(monkeypatch):
+    """End to end: two in-process ranks run allreduces with autotune on;
+    tuning completes, both ranks converge to identical parameters, and
+    results stay correct throughout."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_engine import run_ranks
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+
+    def fn(eng, rank):
+        # Small windows so tuning finishes fast.
+        eng.param_manager.cycles_per_sample = 2
+        eng.param_manager.max_samples = 3
+        eng.param_manager.warmup_samples = 1
+        for i in range(200):
+            out = eng.synchronize(
+                eng.enqueue_allreduce(
+                    np.full(8, float(rank + 1), np.float32), name=f"g{i % 4}"
+                ),
+                timeout=30,
+            )
+            np.testing.assert_allclose(out, np.full(8, 3.0))
+            if eng.param_manager.done:
+                break
+        return (eng.param_manager.done, eng.param_manager.fusion_threshold,
+                eng.param_manager.cycle_time_ms)
+
+    out = run_ranks(2, fn)
+    assert out[0][0] and out[1][0], out
+    assert out[0][1] == out[1][1]  # identical tuned fusion threshold
+    assert out[0][2] == out[1][2]  # identical tuned cycle time
