@@ -54,7 +54,27 @@ class Backend(ControllerTransport):
         pass
 
 
+_NATIVE_OP = {
+    ReduceOp.SUM: "sum",
+    ReduceOp.AVERAGE: "sum",
+    ReduceOp.MIN: "min",
+    ReduceOp.MAX: "max",
+    ReduceOp.PRODUCT: "prod",
+}
+
+
 def _reduce(op: ReduceOp, arrays: List[np.ndarray]) -> np.ndarray:
+    # Native C++ kernels first (threaded k-way reduce; ref: the C++ CPU
+    # op layer, collective_operations.h:89-125); NumPy fallback.
+    from ..cc import native
+
+    name = _NATIVE_OP.get(op)
+    if name is not None and len(arrays) > 1:
+        out = native.reduce_arrays(name, arrays)
+        if out is not None:
+            if op == ReduceOp.AVERAGE:
+                out = out / len(arrays)
+            return out
     if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
         out = arrays[0].copy()
         for a in arrays[1:]:
@@ -72,6 +92,9 @@ def _reduce(op: ReduceOp, arrays: List[np.ndarray]) -> np.ndarray:
             out *= a
         return out
     if op == ReduceOp.ADASUM:
+        native_out = native.adasum(arrays)
+        if native_out is not None:
+            return native_out[0]
         from ..ops.adasum import adasum_numpy
 
         return adasum_numpy(arrays)[0]

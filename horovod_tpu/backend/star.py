@@ -57,8 +57,6 @@ class StarCollectivesMixin(Backend):
             return arr.copy()
         gathered = self.gather_bytes(pack_array(arr))
         if self.rank == 0:
-            from ..ops.adasum import adasum_numpy
-
             arrays = [unpack_array(b) for b in gathered]
             nonempty = [a for a in arrays if a.size > 0]
             if len(nonempty) & (len(nonempty) - 1) != 0:
@@ -69,7 +67,17 @@ class StarCollectivesMixin(Backend):
                     f"Adasum requires a power-of-2 contributor count, got "
                     f"{len(nonempty)}"
                 )
-            out = np.asarray(adasum_numpy(nonempty)[0]) if nonempty else arrays[0]
+            if nonempty:
+                from ..cc import native
+
+                combined = native.adasum(nonempty)
+                if combined is None:
+                    from ..ops.adasum import adasum_numpy
+
+                    combined = adasum_numpy(nonempty)
+                out = np.asarray(combined[0])
+            else:
+                out = arrays[0]
             self.bcast_bytes(pack_array(out))
             return out
         return unpack_array(self.bcast_bytes(None)).copy()

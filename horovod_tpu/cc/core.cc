@@ -1,0 +1,222 @@
+// Native core for the eager engine's host-side hot paths.
+//
+// The reference implements its whole runtime in C++ (horovod/common/ —
+// operations.cc, collective_operations.cc fusion memcpys, adasum/adasum.h
+// VHDD math). On TPU the *device* hot path is XLA; what remains hot on
+// the host in process mode is exactly what lives here:
+//
+//   * k-way reduction kernels for the star data plane
+//     (ref: CPU ScaleBuffer/allreduce paths, collective_operations.h:89-125)
+//   * fusion-buffer pack/unpack, multithreaded memcpy
+//     (ref: MemcpyInFusionBuffer/MemcpyOutFusionBuffer)
+//   * the Adasum pairwise recursion with float64 dot/norm accumulation
+//     (ref: ops/adasum/adasum.h:100-280)
+//   * bit-vector AND/OR for cache coordination
+//     (ref: response_cache.h bitvector sync)
+//
+// Exposed as a plain C ABI consumed via ctypes (horovod_tpu/cc/native.py)
+// — the same load pattern as the reference's HorovodBasics
+// (horovod/common/basics.py:22-233), no pybind dependency.
+//
+// Build: `make -C horovod_tpu/cc` (g++ -O3 -shared; no external deps).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kParallelThresholdBytes = 1 << 20;  // 1 MB
+
+int hardware_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 2 : static_cast<int>(n);
+}
+
+// Run fn(begin, end) over [0, n) in roughly equal chunks.
+template <typename F>
+void parallel_for(int64_t n, int64_t grain, F fn) {
+  int nthreads = hardware_threads();
+  if (n < grain || nthreads <= 1) {
+    fn(0, n);
+    return;
+  }
+  int chunks = std::min<int64_t>(nthreads, (n + grain - 1) / grain);
+  std::vector<std::thread> threads;
+  threads.reserve(chunks - 1);
+  int64_t per = (n + chunks - 1) / chunks;
+  for (int c = 1; c < chunks; ++c) {
+    int64_t b = c * per, e = std::min<int64_t>(n, b + per);
+    if (b >= e) break;
+    threads.emplace_back([=] { fn(b, e); });
+  }
+  fn(0, std::min<int64_t>(n, per));
+  for (auto& t : threads) t.join();
+}
+
+template <typename T>
+void reduce_impl(const T** srcs, int nsrc, int64_t len, T* out, int op) {
+  // op: 0=sum, 1=min, 2=max, 3=prod
+  parallel_for(len, 1 << 16, [&](int64_t b, int64_t e) {
+    std::memcpy(out + b, srcs[0] + b, (e - b) * sizeof(T));
+    for (int s = 1; s < nsrc; ++s) {
+      const T* src = srcs[s];
+      switch (op) {
+        case 0:
+          for (int64_t i = b; i < e; ++i) out[i] += src[i];
+          break;
+        case 1:
+          for (int64_t i = b; i < e; ++i)
+            out[i] = src[i] < out[i] ? src[i] : out[i];
+          break;
+        case 2:
+          for (int64_t i = b; i < e; ++i)
+            out[i] = src[i] > out[i] ? src[i] : out[i];
+          break;
+        case 3:
+          for (int64_t i = b; i < e; ++i) out[i] *= src[i];
+          break;
+      }
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// k-way elementwise reduction. dtype: 0=f32, 1=f64, 2=i32, 3=i64.
+// Returns 0 on success, -1 on bad dtype/op.
+int hvd_reduce(const void** srcs, int nsrc, int64_t len, void* out, int dtype,
+               int op) {
+  if (nsrc <= 0 || op < 0 || op > 3) return -1;
+  switch (dtype) {
+    case 0:
+      reduce_impl(reinterpret_cast<const float**>(srcs), nsrc, len,
+                  static_cast<float*>(out), op);
+      return 0;
+    case 1:
+      reduce_impl(reinterpret_cast<const double**>(srcs), nsrc, len,
+                  static_cast<double*>(out), op);
+      return 0;
+    case 2:
+      reduce_impl(reinterpret_cast<const int32_t**>(srcs), nsrc, len,
+                  static_cast<int32_t*>(out), op);
+      return 0;
+    case 3:
+      reduce_impl(reinterpret_cast<const int64_t**>(srcs), nsrc, len,
+                  static_cast<int64_t*>(out), op);
+      return 0;
+    default:
+      return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Fusion buffer pack/unpack (ref: MemcpyIn/OutFusionBuffer).
+void hvd_pack(const void** srcs, const int64_t* nbytes, int n, void* dst) {
+  std::vector<int64_t> offs(n + 1, 0);
+  for (int i = 0; i < n; ++i) offs[i + 1] = offs[i] + nbytes[i];
+  if (offs[n] >= kParallelThresholdBytes && n > 1) {
+    std::atomic<int> next{0};
+    int nthreads = std::min(hardware_threads(), n);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < nthreads; ++t)
+      threads.emplace_back([&] {
+        int i;
+        while ((i = next.fetch_add(1)) < n)
+          std::memcpy(static_cast<char*>(dst) + offs[i], srcs[i], nbytes[i]);
+      });
+    for (auto& th : threads) th.join();
+  } else {
+    for (int i = 0; i < n; ++i)
+      std::memcpy(static_cast<char*>(dst) + offs[i], srcs[i], nbytes[i]);
+  }
+}
+
+void hvd_unpack(const void* src, const int64_t* nbytes, int n, void** dsts) {
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    std::memcpy(dsts[i], static_cast<const char*>(src) + off, nbytes[i]);
+    off += nbytes[i];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Adasum (ref: adasum.h:100-280). vecs: nvec pointers to f64 arrays of
+// length n, combined IN PLACE so that every slot holds the Adasum result.
+// nvec must be a power of two. Dot/norm accumulation is f64 end-to-end
+// like the reference's DispatchComputeDotAndNormSqrds.
+int hvd_adasum(double** vecs, int nvec, int64_t n) {
+  if (nvec <= 0 || (nvec & (nvec - 1)) != 0) return -1;
+  std::vector<std::vector<double>> scratch(nvec);
+  for (int stride = 1; stride < nvec; stride <<= 1) {
+    // Each unordered pair (i, i^stride) combines symmetrically.
+    for (int i = 0; i < nvec; ++i) {
+      int j = i ^ stride;
+      if (j < i) continue;
+      const double* a = vecs[i];
+      const double* b = vecs[j];
+      double dot = 0.0, na = 0.0, nb = 0.0;
+      // Threaded partial sums for big vectors.
+      if (n >= (1 << 18)) {
+        int nthreads = hardware_threads();
+        std::vector<double> pd(nthreads, 0), pa(nthreads, 0), pb(nthreads, 0);
+        std::vector<std::thread> threads;
+        int64_t per = (n + nthreads - 1) / nthreads;
+        for (int t = 0; t < nthreads; ++t)
+          threads.emplace_back([&, t] {
+            int64_t b0 = t * per, e0 = std::min(n, b0 + per);
+            double d = 0, x = 0, y = 0;
+            for (int64_t k = b0; k < e0; ++k) {
+              d += a[k] * b[k];
+              x += a[k] * a[k];
+              y += b[k] * b[k];
+            }
+            pd[t] = d;
+            pa[t] = x;
+            pb[t] = y;
+          });
+        for (auto& th : threads) th.join();
+        for (int t = 0; t < nthreads; ++t) {
+          dot += pd[t];
+          na += pa[t];
+          nb += pb[t];
+        }
+      } else {
+        for (int64_t k = 0; k < n; ++k) {
+          dot += a[k] * b[k];
+          na += a[k] * a[k];
+          nb += b[k] * b[k];
+        }
+      }
+      double ca = na > 0 ? 1.0 - dot / (2.0 * na) : 1.0;
+      double cb = nb > 0 ? 1.0 - dot / (2.0 * nb) : 1.0;
+      auto& tmp = scratch[i];
+      tmp.resize(n);
+      parallel_for(n, 1 << 16, [&](int64_t b0, int64_t e0) {
+        for (int64_t k = b0; k < e0; ++k) tmp[k] = ca * a[k] + cb * b[k];
+      });
+      std::memcpy(vecs[i], tmp.data(), n * sizeof(double));
+      std::memcpy(vecs[j], tmp.data(), n * sizeof(double));
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Bit-vector ops (ref: response_cache.h). op: 0=and, 1=or.
+void hvd_words_op(uint64_t* acc, const uint64_t* other, int n, int op) {
+  if (op == 0)
+    for (int i = 0; i < n; ++i) acc[i] &= other[i];
+  else
+    for (int i = 0; i < n; ++i) acc[i] |= other[i];
+}
+
+int hvd_abi_version() { return 1; }
+
+}  // extern "C"

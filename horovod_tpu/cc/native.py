@@ -1,0 +1,153 @@
+"""ctypes loader for the native core (libhvdtpu.so).
+
+Mirrors the reference's extension-loading pattern (HorovodBasics ctypes
+load, ref: horovod/common/basics.py:22-233 + check_extension,
+horovod/common/util.py:50): build lazily with make on first use, cache
+the handle, and fail soft — every caller has a NumPy fallback, so an
+unbuildable environment degrades to pure Python instead of erroring.
+Disable explicitly with HOROVOD_DISABLE_NATIVE=1.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libhvdtpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The lib handle, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HOROVOD_DISABLE_NATIVE"):
+            return None
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.hvd_abi_version.restype = ctypes.c_int
+            if lib.hvd_abi_version() != 1:
+                return None
+            lib.hvd_reduce.restype = ctypes.c_int
+            lib.hvd_adasum.restype = ctypes.c_int
+            _lib = lib
+        except OSError:
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def native_built() -> bool:
+    """Introspection à la mpi_built()/gloo_built()."""
+    return available()
+
+
+# ---------------------------------------------------------------------------
+def reduce_arrays(op: str, arrays: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """k-way elementwise reduce; None → caller falls back to NumPy."""
+    lib = load()
+    if lib is None or not arrays:
+        return None
+    dt = _DTYPES.get(arrays[0].dtype)
+    if dt is None or op not in _OPS:
+        return None
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    out = np.empty_like(arrays[0])
+    ptrs = (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
+    )
+    rc = lib.hvd_reduce(
+        ptrs, len(arrays), arrays[0].size,
+        out.ctypes.data_as(ctypes.c_void_p), dt, _OPS[op],
+    )
+    return out if rc == 0 else None
+
+
+def pack(arrays: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """Concatenate raveled arrays into one byte buffer (fusion pack)."""
+    lib = load()
+    if lib is None:
+        return None
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    sizes = (ctypes.c_int64 * len(arrays))(*[a.nbytes for a in arrays])
+    total = sum(a.nbytes for a in arrays)
+    dst = np.empty(total, np.uint8)
+    ptrs = (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
+    )
+    lib.hvd_pack(ptrs, sizes, len(arrays),
+                 dst.ctypes.data_as(ctypes.c_void_p))
+    return dst
+
+
+def unpack(buf: np.ndarray, shapes: List[tuple], dtype) -> Optional[List[np.ndarray]]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf.view(np.uint8).ravel())
+    outs = [np.empty(s, dtype) for s in shapes]
+    sizes = (ctypes.c_int64 * len(outs))(*[o.nbytes for o in outs])
+    ptrs = (ctypes.c_void_p * len(outs))(
+        *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs]
+    )
+    lib.hvd_unpack(buf.ctypes.data_as(ctypes.c_void_p), sizes, len(outs), ptrs)
+    return outs
+
+
+def adasum(arrays: Sequence[np.ndarray]) -> Optional[List[np.ndarray]]:
+    """In-place VHDD Adasum over a power-of-2 list; returns the combined
+    result per input slot (all identical), original dtypes preserved."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(arrays)
+    if n & (n - 1) != 0:
+        return None
+    f64 = [np.ascontiguousarray(a, np.float64).ravel() for a in arrays]
+    ptrs = (ctypes.POINTER(ctypes.c_double) * n)(
+        *[v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for v in f64]
+    )
+    rc = lib.hvd_adasum(ptrs, n, f64[0].size)
+    if rc != 0:
+        return None
+    return [
+        v.reshape(np.asarray(a).shape).astype(np.asarray(a).dtype)
+        for v, a in zip(f64, arrays)
+    ]

@@ -264,10 +264,17 @@ class Engine:
             shapes = None
         else:
             # Fusion buffer: flatten + concat (ref: MemcpyInFusionBuffer,
-            # collective_operations.cc).
+            # collective_operations.cc; native multithreaded memcpy when
+            # the C++ core is built).
             self.timeline.activity_start(name0, MEMCPY_IN_FUSION_BUFFER)
             shapes = [e.tensor.shape for e in entries]
-            buf = np.concatenate([np.ravel(e.tensor) for e in entries])
+            from ..cc import native
+
+            packed = native.pack([e.tensor for e in entries])
+            if packed is not None:
+                buf = packed.view(entries[0].tensor.dtype)
+            else:
+                buf = np.concatenate([np.ravel(e.tensor) for e in entries])
             self.timeline.activity_end(name0)
         if pre != 1.0:
             buf = _scale_np(buf, pre)

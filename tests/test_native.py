@@ -1,0 +1,116 @@
+"""Native C++ core tests: every kernel is verified against its NumPy
+fallback (the reference's pattern of validating Adasum against a NumPy
+model, test/test_adasum_pytorch.py)."""
+import numpy as np
+import pytest
+
+from horovod_tpu.cc import native
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.backend.base import _reduce
+from horovod_tpu.ops.adasum import adasum_numpy
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    # g++ is part of the baked toolchain; the build must succeed here.
+    assert native.available(), "native core failed to build"
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                   np.int64])
+@pytest.mark.parametrize("op", ["sum", "min", "max", "prod"])
+def test_reduce_matches_numpy(op, dtype):
+    rng = np.random.RandomState(0)
+    if np.issubdtype(dtype, np.integer):
+        arrays = [rng.randint(1, 5, 257).astype(dtype) for _ in range(4)]
+    else:
+        arrays = [rng.rand(257).astype(dtype) + 0.5 for _ in range(4)]
+    got = native.reduce_arrays(op, arrays)
+    ref = {
+        "sum": lambda: np.sum(arrays, axis=0, dtype=dtype),
+        "min": lambda: np.minimum.reduce(arrays),
+        "max": lambda: np.maximum.reduce(arrays),
+        "prod": lambda: np.prod(np.stack(arrays), axis=0, dtype=dtype),
+    }[op]()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    assert got.dtype == dtype
+
+
+def test_reduce_large_parallel_path():
+    rng = np.random.RandomState(1)
+    arrays = [rng.rand(1 << 18).astype(np.float32) for _ in range(3)]
+    got = native.reduce_arrays("sum", arrays)
+    np.testing.assert_allclose(got, np.sum(arrays, axis=0), rtol=1e-5)
+
+
+def test_reduce_unsupported_dtype_falls_back():
+    arrays = [np.ones(4, np.uint8) for _ in range(2)]
+    assert native.reduce_arrays("sum", arrays) is None
+    # _reduce still works through the NumPy path.
+    out = _reduce(ReduceOp.SUM, arrays)
+    np.testing.assert_array_equal(out, np.full(4, 2, np.uint8))
+
+
+def test_pack_unpack_roundtrip_mixed_shapes():
+    rng = np.random.RandomState(2)
+    arrays = [rng.rand(*s).astype(np.float32)
+              for s in [(3, 4), (7,), (2, 2, 2), (1,)]]
+    packed = native.pack(arrays)
+    assert packed.nbytes == sum(a.nbytes for a in arrays)
+    outs = native.unpack(packed, [a.shape for a in arrays], np.float32)
+    for a, b in zip(arrays, outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pack_large_parallel_path():
+    rng = np.random.RandomState(3)
+    arrays = [rng.rand(1 << 17).astype(np.float32) for _ in range(8)]
+    packed = native.pack(arrays).view(np.float32)
+    np.testing.assert_array_equal(
+        packed, np.concatenate([a.ravel() for a in arrays])
+    )
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_adasum_matches_numpy_oracle(n):
+    rng = np.random.RandomState(4)
+    arrays = [rng.randn(33).astype(np.float32) for _ in range(n)]
+    got = native.adasum(arrays)
+    ref = adasum_numpy(arrays)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+        assert g.dtype == np.float32
+
+
+def test_adasum_identical_vectors_identity():
+    """n identical vectors adasum-combine to the same vector."""
+    v = np.linspace(-1, 1, 17).astype(np.float64)
+    got = native.adasum([v.copy() for _ in range(4)])
+    for g in got:
+        np.testing.assert_allclose(g, v, rtol=1e-12)
+
+
+def test_adasum_rejects_non_power_of_two():
+    assert native.adasum([np.ones(4) for _ in range(3)]) is None
+
+
+def test_reduce_through_backend_dispatch():
+    """_reduce uses the native path for f32 and agrees with NumPy."""
+    rng = np.random.RandomState(5)
+    arrays = [rng.rand(100).astype(np.float32) for _ in range(3)]
+    out = _reduce(ReduceOp.AVERAGE, arrays)
+    np.testing.assert_allclose(out, np.mean(arrays, axis=0), rtol=1e-6)
+
+
+def test_disable_native_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_DISABLE_NATIVE", "1")
+    # Force a fresh load decision.
+    import horovod_tpu.cc.native as nat
+
+    old_lib, old_tried = nat._lib, nat._tried
+    nat._lib, nat._tried = None, False
+    try:
+        assert nat.load() is None
+        assert nat.reduce_arrays("sum", [np.ones(3, np.float32)] * 2) is None
+    finally:
+        nat._lib, nat._tried = old_lib, old_tried
