@@ -1,0 +1,287 @@
+"""`horovod_tpu.torch` — drop-in surface of `horovod.torch` for PyTorch
+users (ref: horovod/torch/mpi_ops.py, horovod/torch/optimizer.py,
+horovod/torch/functions.py).
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(optimizer,
+                                         named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+Tensors ride the same asynchronous name-negotiated engine as the JAX
+eager path (numpy bridge, zero-copy where torch memory is contiguous);
+on TPU hardware the JAX path is the performance surface — this adapter
+exists for capability parity and CPU-cluster jobs.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..common.basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+)
+from ..common import basics as _basics
+from ..common.exceptions import HorovodInternalError
+from ..common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
+from . import compression as _compression_mod
+from .compression import Compression  # noqa: F401
+from .optimizer import DistributedOptimizer  # noqa: F401
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy()
+
+
+def _from_numpy(arr: np.ndarray, like):
+    import torch
+
+    return torch.from_numpy(np.ascontiguousarray(arr)).to(
+        dtype=like.dtype, device=like.device
+    )
+
+
+def _engine():
+    eng = _basics.engine()
+    if eng is None:
+        raise HorovodInternalError(
+            "horovod_tpu.torch collectives need process mode (hvdrun) or "
+            "size()==1"
+        )
+    return eng
+
+
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    if op is not None and average is not None:
+        raise ValueError("specify op= or the legacy average=, not both")
+    if op is None:
+        return ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    return op
+
+
+# ---------------------------------------------------------------------------
+# Async handle API (ref: horovod/torch/mpi_ops.py:83-219)
+_handles = {}
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+    rop = _resolve_op(op, average)
+    h = _engine().enqueue_allreduce(
+        _to_numpy(tensor), name=name, op=rop,
+        prescale=prescale_factor, postscale=postscale_factor,
+    )
+    _handles[h] = ("allreduce", tensor, None)
+    return h
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """In-place variant (ref: mpi_ops.py allreduce_async_)."""
+    h = allreduce_async(tensor, average, name, op, prescale_factor,
+                        postscale_factor)
+    _handles[h] = ("allreduce_", tensor, None)
+    return h
+
+
+def allgather_async(tensor, name=None) -> int:
+    h = _engine().enqueue_allgather(_to_numpy(tensor), name=name)
+    _handles[h] = ("allgather", tensor, None)
+    return h
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    h = _engine().enqueue_broadcast(_to_numpy(tensor), root_rank, name=name)
+    _handles[h] = ("broadcast", tensor, None)
+    return h
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    h = broadcast_async(tensor, root_rank, name)
+    _handles[h] = ("broadcast_", tensor, None)
+    return h
+
+
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    h = _engine().enqueue_alltoall(
+        _to_numpy(tensor), list(splits) if splits is not None else None,
+        name=name,
+    )
+    _handles[h] = ("alltoall", tensor, None)
+    return h
+
+
+def poll(handle: int) -> bool:
+    return _engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """(ref: mpi_ops.py synchronize — returns the op's result; in-place
+    ops copy into the original tensor.)"""
+    kind, tensor, _ = _handles.pop(handle, (None, None, None))
+    out = _engine().synchronize(handle)
+    if kind == "alltoall":
+        arr, recv_splits = out
+        import torch
+
+        return _from_numpy(arr, tensor), torch.tensor(recv_splits)
+    if kind in ("allreduce_", "broadcast_"):
+        result = _from_numpy(np.asarray(out), tensor)
+        tensor.copy_(result.reshape(tensor.shape))
+        return tensor
+    if kind is None:
+        return out
+    return _from_numpy(np.asarray(out), tensor)
+
+
+# ---------------------------------------------------------------------------
+# Synchronous ops
+def _sync_single(tensor, op: ReduceOp, prescale, postscale):
+    # size-1 fast path shared by all sync ops.
+    arr = _to_numpy(tensor)
+    if op == ReduceOp.SUM:
+        arr = arr * _basics.size()
+    return _from_numpy(arr * prescale * postscale, tensor).reshape(tensor.shape)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    rop = _resolve_op(op, average)
+    if _basics.size() == 1:
+        return _sync_single(tensor, rop, prescale_factor, postscale_factor)
+    return synchronize(
+        allreduce_async(tensor, None, name, rop, prescale_factor,
+                        postscale_factor)
+    )
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0):
+    rop = _resolve_op(op, average)
+    if _basics.size() == 1:
+        tensor.copy_(_sync_single(tensor, rop, prescale_factor,
+                                  postscale_factor))
+        return tensor
+    return synchronize(
+        allreduce_async_(tensor, None, name, rop, prescale_factor,
+                         postscale_factor)
+    )
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None):
+    rop = _resolve_op(op, average)
+    base = name or "grouped"
+    handles = [
+        allreduce_async(t, None, f"{base}.{i}", rop)
+        for i, t in enumerate(tensors)
+    ]
+    return [synchronize(h) for h in handles]
+
+
+def allgather(tensor, name=None):
+    if _basics.size() == 1:
+        return tensor.clone()
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast(tensor, root_rank, name=None):
+    if _basics.size() == 1:
+        return tensor.clone()
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor, root_rank, name=None):
+    if _basics.size() == 1:
+        return tensor
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall(tensor, splits=None, name=None):
+    if _basics.size() == 1:
+        import torch
+
+        s = splits if splits is not None else [tensor.shape[0]]
+        return tensor.clone(), torch.tensor(list(s))
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
+def join() -> int:
+    from ..ops import join as _join
+
+    return _join()
+
+
+def barrier():
+    from ..ops import barrier as _barrier
+
+    _barrier()
+
+
+# ---------------------------------------------------------------------------
+# State broadcast helpers (ref: horovod/torch/functions.py:30-227)
+def broadcast_parameters(params, root_rank: int = 0):
+    """In-place broadcast of a state_dict or named_parameters iterable."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        try:
+            handles.append(broadcast_async_(p, root_rank, name=f"bp.{name}"))
+        except AttributeError:
+            continue  # non-tensor entries
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0):
+    """(ref: functions.py:60-107) — broadcast optimizer state tensors;
+    scalar hyper-state rides broadcast_object."""
+    import torch
+
+    state = optimizer.state_dict()
+    # Tensors in state broadcast in place; the rest via object broadcast.
+    scalars = broadcast_object(
+        {
+            k: v for k, v in state.items() if k != "state"
+        },
+        root_rank=root_rank, name="opt_meta",
+    )
+    state.update(scalars)
+    for pid, pstate in sorted(state.get("state", {}).items()):
+        for key, val in sorted(pstate.items()):
+            if isinstance(val, torch.Tensor):
+                broadcast_(val, root_rank, name=f"opt.{pid}.{key}")
+            else:
+                pstate[key] = broadcast_object(
+                    val, root_rank, name=f"opt.{pid}.{key}"
+                )
+    optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    from ..common.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    from ..common.functions import allgather_object as _ao
+
+    return _ao(obj, name=name)

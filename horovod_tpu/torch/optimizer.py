@@ -1,0 +1,172 @@
+"""DistributedOptimizer for torch — hook-fired async allreduce of grads
+with synchronization in step() (ref: horovod/torch/optimizer.py:32-207,
+factory at :337-414).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+from ..common import basics as _basics
+from ..common.types import ReduceOp
+from .compression import Compression
+
+
+class _DistributedOptimizer:
+    """Proxy wrapping a torch.optim.Optimizer. Gradients are allreduced
+    asynchronously as they become ready (post-accumulate-grad hooks, the
+    engine overlapping communication with the rest of backward — the
+    reference's core trick) and joined in step()."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op: ReduceOp = ReduceOp.AVERAGE,
+                 prescale_factor: float = 1.0,
+                 postscale_factor: float = 1.0):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self.backward_passes_per_step = backward_passes_per_step
+        self._passes = 0
+        self._handles = {}      # param -> (handle, ctx)
+        self._hook_handles = []
+        self._synchronized = False
+        self._should_synchronize = True
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [
+                (f"param.{gi}.{pi}", p)
+                for gi, group in enumerate(optimizer.param_groups)
+                for pi, p in enumerate(group["params"])
+            ]
+        # Duplicate-name check (ref: optimizer.py:52-64).
+        names = [n for n, _ in named]
+        if len(set(names)) != len(names):
+            raise ValueError("parameter names must be unique")
+        self._names = {p: n for n, p in named}
+        if _basics.size() > 1:
+            self._register_hooks(p for _, p in named)
+
+    # -- attribute proxying ------------------------------------------------
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    # ----------------------------------------------------------------------
+    def _register_hooks(self, params):
+        for p in params:
+            if not p.requires_grad:
+                continue
+            if hasattr(p, "register_post_accumulate_grad_hook"):
+                h = p.register_post_accumulate_grad_hook(self._make_hook(p))
+                self._hook_handles.append(h)
+
+    def _make_hook(self, p):
+        def hook(*ignore):
+            self._passes_check_and_reduce(p)
+
+        return hook
+
+    def _passes_check_and_reduce(self, p):
+        # Local accumulation: only communicate on the boundary pass
+        # (ref: optimizer.py backward_passes_per_step).
+        if (self._passes + 1) % self.backward_passes_per_step != 0:
+            return
+        if p in self._handles or p.grad is None:
+            return
+        self._handles[p] = self._allreduce_grad_async(p)
+
+    def _allreduce_grad_async(self, p):
+        import horovod_tpu.torch as hvd_torch
+
+        tensor, ctx = self._compression.compress(p.grad)
+        # Accumulated local passes are NOT rescaled by 1/k — matching the
+        # reference: backward_passes_per_step grows the effective batch
+        # (ref: optimizer.py backward_passes_per_step docs).
+        handle = hvd_torch.allreduce_async(
+            tensor, name=f"grad.{self._names[p]}", op=self._op,
+            prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+        )
+        return handle, ctx
+
+    def synchronize(self):
+        """Join all outstanding grad allreduces
+        (ref: optimizer.py:151-200)."""
+        import horovod_tpu.torch as hvd_torch
+
+        if _basics.size() > 1:
+            missing = [
+                p for p in self._names
+                if p.requires_grad and p.grad is not None
+                and p not in self._handles
+            ]
+            for p in missing:
+                self._handles[p] = self._allreduce_grad_async(p)
+            for p, (handle, ctx) in list(self._handles.items()):
+                out = hvd_torch.synchronize(handle)
+                p.grad.copy_(
+                    self._compression.decompress(out, ctx).reshape(
+                        p.grad.shape
+                    )
+                )
+        self._handles.clear()
+        self._synchronized = True
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def skip_synchronize(self):
+        """For manual synchronize() + grad clipping before step()
+        (ref: optimizer.py skip_synchronize)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        self._passes += 1
+        boundary = self._passes % self.backward_passes_per_step == 0
+        if boundary and self._should_synchronize and not self._synchronized:
+            self.synchronize()
+        self._synchronized = False
+        if not boundary:
+            return None
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        if self._passes % self.backward_passes_per_step != 0:
+            # Keep accumulating locally between boundaries.
+            return None
+        return self._opt.zero_grad(*a, **kw)
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._opt.load_state_dict(sd)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = ReduceOp.AVERAGE,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0):
+    """(ref: horovod/torch/optimizer.py:337-414)"""
+    return _DistributedOptimizer(
+        optimizer, named_parameters, compression, backward_passes_per_step,
+        op, prescale_factor, postscale_factor,
+    )
