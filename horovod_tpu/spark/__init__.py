@@ -1,0 +1,6 @@
+"""Spark integration (ref: horovod/spark/ — run()/run_elastic() +
+Estimator API). pyspark is optional: `run(..., spark_context=...)`
+accepts any object with the small RDD surface used, and JaxEstimator
+fits pandas DataFrames locally."""
+from .estimator import JaxEstimator, JaxModel
+from .runner import run, run_elastic

@@ -1,0 +1,125 @@
+"""Estimator API over spark.run (ref: horovod/spark/keras/estimator.py,
+horovod/spark/torch/estimator.py — fit framework models on DataFrames).
+
+`JaxEstimator.fit(df)` trains a flax model data-parallel across Spark
+tasks: the DataFrame's feature/label columns are collected per
+partition, each task trains on its shard with grads allreduced through
+the engine, and rank 0's params come back in a `JaxModel` transformer.
+Works with pandas DataFrames directly for local use.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class JaxModel:
+    """Fitted-model transformer (ref: spark estimators' Model)."""
+
+    def __init__(self, model, params, feature_cols, label_col, output_col):
+        self.model = model
+        self.params = params
+        self.feature_cols = feature_cols
+        self.label_col = label_col
+        self.output_col = output_col
+
+    def transform(self, df):
+        import pandas as pd
+
+        pdf = df.toPandas() if hasattr(df, "toPandas") else df
+        x = np.stack([pdf[c].to_numpy() for c in self.feature_cols], axis=-1)
+        out = np.asarray(self.model.apply(self.params, x))
+        res = pdf.copy()
+        res[self.output_col] = list(out)
+        return res
+
+
+class JaxEstimator:
+    """(ref: estimator params subset — model, optimizer, loss, epochs,
+    batch_size, feature/label cols.)"""
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss: Callable,
+        feature_cols: Sequence[str],
+        label_col: str,
+        output_col: str = "prediction",
+        num_proc: Optional[int] = None,
+        epochs: int = 1,
+        batch_size: int = 32,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.output_col = output_col
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+
+    # ------------------------------------------------------------------
+    def _collect(self, df):
+        pdf = df.toPandas() if hasattr(df, "toPandas") else df
+        x = np.stack(
+            [pdf[c].to_numpy() for c in self.feature_cols], axis=-1
+        ).astype(np.float32)
+        y = pdf[self.label_col].to_numpy()
+        return x, y
+
+    def fit(self, df) -> JaxModel:
+        x, y = self._collect(df)
+        est = self
+
+        def train():
+            import jax
+            import optax
+
+            import horovod_tpu as hvd
+
+            hvd.init()
+            xs = x[hvd.rank()::hvd.size()]
+            ys = y[hvd.rank()::hvd.size()]
+            params = est.model.init(
+                jax.random.PRNGKey(est.seed), xs[: est.batch_size]
+            )
+            params = hvd.broadcast_parameters(params, root_rank=0)
+            tx = hvd.DistributedOptimizer(est.optimizer)
+            opt_state = tx.init(params)
+
+            grad_fn = jax.jit(jax.value_and_grad(
+                lambda p, bx, by: est.loss(est.model.apply(p, bx), by)
+            ))
+            steps = max(len(xs) // est.batch_size, 1)
+            for epoch in range(est.epochs):
+                perm = np.random.RandomState(epoch).permutation(len(xs))
+                for i in range(steps):
+                    idx = perm[i * est.batch_size:(i + 1) * est.batch_size]
+                    if len(idx) == 0:
+                        break
+                    _, grads = grad_fn(params, xs[idx], ys[idx])
+                    upd, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, upd)
+            return jax.tree.map(np.asarray, params)
+
+        num_proc = self.num_proc or 1
+        if hasattr(df, "rdd") or num_proc > 1:
+            results = self._run_distributed(train, num_proc, df)
+        else:
+            results = [train()]
+        return JaxModel(self.model, results[0], self.feature_cols,
+                        self.label_col, self.output_col)
+
+    def _run_distributed(self, train, num_proc, df):
+        if hasattr(df, "rdd"):
+            from .runner import run as spark_run
+
+            return spark_run(train, num_proc=num_proc)
+        from ..runner import run as local_run
+
+        return local_run(train, np=num_proc)

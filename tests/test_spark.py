@@ -1,0 +1,123 @@
+"""Spark-integration tests with a mock SparkContext (the reference tests
+against a local Spark cluster, test/test_spark.py; here the Spark API
+surface is mocked so the orchestration logic is covered hermetically)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def isolate_env():
+    """_task_fn sets the worker env contract via os.environ — correct in
+    real Spark executors (separate processes), but the threaded mock
+    shares this process, so snapshot/restore around every test."""
+    snap = dict(os.environ)
+    yield
+    for k in set(os.environ) - set(snap):
+        del os.environ[k]
+    os.environ.update(snap)
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+
+
+class FakeRDD:
+    """Runs each 'partition' in a thread — same concurrency shape as
+    barrier-mode Spark tasks on one box."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def barrier(self):
+        return self
+
+    def mapPartitionsWithIndex(self, f):
+        self._f = f
+        return self
+
+    def collect(self):
+        results = [None] * self.n
+        errors = [None] * self.n
+
+        def worker(i):
+            try:
+                results[i] = list(self._f(i, iter([i])))
+            except BaseException as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(self.n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for e in errors:
+            if e is not None:
+                raise e
+        return [r for part in results if part for r in part]
+
+
+class FakeSparkContext:
+    defaultParallelism = 2
+
+    def parallelize(self, data, n):
+        return FakeRDD(n)
+
+
+def test_spark_run_two_tasks(monkeypatch):
+    # Tasks run in-process threads; process-mode env must not leak.
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "1")
+    from horovod_tpu.spark import run
+
+    def fn():
+        import os
+
+        # Inside the task, the env contract must be set.
+        rank = int(os.environ["HOROVOD_RANK"])
+        size = int(os.environ["HOROVOD_SIZE"])
+        assert size == 2
+        return rank * 100
+
+    out = run(fn, num_proc=2, spark_context=FakeSparkContext())
+    assert out == [0, 100]
+
+
+def test_spark_run_requires_context():
+    from horovod_tpu.spark import run
+
+    with pytest.raises((ImportError, ValueError)):
+        run(lambda: 1, num_proc=1)
+
+
+def test_jax_estimator_local_pandas():
+    pd = pytest.importorskip("pandas")
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.spark import JaxEstimator
+
+    class Reg(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)[..., 0]
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 3).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5], np.float32)
+    df = pd.DataFrame({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": X @ w,
+    })
+    est = JaxEstimator(
+        Reg(), optax.adam(0.05),
+        loss=lambda pred, y: jnp.mean((pred - y) ** 2),
+        feature_cols=["a", "b", "c"], label_col="y",
+        epochs=200, batch_size=64,
+    )
+    model = est.fit(df)
+    out = model.transform(df)
+    err = float(np.mean((np.asarray(list(out["prediction"])) -
+                         df["y"].to_numpy()) ** 2))
+    assert err < 0.05, err
