@@ -73,16 +73,35 @@ def _init_mesh_mode(devices=None, axis_name: str = "hvd"):
     _state.cross_size = jax.process_count()
 
 
-def _init_process_mode():
+def _init_process_mode(ranks: Optional[Sequence[int]] = None):
     from ..engine.engine import Engine
 
     _state.mode = "process"
-    _state.rank = env_cfg.get_int(env_cfg.RANK, 0)
+    world_rank = env_cfg.get_int(env_cfg.RANK, 0)
+    _state.rank = world_rank
     _state.size = env_cfg.get_int(env_cfg.SIZE, 1)
     _state.local_rank = env_cfg.get_int(env_cfg.LOCAL_RANK, 0)
     _state.local_size = env_cfg.get_int(env_cfg.LOCAL_SIZE, 1)
     _state.cross_rank = env_cfg.get_int(env_cfg.CROSS_RANK, 0)
     _state.cross_size = env_cfg.get_int(env_cfg.CROSS_SIZE, 1)
+    scope = None
+    if ranks is not None:
+        # Subset communicator (ref: basics.py:33-65 — init(comm) with a
+        # sub-communicator; only member processes may call init). Ranks
+        # are renumbered 0..len-1 in the given order and the subset
+        # rendezvouses under its own mesh scope so it never collides
+        # with the world mesh or other subsets.
+        ranks = [int(r) for r in ranks]
+        if world_rank not in ranks:
+            raise ValueError(
+                f"process {world_rank} is not a member of the "
+                f"communicator ranks={ranks}; only members may init"
+            )
+        _state.ranks = ranks
+        _state.rank = ranks.index(world_rank)
+        _state.size = len(ranks)
+        base = env_cfg.get_str(env_cfg.MESH_SCOPE, "hvd_mesh")
+        scope = f"{base}_ps_{'_'.join(map(str, ranks))}"
     _state.engine = Engine(
         rank=_state.rank,
         size=_state.size,
@@ -90,6 +109,7 @@ def _init_process_mode():
         local_size=_state.local_size,
         cross_rank=_state.cross_rank,
         cross_size=_state.cross_size,
+        scope=scope,
     )
     _state.engine.start()
 
@@ -108,16 +128,13 @@ def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "
         if mode is None:
             mode = "process" if os.environ.get(env_cfg.RANK) is not None else "mesh"
         if mode == "process":
-            if ranks is not None:
-                # Subset communicators (process sets) are not wired into
-                # the engine yet; fail loudly rather than silently
-                # spanning the full world (ref: basics.py:33-65).
-                raise NotImplementedError(
-                    "init(ranks=...) subset communicators are not yet "
-                    "supported in process mode"
-                )
-            _init_process_mode()
+            _init_process_mode(ranks)
         else:
+            if ranks is not None and devices is None:
+                import jax
+
+                all_devices = jax.devices()
+                devices = [all_devices[r] for r in ranks]
             _init_mesh_mode(devices, axis_name)
         _state.initialized = True
         logger.debug(

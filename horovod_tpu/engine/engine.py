@@ -97,7 +97,11 @@ class Engine:
         cross_rank: int = 0,
         cross_size: int = 1,
         backend=None,
+        scope: Optional[str] = None,
     ):
+        # Rendezvous scope for the TCP mesh (subset communicators use a
+        # ranks-derived scope; None = env / default world scope).
+        self._scope = scope
         self.rank = rank
         self.size = size
         self.local_rank = local_rank
@@ -142,7 +146,8 @@ class Engine:
             else:
                 from ..backend.tcp import TcpBackend
 
-                self.backend = TcpBackend(self.rank, self.size)
+                self.backend = TcpBackend(self.rank, self.size,
+                                          scope=self._scope)
             self.controller = Controller(self.backend, self.size, self.rank)
             from .parameter_manager import ParameterManager
 

@@ -1,0 +1,65 @@
+"""Subset-communicator (process set) tests
+(ref: horovod/common/basics.py:33-65 init with sub-communicator)."""
+import numpy as np
+import pytest
+
+from horovod_tpu.runner import run
+
+ENV = {"HOROVOD_CYCLE_TIME": "1", "JAX_PLATFORMS": "cpu"}
+
+
+def test_subset_communicator_process_mode():
+    """3 workers; ranks 0 and 2 form a communicator of size 2 and
+    allreduce within it; rank 1 stays out."""
+
+    def fn():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        world_rank = int(os.environ["HOROVOD_RANK"])
+        if world_rank == 1:
+            return "outside"
+        hvd.init(ranks=[0, 2])
+        assert hvd.size() == 2
+        assert hvd.rank() == (0 if world_rank == 0 else 1)
+        out = hvd.allreduce(np.ones(3, np.float32) * (world_rank + 1),
+                            average=False)
+        # contributions: world ranks 0 (=1.0) and 2 (=3.0) -> 4.0
+        return out.tolist()
+
+    out = run(fn, np=3, extra_env=ENV)
+    assert out[1] == "outside"
+    assert out[0] == out[2] == [4.0, 4.0, 4.0]
+
+
+def test_non_member_init_rejected():
+    def fn():
+        import os
+
+        import horovod_tpu as hvd
+
+        world_rank = int(os.environ["HOROVOD_RANK"])
+        if world_rank == 0:
+            try:
+                hvd.init(ranks=[1])
+                return "no-error"
+            except ValueError as e:
+                return "rejected"
+        hvd.init(ranks=[1])
+        assert hvd.size() == 1 and hvd.rank() == 0
+        return "member"
+
+    out = run(fn, np=2, extra_env=ENV)
+    assert out == ["rejected", "member"]
+
+
+def test_subset_mesh_mode(hvd_mesh):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(ranks=[0, 1, 2, 3])
+    assert hvd.size() == 4
+    hvd.shutdown()

@@ -1,0 +1,35 @@
+"""Timeline tests (ref: test/test_timeline.py — validate Chrome-trace
+JSON is produced with negotiation + op phases)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_engine import run_ranks
+
+
+def test_timeline_writes_valid_chrome_trace(tmp_path, monkeypatch):
+    path = tmp_path / "timeline.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    monkeypatch.setenv("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+
+    def fn(eng, rank):
+        for i in range(3):
+            eng.synchronize(
+                eng.enqueue_allreduce(np.ones(4, np.float32), name="t"),
+                timeout=30)
+        return True
+
+    run_ranks(2, fn)
+    assert path.exists()
+    events = json.loads(path.read_text())
+    assert isinstance(events, list) and events
+    names = {e.get("name") for e in events}
+    assert "ALLREDUCE" in names          # op phase
+    assert any(n and n.startswith("NEGOTIATE") for n in names if n)
+    assert "CYCLE" in names              # mark-cycles enabled
+    for e in events:
+        assert e["ph"] in ("B", "E", "i")
+        assert "ts" in e and "tid" in e
