@@ -1,0 +1,70 @@
+"""Keras model.fit MNIST with horovod_tpu.keras
+(ref: examples/tensorflow2_keras_mnist.py — DistributedOptimizer +
+broadcast/metric-average/LR-warmup callbacks + rank-sharded data).
+
+Run:
+    hvdrun -np 2 python examples/tensorflow2_keras_mnist.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from jax_mnist import load_mnist, synthetic_mnist  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.001)
+    args = p.parse_args()
+
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import keras
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+
+    x, y = (load_mnist(args.data_dir) if args.data_dir
+            else synthetic_mnist())
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    model = keras.Sequential([
+        keras.Input((28, 28)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # Scale LR by size; warmup eases the large effective batch in
+    # (ref: tensorflow2_keras_mnist.py scaled_lr + warmup callback).
+    scaled_lr = args.lr * hvd.size()
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(scaled_lr))
+    model.compile(
+        optimizer=opt,
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        run_eagerly=True,  # collectives are eager ops in this binding
+    )
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=args.lr, warmup_epochs=1, verbose=hvd.rank() == 0),
+    ]
+    verbose = 1 if hvd.rank() == 0 else 0
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks, verbose=verbose)
+
+    if hvd.rank() == 0:
+        _, acc = model.evaluate(x[:1024], y[:1024], verbose=0)
+        print(f"train accuracy (first 1024): {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

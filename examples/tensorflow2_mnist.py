@@ -1,0 +1,98 @@
+"""TF2 MNIST with horovod_tpu.tensorflow — the reference's first-run
+TF2 example ported to this framework
+(ref: examples/tensorflow2_mnist.py: DistributedGradientTape + variable
+broadcast on first batch + rank-sharded data + lr scaling).
+
+Run:
+    python examples/tensorflow2_mnist.py               # single process
+    hvdrun -np 2 python examples/tensorflow2_mnist.py  # 2 ranks
+
+Uses a synthetic MNIST-shaped dataset by default (no network egress);
+pass --data-dir with the standard IDX files for real MNIST.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from jax_mnist import load_mnist, synthetic_mnist  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.001)
+    args = p.parse_args()
+
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import numpy as np
+    import tensorflow as tf
+    import keras
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+
+    x, y = (load_mnist(args.data_dir) if args.data_dir
+            else synthetic_mnist())
+    # Shard the dataset across ranks (ref: tensorflow2_mnist.py shard).
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+    dataset = (
+        tf.data.Dataset.from_tensor_slices(
+            (x[..., None].astype("float32"), y.astype("int64"))
+        )
+        .shuffle(4096, seed=hvd.rank())
+        .batch(args.batch_size)
+    )
+
+    model = keras.Sequential([
+        keras.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    loss_obj = keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    # Scale LR by world size (ref: tensorflow2_mnist.py `0.001 * hvd.size()`).
+    opt = keras.optimizers.Adam(args.lr * hvd.size())
+
+    def training_step(images, labels, first_batch):
+        with tf.GradientTape() as tape:
+            logits = model(images, training=True)
+            loss_value = loss_obj(labels, logits)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss_value, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first_batch:
+            # Broadcast initial state once variables exist
+            # (ref: tensorflow2_mnist.py first_batch broadcast note).
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            opt_vars = opt.variables
+            hvd.broadcast_variables(
+                list(opt_vars() if callable(opt_vars) else opt_vars),
+                root_rank=0,
+            )
+        return loss_value
+
+    step = 0
+    for epoch in range(args.epochs):
+        for images, labels in dataset:
+            loss_value = training_step(images, labels, step == 0)
+            step += 1
+            if step % 50 == 0 and hvd.rank() == 0:
+                print(f"step {step}: loss={float(loss_value):.4f}")
+
+    if hvd.rank() == 0:
+        logits = model(x[:1024, ..., None].astype("float32"))
+        acc = float(np.mean(np.argmax(logits.numpy(), -1) == y[:1024]))
+        print(f"train accuracy (first 1024): {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,10 @@ class _State:
 
 _state = _State()
 
+# Incarnation counters for subset communicators, keyed by the member-rank
+# tuple. Survives shutdown() so re-inits scope fresh rendezvous keys.
+_subset_generations: dict = {}
+
 
 def _init_mesh_mode(devices=None, axis_name: str = "hvd"):
     import jax
@@ -101,7 +105,18 @@ def _init_process_mode(ranks: Optional[Sequence[int]] = None):
         _state.rank = ranks.index(world_rank)
         _state.size = len(ranks)
         base = env_cfg.get_str(env_cfg.MESH_SCOPE, "hvd_mesh")
-        scope = f"{base}_ps_{'_'.join(map(str, ranks))}"
+        # Scope includes an incarnation counter: members re-init subsets
+        # in lockstep, so shutdown+init of the same ranks gets fresh KV
+        # keys instead of reading a peer's stale host:port from the
+        # previous incarnation (the elastic path epoch-scopes MESH_SCOPE
+        # for the same reason). Caveat: the counter is per-process, so a
+        # freshly respawned member (gen 0) cannot rejoin survivors at
+        # gen>0 — recovery across process death must go through the
+        # elastic driver, whose epoch-scoped MESH_SCOPE resets every
+        # member's world AND subset scopes together.
+        gen = _subset_generations.get(tuple(ranks), 0)
+        _subset_generations[tuple(ranks)] = gen + 1
+        scope = f"{base}_ps_{'_'.join(map(str, ranks))}_g{gen}"
     _state.engine = Engine(
         rank=_state.rank,
         size=_state.size,

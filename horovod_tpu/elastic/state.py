@@ -61,7 +61,12 @@ class State:
         for ts, update in self._host_messages:
             if ts > last:
                 last = ts
-                res = update
+            # OR-accumulate across every queued message (ref:
+            # common/elastic.py `all_update |= update`): an ADDED
+            # followed by a REMOVED in one window must yield MIXED so
+            # sync is not skipped while a new worker waits in sync().
+            if ts > prev:
+                res |= update
         self._host_messages.clear()
         prev, last, res = broadcast_object(
             (prev, last, res), root_rank=0, name="host_update_ts"

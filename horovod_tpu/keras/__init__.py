@@ -1,0 +1,177 @@
+"""`horovod_tpu.keras` — drop-in surface of `horovod.keras`
+(ref: horovod/keras/__init__.py, horovod/_keras/__init__.py).
+
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(optimizer=opt, ...)
+    callbacks = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]
+
+Targets Keras 3 (the version shipping with TF 2.16+): the wrapper
+subclasses the inner optimizer's class and intercepts
+`apply_gradients`/`apply`, the single funnel Keras 3 routes all updates
+through — the same interception point as the reference's dynamic
+subclass overriding get_gradients/_aggregate_gradients
+(ref: horovod/_keras/__init__.py:27-143).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+)
+from ..common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
+from ..tensorflow import (  # noqa: F401
+    allgather,
+    allgather_object,
+    allreduce,
+    broadcast,
+    broadcast_object,
+    broadcast_variables,
+    join,
+    barrier,
+)
+from ..tensorflow.compression import Compression  # noqa: F401
+from . import callbacks  # noqa: F401
+from .elastic import KerasState  # noqa: F401
+
+
+def DistributedOptimizer(
+    optimizer,
+    name: Optional[str] = None,
+    device_dense: str = "",
+    device_sparse: str = "",
+    compression=None,
+    sparse_as_dense: bool = False,
+    gradient_predivide_factor: float = 1.0,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    backward_passes_per_step: int = 1,
+):
+    """Wrap a Keras optimizer so gradients are allreduced across ranks
+    before being applied (ref: horovod/keras/__init__.py:34-82,
+    horovod/_keras/__init__.py:27-143).
+
+    `backward_passes_per_step > 1` accumulates locally and communicates
+    + applies on every k-th call, the reference's local-gradient-
+    aggregation semantics (ref: _keras/__init__.py:62-116).
+    """
+    from ..tensorflow import _make_allreduce_grads_fn
+
+    cls = type(optimizer)
+    allreduce_grads = _make_allreduce_grads_fn(
+        name or f"Distributed{cls.__name__}", device_dense, device_sparse,
+        compression or Compression.none, sparse_as_dense, op,
+        gradient_predivide_factor,
+    )
+    k = int(backward_passes_per_step)
+
+    class _DistributedOptimizer(cls):
+        _hvd_wrapped = True
+
+        def __init__(self):
+            # Adopt the wrapped instance's state wholesale: Keras 3
+            # optimizers are plain python objects with tracked
+            # variables; re-pointing __dict__ makes this instance an
+            # alias of the original with overridden apply methods.
+            object.__setattr__(self, "__dict__", optimizer.__dict__)
+            object.__setattr__(self, "_hvd_acc", None)
+            object.__setattr__(self, "_hvd_count", 0)
+
+        # Keras 3 funnels model.fit / apply_gradients through apply().
+        def apply(self, grads, trainable_variables=None):
+            import tensorflow as tf
+
+            grads = list(grads)
+            if k <= 1:
+                reduced = allreduce_grads(grads)
+                return cls.apply(self, reduced, trainable_variables)
+
+            # Local accumulation (eager path; the reference's
+            # LocalGradientAggregationHelper equivalent).
+            if self._hvd_acc is None:
+                self._hvd_acc = [
+                    tf.Variable(tf.zeros_like(g), trainable=False)
+                    for g in grads
+                ]
+            for acc, g in zip(self._hvd_acc, grads):
+                acc.assign_add(g)
+            self._hvd_count += 1
+            if self._hvd_count % k:
+                return None
+            reduced = allreduce_grads([a.value() for a in self._hvd_acc])
+            reduced = [r / float(k) for r in reduced]
+            for a in self._hvd_acc:
+                a.assign(tf.zeros_like(a))
+            return cls.apply(self, reduced, trainable_variables)
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            grads, tvars = zip(*list(grads_and_vars))
+            return self.apply(list(grads), list(tvars))
+
+    _DistributedOptimizer.__name__ = f"Distributed{cls.__name__}"
+    return _DistributedOptimizer()
+
+
+def broadcast_global_variables(model_or_variables, root_rank: int = 0):
+    """Broadcast a model's (or variable list's) values from root
+    (ref: horovod/keras/__init__.py:84-93)."""
+    variables = getattr(model_or_variables, "variables",
+                        model_or_variables)
+    broadcast_variables(variables, root_rank=root_rank)
+
+
+def _wrapped_optimizer_loader(base_cls, compression):
+    """Deserialization shim: models saved with a Distributed<X> optimizer
+    reference a class that only ever existed dynamically; this recreates
+    base_cls from config and re-wraps it
+    (ref: horovod/keras/__init__.py:137-152 horovod_objects)."""
+
+    class _Loader:
+        @classmethod
+        def from_config(cls, config, custom_objects=None):
+            return DistributedOptimizer(
+                base_cls.from_config(config), compression=compression
+            )
+
+    _Loader.__name__ = f"Distributed{base_cls.__name__}"
+    return _Loader
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Load a Keras model, wrapping its optimizer in DistributedOptimizer
+    (ref: horovod/keras/__init__.py:127-158)."""
+    import keras
+
+    cobj = dict(custom_objects or {})
+    base_classes = list(custom_optimizers or [])
+    for name in dir(keras.optimizers):
+        c = getattr(keras.optimizers, name)
+        if isinstance(c, type) and issubclass(c, keras.optimizers.Optimizer):
+            base_classes.append(c)
+    for c in base_classes:
+        cobj.setdefault(
+            f"Distributed{c.__name__}",
+            _wrapped_optimizer_loader(c, compression),
+        )
+
+    model = keras.models.load_model(filepath, custom_objects=cobj)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(opt, "_hvd_wrapped", False):
+        # The wrapper aliases the loaded optimizer's state (shared
+        # __dict__), so swapping the attribute in place keeps the
+        # compiled loss/metrics intact — no recompile needed.
+        model.optimizer = DistributedOptimizer(opt, compression=compression)
+    return model

@@ -1,0 +1,138 @@
+"""Keras callbacks (ref: horovod/_keras/callbacks.py:22-192,
+horovod/callbacks surface horovod/keras/callbacks.py).
+
+Real `keras.callbacks.Callback` subclasses, usable directly in
+`model.fit(callbacks=[...])`. The JAX-loop ports of the same callbacks
+live in `horovod_tpu.callbacks` for users running custom JAX loops.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+import numpy as np
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast model + optimizer state from root at train begin
+    (ref: _keras/callbacks.py:22-46)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        # End of the first batch, not the beginning: lazily-built models
+        # have no variables until the first forward pass has run
+        # (ref: _keras/callbacks.py broadcasts on_batch_end for this).
+        if self.broadcast_done:
+            return
+        from ..tensorflow import broadcast_variables
+
+        broadcast_variables(self.model.variables, root_rank=self.root_rank)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None:
+            v = getattr(opt, "variables", [])
+            opt_vars = list(v() if callable(v) else v)
+            if opt_vars:
+                broadcast_variables(opt_vars, root_rank=self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks before other callbacks (e.g.
+    checkpointers) read them (ref: _keras/callbacks.py:48-88)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None:
+            return
+        from ..tensorflow import allreduce
+        from ..common.types import ReduceOp
+
+        for k in sorted(logs.keys()):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                logs[k] = float(np.asarray(allreduce(
+                    np.asarray(v, np.float64), op=ReduceOp.AVERAGE,
+                    name=f"metric.{epoch}.{k}",
+                )))
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Schedule LR as multiplier(epoch) × initial
+    (ref: _keras/callbacks.py:90-145)."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True, steps_per_epoch=None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _set_lr(self, epoch):
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        if epoch < self.start_epoch:
+            return
+        lr = self.initial_lr * self.multiplier(epoch)
+        opt = self.model.optimizer
+        try:
+            opt.learning_rate.assign(lr)
+        except AttributeError:
+            opt.learning_rate = lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._set_lr(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.steps_per_epoch:
+            self._set_lr(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            try:
+                logs["lr"] = float(
+                    np.asarray(self.model.optimizer.learning_rate)
+                )
+            except Exception:
+                pass
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from initial_lr to initial_lr×size over
+    warmup_epochs (ref: _keras/callbacks.py:147-192)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        from ..common.basics import size
+
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        n = size()
+
+        def multiplier(epoch):
+            if epoch >= warmup_epochs:
+                return n
+            return 1.0 + (n - 1.0) * epoch / max(warmup_epochs, 1)
+
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=None, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if self.verbose and epoch == self.warmup_epochs:
+            print(f"Epoch {epoch}: finished gradual learning rate warmup.")

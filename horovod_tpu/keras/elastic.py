@@ -1,0 +1,47 @@
+"""Elastic Keras state + callbacks (ref: horovod/keras/elastic.py:20-83,
+horovod/_keras/elastic.py:24-59)."""
+import keras
+
+from ..tensorflow.elastic import TensorFlowKerasState as KerasState  # noqa: F401
+
+
+class CommitStateCallback(keras.callbacks.Callback):
+    """Commit elastic state every `batches_per_commit` batches
+    (ref: horovod/_keras/elastic.py:24-40)."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+        self._counter = 0
+
+    def on_batch_end(self, batch, logs=None):
+        self._counter += 1
+        if self._counter % self.batches_per_commit == 0:
+            self.state.commit()
+
+
+class UpdateBatchStateCallback(keras.callbacks.Callback):
+    """Track batch progress in elastic state
+    (ref: horovod/_keras/elastic.py:43-59)."""
+
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallback(keras.callbacks.Callback):
+    """Track epoch progress in elastic state."""
+
+    def __init__(self, state):
+        super().__init__()
+        self.state = state
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.epoch = epoch

@@ -20,12 +20,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import shard_map
 
 
-def _pvary(x, axis):
-    """Mark x as varying over `axis` for shard_map's VMA tracking."""
-    try:
-        return jax.lax.pcast(x, to="varying", axes=axis)
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(x, axis)
+from ..utils.compat import pvary as _pvary
 
 
 def gpipe(

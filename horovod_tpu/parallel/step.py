@@ -83,11 +83,33 @@ def wrap_step(
                 for i in range(len(args))
             )
             out_spec = P() if out_replicated else P(an)
+
+            # Mark replicated inputs as axis-varying inside the body.
+            # Without this, jax's manual-axes tracking auto-psums the
+            # cotangent of any replicated input, so a user's jax.grad
+            # inside the step already returns the cross-rank SUM and a
+            # subsequent hvd.allreduce(AVERAGE) cannot recover the
+            # per-rank average (it sees identical values on every
+            # shard). pvary keeps grads rank-local — the reference's
+            # semantics, where each rank owns its gradient until the
+            # explicit allreduce (ref: horovod/torch/optimizer.py:114-149).
+            def local_fn(*inner):
+                from ..utils.compat import pvary
+
+                inner = tuple(
+                    jax.tree.map(lambda x: pvary(x, an), a)
+                    if i in repl else a
+                    for i, a in enumerate(inner)
+                )
+                return fn(*inner)
+
+            # out_specs is a prefix pytree: one spec covers the whole
+            # output tree (eval_shape-ing fn here would trace its
+            # collectives outside the mesh and hit unbound axis names).
             sm = shard_map(
-                fn, mesh=m,
+                local_fn, mesh=m,
                 in_specs=in_specs,
-                out_specs=jax.tree.map(lambda _: out_spec,
-                                       jax.eval_shape(fn, *args)),
+                out_specs=out_spec,
             )
             if jit:
                 sm = jax.jit(sm, donate_argnums=donate_argnums)

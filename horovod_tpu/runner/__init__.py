@@ -48,10 +48,16 @@ def run(
         command = [sys.executable, "-m", "horovod_tpu.runner.task_runner",
                    func_path]
         env = dict(extra_env or {})
-        env.setdefault("PYTHONPATH", os.pathsep.join(
+        # Prepend the repo root but keep the parent's PYTHONPATH — user
+        # functions may need it to unpickle/import on workers (spawn_worker
+        # overlays this env on os.environ, so dropping it here loses it).
+        inherited = os.environ.get("PYTHONPATH")
+        parts = (
             [os.path.dirname(os.path.dirname(os.path.dirname(__file__)))]
             + sys.path[1:2]
-        ))
+            + ([inherited] if inherited else [])
+        )
+        env.setdefault("PYTHONPATH", os.pathsep.join(parts))
         rc = launch_static(slots, command, env, verbose, rendezvous=server,
                            prefix_output=not verbose)
         if rc != 0:

@@ -170,8 +170,33 @@ def run(
 
 
 def run_elastic(fn, args=(), kwargs=None, num_proc=None,
-                min_np=None, max_np=None, **_):
+                min_np=None, max_np=None, **extra):
     """Elastic variant (ref: spark/runner.py:303). Spark's task-retry
     model supplies the respawn; state handling uses hvd.elastic in the
-    task fn. Currently delegates to run() with Spark-level retries."""
-    return run(fn, args=args, kwargs=kwargs, num_proc=num_proc)
+    task fn. Currently delegates to run() with Spark-level retries —
+    there is no mid-job rescale, so a min_np/max_np window is not
+    honored and we say so rather than silently dropping it."""
+    import inspect
+    import warnings
+
+    if (min_np is not None and min_np != num_proc) or (
+        max_np is not None and max_np != num_proc
+    ):
+        warnings.warn(
+            "horovod_tpu.spark.run_elastic runs at a fixed num_proc via "
+            "Spark task retries; min_np/max_np rescaling is not "
+            "supported and will be ignored",
+            stacklevel=2,
+        )
+    # Forward everything run() itself accepts (spark_context, env, ...);
+    # warn only about genuinely unsupported arguments.
+    accepted = set(inspect.signature(run).parameters)
+    passthrough = {k: v for k, v in extra.items() if k in accepted}
+    unknown = sorted(set(extra) - accepted)
+    if unknown:
+        warnings.warn(
+            f"run_elastic ignoring unsupported arguments: {unknown}",
+            stacklevel=2,
+        )
+    return run(fn, args=args, kwargs=kwargs, num_proc=num_proc,
+               **passthrough)

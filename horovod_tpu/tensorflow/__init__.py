@@ -1,0 +1,500 @@
+"""`horovod_tpu.tensorflow` — drop-in surface of `horovod.tensorflow`
+(ref: horovod/tensorflow/__init__.py, horovod/tensorflow/mpi_ops.py).
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    tape = hvd.DistributedGradientTape(tape)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+
+Tensors ride the same asynchronous name-negotiated engine as the JAX
+eager path and the torch adapter (numpy bridge). Ops are graph-safe:
+under `tf.function` they trace through `tf.py_function`, with custom
+gradients mirroring the reference's registered grads
+(ref: horovod/tensorflow/mpi_ops.py:139-220). On TPU hardware the JAX
+path is the performance surface — this adapter exists for capability
+parity and CPU-cluster jobs, like the torch one.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..common.basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    gloo_built,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+)
+from ..common import basics as _basics
+from ..common.exceptions import HorovodInternalError
+from ..common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_variables,
+)
+from .sync_batch_norm import SyncBatchNormalization  # noqa: F401
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def _engine():
+    eng = _basics.engine()
+    if eng is None:
+        raise HorovodInternalError(
+            "horovod_tpu.tensorflow collectives need process mode "
+            "(hvdrun) or size()==1"
+        )
+    return eng
+
+
+def _engine_allreduce(arr, nm, rop, prescale, postscale):
+    eng = _engine()
+    return eng.synchronize(eng.enqueue_allreduce(
+        arr, name=nm, op=rop, prescale=prescale, postscale=postscale))
+
+
+def _engine_allgather(arr, nm):
+    eng = _engine()
+    return eng.synchronize(eng.enqueue_allgather(arr, name=nm))
+
+
+def _engine_broadcast(arr, root_rank, nm):
+    eng = _engine()
+    return eng.synchronize(eng.enqueue_broadcast(arr, root_rank, name=nm))
+
+
+def _engine_alltoall(arr, splits, nm):
+    eng = _engine()
+    return eng.synchronize(eng.enqueue_alltoall(arr, splits, name=nm))
+
+
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    if op is not None and average is not None:
+        raise ValueError("specify op= or the legacy average=, not both")
+    if op is None:
+        return ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    return op
+
+
+def _eager_or_py_function(numpy_fn, tensor, out_dtype, out_shape, name):
+    """Run `numpy_fn` on the tensor's value: directly when eager,
+    through tf.py_function when tracing (the reference's AsyncOpKernel
+    registration point, ref: tensorflow/mpi_ops.cc:371-416)."""
+    tf = _tf()
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(numpy_fn(tensor.numpy()), dtype=out_dtype)
+    out = tf.py_function(
+        lambda t: tf.convert_to_tensor(numpy_fn(t.numpy()), dtype=out_dtype),
+        inp=[tensor],
+        Tout=out_dtype,
+        name=name,
+    )
+    out.set_shape(out_shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Collectives (ref: horovod/tensorflow/__init__.py:52-201 allreduce;
+# mpi_ops.py _allreduce/allgather/broadcast/alltoall)
+
+
+def allreduce(
+    tensor,
+    average=None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=None,
+):
+    """All-reduce a tf.Tensor/Variable across ranks. Sparse
+    tf.IndexedSlices take the allgather path like the reference
+    (ref: horovod/tensorflow/__init__.py:76-106)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        # Average of gathered slices (ref: __init__.py:84-101).
+        rop = _resolve_op(op, average)
+        if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise NotImplementedError(
+                "IndexedSlices allreduce supports SUM/AVERAGE only"
+            )
+        values = allgather(tensor.values, name=f"{name or 'ar'}.values")
+        indices = allgather(tensor.indices, name=f"{name or 'ar'}.indices")
+        if rop == ReduceOp.AVERAGE:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    rop = _resolve_op(op, average)
+    comp = compression or Compression.none
+    tensor = tf.convert_to_tensor(tensor)
+    compressed, ctx = comp.compress(tensor)
+
+    if _basics.size() == 1:
+        out = compressed
+        if rop == ReduceOp.SUM:
+            out = out * 1  # sum over one rank is identity
+        out = out * prescale_factor * postscale_factor
+        return comp.decompress(out, ctx)
+
+    nm = name or f"HorovodAllreduce_{_auto_name(tensor)}"
+
+    def run(arr):
+        return _engine_allreduce(arr, nm, rop, prescale_factor,
+                                 postscale_factor)
+
+    @tf.custom_gradient
+    def op_with_grad(x):
+        y = _eager_or_py_function(run, x, x.dtype, x.shape, "HorovodAllreduce")
+
+        def grad(dy):
+            # Gradient of allreduce is allreduce with the same op
+            # (ref: mpi_ops.py:139-152).
+            return allreduce(dy, op=rop, name=f"{nm}.grad")
+
+        return y, grad
+
+    return comp.decompress(op_with_grad(compressed), ctx)
+
+
+_name_counter = [0]
+
+
+def _auto_name(tensor) -> str:
+    tname = getattr(tensor, "name", None)
+    if tname and not _tf().executing_eagerly():
+        return tname.replace(":", "_").replace("/", "_")
+    _name_counter[0] += 1
+    return f"t{_name_counter[0]}"
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      compression=None):
+    """(ref: tensorflow/mpi_ops.py grouped_allreduce) — the engine fuses
+    same-cycle requests, so issuing all then gathering preserves the
+    fused wire behavior."""
+    rop = _resolve_op(op, average)
+    base = name or "HorovodGrouped"
+    return [
+        allreduce(t, None, f"{base}.{i}", rop, prescale_factor,
+                  postscale_factor, compression)
+        for i, t in enumerate(tensors)
+    ]
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate across ranks on dim 0; first dims may differ
+    (ref: mpi_ops.py allgather, collective_operations.h:206-256)."""
+    tf = _tf()
+    tensor = tf.convert_to_tensor(tensor)
+    if _basics.size() == 1:
+        return tf.identity(tensor)
+    nm = name or f"HorovodAllgather_{_auto_name(tensor)}"
+
+    def run(arr):
+        return _engine_allgather(arr, nm)
+
+    @tf.custom_gradient
+    def op_with_grad(x):
+        out_shape = tf.TensorShape([None] + list(x.shape)[1:])
+        y = _eager_or_py_function(run, x, x.dtype, out_shape,
+                                  "HorovodAllgather")
+
+        def grad(dy):
+            # Sum the grad across ranks, then take this rank's slice
+            # (ref: mpi_ops.py:154-186).
+            summed = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad")
+            sizes = allgather(
+                tf.convert_to_tensor([tf.shape(x)[0]]), name=f"{nm}.gsizes"
+            )
+            offset = tf.reduce_sum(sizes[: rank()])
+            return summed[offset : offset + tf.shape(x)[0]]
+
+        return y, grad
+
+    return op_with_grad(tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    tf = _tf()
+    tensor = tf.convert_to_tensor(tensor)
+    if _basics.size() == 1:
+        return tf.identity(tensor)
+    nm = name or f"HorovodBroadcast_{_auto_name(tensor)}"
+
+    def run(arr):
+        return _engine_broadcast(arr, root_rank, nm)
+
+    @tf.custom_gradient
+    def op_with_grad(x):
+        y = _eager_or_py_function(run, x, x.dtype, x.shape,
+                                  "HorovodBroadcast")
+
+        def grad(dy):
+            # Reduce grads to the root; zero elsewhere
+            # (ref: mpi_ops.py:188-200).
+            summed = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad")
+            if rank() == root_rank:
+                return summed
+            return tf.zeros_like(summed)
+
+        return y, grad
+
+    return op_with_grad(tensor)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """(ref: mpi_ops.py alltoall) Returns (output, received_splits)."""
+    tf = _tf()
+    tensor = tf.convert_to_tensor(tensor)
+    if _basics.size() == 1:
+        s = splits if splits is not None else [int(tensor.shape[0])]
+        return tf.identity(tensor), tf.convert_to_tensor(list(s))
+    nm = name or f"HorovodAlltoall_{_auto_name(tensor)}"
+    # splits may be a python list, an eager tensor, or (inside the
+    # traced backward) a symbolic tensor; symbolic splits resolve inside
+    # the py_function where execution is eager. -1 marks "even split".
+    if splits is None:
+        splits_t = tf.fill([_basics.size()], -1)
+    else:
+        splits_t = tf.cast(tf.convert_to_tensor(splits), tf.int32)
+
+    def _run_np(arr, split_arr):
+        sl = [int(s) for s in split_arr.tolist()]
+        if sl and sl[0] < 0:
+            sl = None
+        return _engine_alltoall(arr, sl, nm)
+
+    @tf.custom_gradient
+    def op_with_grad(x, s):
+        if tf.executing_eagerly():
+            out, recv = _run_np(x.numpy(), s.numpy())
+            out = tf.convert_to_tensor(out)
+            recv = tf.convert_to_tensor(np.asarray(recv, np.int32))
+        else:
+            def run(t, st):
+                o, r = _run_np(t.numpy(), st.numpy())
+                return (tf.convert_to_tensor(o),
+                        tf.convert_to_tensor(np.asarray(r, np.int32)))
+
+            out, recv = tf.py_function(
+                run, inp=[x, s], Tout=[x.dtype, tf.int32],
+                name="HorovodAlltoall",
+            )
+            out.set_shape(tf.TensorShape([None] + list(x.shape)[1:]))
+            recv.set_shape(tf.TensorShape([_basics.size()]))
+
+        def grad(dy, drecv=None):
+            # Backward of alltoall is the reverse exchange: route each
+            # received block back to its sender using the received
+            # splits (ref: mpi_ops.py alltoall gradient registration).
+            back, _ = alltoall(dy, splits=recv, name=f"{nm}.grad")
+            return back, None
+
+        return (out, recv), grad
+
+    return op_with_grad(tensor, splits_t)
+
+
+def join() -> int:
+    from ..ops import join as _join
+
+    return _join()
+
+
+def barrier():
+    from ..ops import barrier as _barrier
+
+    _barrier()
+
+
+# ---------------------------------------------------------------------------
+# Gradient aggregation helpers (ref: horovod/tensorflow/__init__.py:242-287)
+
+
+def _make_allreduce_grads_fn(name_scope: str, device_dense, device_sparse,
+                             compression, sparse_as_dense, op,
+                             gradient_predivide_factor: float = 1.0):
+    """Returns grads_fn(list) -> list, splitting AVERAGE into
+    pre/postscale divisions like the reference when a predivide factor
+    is given (ref: __init__.py:242-274)."""
+    tf = _tf()
+
+    if op == ReduceOp.AVERAGE and gradient_predivide_factor != 1.0:
+        # Divide average into pre- and post-scale factors.
+        prescale = 1.0 / gradient_predivide_factor
+        postscale = gradient_predivide_factor / size()
+        eff_op = ReduceOp.SUM
+    else:
+        prescale, postscale, eff_op = 1.0, 1.0, op
+
+    def allreduce_grads(grads):
+        out = []
+        for i, grad in enumerate(grads):
+            if grad is None:
+                out.append(None)
+                continue
+            if sparse_as_dense and isinstance(grad, tf.IndexedSlices):
+                grad = tf.convert_to_tensor(grad)
+            out.append(
+                allreduce(
+                    grad,
+                    op=eff_op,
+                    name=f"{name_scope}.grad.{i}",
+                    prescale_factor=prescale,
+                    postscale_factor=postscale,
+                    compression=compression,
+                )
+            )
+        return out
+
+    return allreduce_grads
+
+
+class DistributedGradientTape:
+    """Wrap tf.GradientTape so .gradient() allreduces
+    (ref: horovod/tensorflow/__init__.py:434-505 _DistributedGradientTape,
+    :507-572 factory)."""
+
+    def __init__(self, gradtape, device_dense="", device_sparse="",
+                 compression=None, sparse_as_dense=False, op=ReduceOp.AVERAGE,
+                 gradient_predivide_factor: float = 1.0):
+        self._tape = gradtape
+        self._allreduce_grads = _make_allreduce_grads_fn(
+            "DistributedGradientTape", device_dense, device_sparse,
+            compression or Compression.none, sparse_as_dense, op,
+            gradient_predivide_factor,
+        )
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        tf = _tf()
+        grads = self._tape.gradient(target, sources, output_gradients)
+        # Sources may be a tensor, list, dict, or nested structure
+        # (the reference flattens with tf.nest the same way).
+        flat = tf.nest.flatten(grads)
+        return tf.nest.pack_sequence_as(grads, self._allreduce_grads(flat))
+
+
+def DistributedOptimizer(
+    optimizer,
+    name: Optional[str] = None,
+    device_dense: str = "",
+    device_sparse: str = "",
+    compression=None,
+    sparse_as_dense: bool = False,
+    backward_passes_per_step: int = 1,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    gradient_predivide_factor: float = 1.0,
+):
+    """Wrap a tf.compat.v1.train.Optimizer or a Keras optimizer so
+    gradients are allreduced before updates
+    (ref: horovod/tensorflow/__init__.py:289-332 for v1 optimizers; the
+    keras wrap lives in horovod_tpu.keras like the reference's
+    horovod/_keras/__init__.py:27-143)."""
+    tf = _tf()
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _make_v1_optimizer(
+            optimizer, name, device_dense, device_sparse, compression,
+            sparse_as_dense, op, gradient_predivide_factor,
+        )
+    from ..keras import DistributedOptimizer as _keras_wrap
+
+    return _keras_wrap(
+        optimizer,
+        compression=compression,
+        sparse_as_dense=sparse_as_dense,
+        backward_passes_per_step=backward_passes_per_step,
+        op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+    )
+
+
+def _make_v1_optimizer(optimizer, name, device_dense, device_sparse,
+                       compression, sparse_as_dense, op,
+                       gradient_predivide_factor):
+    tf = _tf()
+
+    allreduce_grads = _make_allreduce_grads_fn(
+        name or f"Distributed{type(optimizer).__name__}", device_dense,
+        device_sparse, compression or Compression.none, sparse_as_dense,
+        op, gradient_predivide_factor,
+    )
+
+    class _DistributedOptimizer(type(optimizer)):
+        """Dynamic subclass overriding compute_gradients
+        (ref: __init__.py:289-332)."""
+
+        def __init__(self):
+            self._opt = optimizer
+            self.__dict__.update(optimizer.__dict__)
+
+        def compute_gradients(self, *args, **kwargs):
+            gradients = type(optimizer).compute_gradients(
+                self, *args, **kwargs
+            )
+            grads, variables = zip(*gradients)
+            reduced = allreduce_grads(list(grads))
+            return list(zip(reduced, variables))
+
+    _DistributedOptimizer.__name__ = f"Distributed{type(optimizer).__name__}"
+    return _DistributedOptimizer()
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """(ref: horovod/tensorflow/__init__.py:182-201) — v1 graph helper;
+    in TF2 eager, broadcasts every tf.Variable currently tracked by the
+    default strategy is not possible, so this covers the v1 path."""
+    tf = _tf()
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables is graph-mode only; use "
+            "hvd.broadcast_variables(model.variables, root_rank) in TF2"
+        )
+    return broadcast_variables(
+        tf.compat.v1.global_variables(), root_rank=root_rank
+    )
+
+
+class BroadcastGlobalVariablesHook:
+    """SessionRunHook equivalent (ref: __init__.py:206-239): broadcasts
+    variables once after session creation. TF2-friendly shape: call
+    `hook.on_train_begin(model)`."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, model):
+        broadcast_variables(model.variables, root_rank=self.root_rank)
+
+
+def elastic_run(fn):  # pragma: no cover - thin alias
+    from ..elastic import run as _run
+
+    return _run(fn)

@@ -1,0 +1,52 @@
+"""Gradient compression for the TF binding
+(ref: horovod/tensorflow/compression.py:24-74)."""
+from __future__ import annotations
+
+
+class Compressor:
+    """Interface (ref: compression.py:24-35)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast float tensors to fp16 on the wire (ref: compression.py:46-64)."""
+
+    @staticmethod
+    def compress(tensor):
+        import tensorflow as tf
+
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        import tensorflow as tf
+
+        if ctx is not None:
+            return tf.cast(tensor, ctx)
+        return tensor
+
+
+class Compression:
+    """(ref: compression.py:67-74)"""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

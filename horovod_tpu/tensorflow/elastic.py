@@ -1,0 +1,66 @@
+"""Elastic state for TF/Keras models
+(ref: horovod/tensorflow/elastic.py:91-210 TensorFlowKerasState).
+
+Keeps an in-memory copy of model + optimizer variables; `sync()`
+broadcasts rank 0's values after a topology change, matching the
+reference's save/restore/sync contract (ref: common/elastic.py:95-109).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..elastic.state import ObjectState
+
+
+class TensorFlowKerasState(ObjectState):
+    """State wrapping a Keras model + optimizer plus scalar attributes
+    like epoch/batch (ref: tensorflow/elastic.py:91-160)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_model_weights = None
+        self._saved_opt_weights = None
+        super().__init__(**kwargs)
+
+    def _opt_vars(self):
+        if self.optimizer is None:
+            return []
+        v = getattr(self.optimizer, "variables", [])
+        return list(v() if callable(v) else v)
+
+    def save(self):
+        self._saved_model_weights = [
+            np.copy(w) for w in self.model.get_weights()
+        ]
+        self._saved_opt_weights = [
+            np.copy(v.numpy()) for v in self._opt_vars()
+        ]
+        super().save()
+
+    def restore(self):
+        if self._saved_model_weights is not None:
+            self.model.set_weights(self._saved_model_weights)
+        for var, val in zip(self._opt_vars(), self._saved_opt_weights or []):
+            var.assign(val)
+        super().restore()
+
+    def sync(self):
+        from .functions import broadcast_object
+
+        weights = broadcast_object(
+            [np.asarray(w) for w in self.model.get_weights()],
+            root_rank=0, name="tfks.model",
+        )
+        self.model.set_weights(weights)
+        opt_vals = broadcast_object(
+            [np.asarray(v.numpy()) for v in self._opt_vars()],
+            root_rank=0, name="tfks.opt",
+        )
+        for var, val in zip(self._opt_vars(), opt_vals):
+            if tuple(var.shape) == tuple(np.shape(val)):
+                var.assign(val)
+        super().sync()
+
+
+KerasState = TensorFlowKerasState

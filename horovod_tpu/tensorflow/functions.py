@@ -1,0 +1,43 @@
+"""State broadcast helpers for the TF binding
+(ref: horovod/tensorflow/functions.py:47-160)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    """Assign every variable its root-rank value in place
+    (ref: functions.py:47-64 broadcast_variables)."""
+    from . import broadcast
+
+    for i, var in enumerate(variables):
+        name = getattr(var, "name", None) or f"var.{i}"
+        value = broadcast(var, root_rank,
+                          name=f"bv.{name.replace(':', '_')}.{i}")
+        var.assign(value)
+
+
+def broadcast_object(obj=None, root_rank: int = 0,
+                     name: Optional[str] = None):
+    """Pickle-broadcast an arbitrary object (ref: functions.py:82-120)."""
+    from ..common.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+def broadcast_object_fn(root_rank: int = 0, name: Optional[str] = None):
+    """(ref: functions.py:122-133)"""
+
+    def fn(obj=None):
+        return broadcast_object(obj, root_rank=root_rank, name=name)
+
+    return fn
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    """(ref: functions.py:136-160)"""
+    from ..common.functions import allgather_object as _ao
+
+    return _ao(obj, name=name)

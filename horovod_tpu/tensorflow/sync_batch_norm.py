@@ -1,0 +1,94 @@
+"""Cross-rank synchronized batch normalization for Keras models
+(ref: horovod/tensorflow/sync_batch_norm.py:22-65 — allreduce of the
+batch mean and variance so every rank normalizes with global statistics).
+"""
+from __future__ import annotations
+
+
+def _keras():
+    import keras
+
+    return keras
+
+
+class SyncBatchNormalization:
+    """Factory returning a keras BatchNormalization-compatible layer
+    whose training-time moments are averaged across ranks.
+
+    The reference subclasses tf BatchNormalization and overrides
+    `_calculate_mean_and_var` (ref: sync_batch_norm.py:35-64). Keras 3
+    has no such hook, so this builds a fresh layer with the same
+    parameter surface computing BN explicitly; moments go through
+    hvd.allreduce(AVERAGE) and E[x²]−E[x]² like the reference.
+    """
+
+    def __new__(cls, **kwargs):
+        keras = _keras()
+        from . import allreduce, size
+        from ..common.types import ReduceOp
+
+        class _SyncBN(keras.layers.Layer):
+            def __init__(self, axis=-1, momentum=0.99, epsilon=1e-3,
+                         center=True, scale=True, **kw):
+                super().__init__(**kw)
+                self.axis = axis
+                self.momentum = momentum
+                self.epsilon = epsilon
+                self.center = center
+                self.scale = scale
+
+            def build(self, input_shape):
+                dim = input_shape[self.axis]
+                shape = (dim,)
+                if self.scale:
+                    self.gamma = self.add_weight(
+                        name="gamma", shape=shape, initializer="ones")
+                if self.center:
+                    self.beta = self.add_weight(
+                        name="beta", shape=shape, initializer="zeros")
+                self.moving_mean = self.add_weight(
+                    name="moving_mean", shape=shape, initializer="zeros",
+                    trainable=False)
+                self.moving_variance = self.add_weight(
+                    name="moving_variance", shape=shape, initializer="ones",
+                    trainable=False)
+
+            def call(self, x, training=False):
+                import tensorflow as tf
+
+                ndim = len(x.shape)
+                axis = self.axis % ndim
+                red = [i for i in range(ndim) if i != axis]
+                if training and size() > 1:
+                    # Global moments: average E[x] and E[x²] across
+                    # ranks, then var = E[x²] − E[x]²
+                    # (ref: sync_batch_norm.py:40-58).
+                    mean = tf.reduce_mean(x, axis=red)
+                    sq = tf.reduce_mean(tf.square(x), axis=red)
+                    mean = allreduce(mean, op=ReduceOp.AVERAGE,
+                                     name=f"sbn.{self.name}.mean")
+                    sq = allreduce(sq, op=ReduceOp.AVERAGE,
+                                   name=f"sbn.{self.name}.sq")
+                    var = sq - tf.square(mean)
+                elif training:
+                    mean, var = tf.nn.moments(x, axes=red)
+                else:
+                    mean, var = self.moving_mean, self.moving_variance
+                if training:
+                    self.moving_mean.assign(
+                        self.moving_mean * self.momentum
+                        + mean * (1.0 - self.momentum))
+                    self.moving_variance.assign(
+                        self.moving_variance * self.momentum
+                        + var * (1.0 - self.momentum))
+                shape = [1] * ndim
+                shape[axis] = -1
+                inv = tf.math.rsqrt(var + self.epsilon)
+                out = (x - tf.reshape(mean, shape)) * tf.reshape(inv, shape)
+                if self.scale:
+                    out = out * tf.reshape(self.gamma, shape)
+                if self.center:
+                    out = out + tf.reshape(self.beta, shape)
+                return out
+
+        return _SyncBN(**kwargs)

@@ -32,6 +32,19 @@ except ImportError:  # pragma: no cover
                               check_rep=check_rep, **kw)
 
 
+def pvary(x, axis):
+    """Mark x as varying over `axis` for shard_map's VMA tracking.
+    No-op under check_vma=False (our shard_map default); under VMA
+    tracking it keeps jax.grad cotangents rank-local instead of
+    auto-psummed, preserving Horovod's per-rank-gradient semantics."""
+    import jax
+
+    try:
+        return jax.lax.pcast(x, to="varying", axes=axis)
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axis)
+
+
 def tree_map(f, *trees):
     return jax.tree.map(f, *trees)
 

@@ -168,3 +168,62 @@ def test_pipelined_lm_matches_and_trains():
         state, loss = step_fn(state, ids)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_wrap_step_grad_semantics(hvd_mesh):
+    """A jax.grad inside wrap_step must yield the Horovod semantics:
+    hvd.allreduce(AVERAGE) of per-rank gradients equals the global-batch
+    gradient — not the cross-rank sum (regression: jax's manual-axes
+    cotangent auto-psum would inflate grads by world size)."""
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    X = np.arange(32, dtype=np.float32).reshape(32, 1)
+    w = jnp.ones(1)
+
+    def loss_fn(w, xb):
+        return jnp.mean(xb[:, 0] * w[0])
+
+    @hvd.wrap_step
+    def step(w, xb):
+        g = jax.grad(loss_fn)(w, xb)
+        return hvd.allreduce(g, op=hvd.ReduceOp.AVERAGE)
+
+    got = np.asarray(step(w, X))
+    true_avg = np.asarray(jax.grad(loss_fn)(w, jnp.asarray(X)))
+    np.testing.assert_allclose(got, true_avg, rtol=1e-6)
+
+
+def test_wrap_step_distributed_optimizer_converges(hvd_mesh):
+    """Linear regression via wrap_step + DistributedOptimizer: 8 shards,
+    sgd(0.3), 30 steps -> loss < 1e-3 (the verify-skill template)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = X @ w_true
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.3), axis_name="hvd")
+    w = jnp.zeros(4)
+    ostate = tx.init(w)
+
+    def loss_fn(w, xb, yb):
+        return jnp.mean((xb @ w - yb) ** 2)
+
+    @hvd.wrap_step
+    def step(carry, xb, yb):
+        w, ostate = carry
+        g = jax.grad(loss_fn)(w, xb, yb)
+        u, ostate2 = tx.update(g, ostate)
+        return w + u, ostate2
+
+    for _ in range(30):
+        w, ostate = step((w, ostate), X, y)
+    assert float(loss_fn(w, X, y)) < 1e-3
