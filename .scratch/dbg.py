@@ -1,0 +1,33 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, optax, jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+import horovod_tpu as hvd
+hvd.init()
+mesh = hvd.mesh()
+rng = np.random.RandomState(0)
+X = rng.randn(64, 4).astype(np.float32)
+w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+y = X @ w_true
+
+def loss_fn(w, xb, yb):
+    return jnp.mean((xb @ w - yb) ** 2)
+
+@jax.jit
+def gradcheck(w, X, y):
+    def shard_step(w, xb, yb):
+        g = jax.grad(loss_fn)(w, xb, yb)
+        return jax.lax.pmean(g, "hvd")
+    return shard_map(shard_step, mesh=mesh,
+                     in_specs=(P(), P("hvd"), P("hvd")),
+                     out_specs=P())(w, X, y)
+
+w = jnp.zeros(4)
+g_sharded = gradcheck(w, X, y)
+g_global = jax.grad(loss_fn)(w, X, y)
+print("sharded", np.asarray(g_sharded))
+print("global ", np.asarray(g_global))

@@ -1,0 +1,21 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+import horovod_tpu as hvd
+hvd.init()
+mesh = hvd.mesh()
+print("mesh:", mesh, type(mesh))
+X = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+
+@jax.jit
+def f(X):
+    def s(xb):
+        return jax.lax.pmean(jnp.mean(xb), "hvd")
+    return shard_map(s, mesh=mesh, in_specs=P("hvd"), out_specs=P())(X)
+
+print("pmean:", float(f(X)), "true mean:", X.mean())

@@ -1,0 +1,40 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+print("jax", jax.__version__)
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P, Mesh
+mesh = Mesh(np.array(jax.devices()), ("hvd",))
+rng = np.random.RandomState(0)
+X = rng.randn(64, 4).astype(np.float32)
+w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+y = X @ w_true
+w = jnp.zeros(4)
+
+def loss_fn(w, xb, yb):
+    return jnp.mean((xb @ w - yb) ** 2)
+
+@jax.jit
+def manual(w, X, y):
+    def s(w, xb, yb):
+        r = xb @ w - yb
+        g = 2.0 / xb.shape[0] * (xb.T @ r)
+        return jax.lax.pmean(g, "hvd")
+    return shard_map(s, mesh=mesh, in_specs=(P(), P("hvd"), P("hvd")),
+                     out_specs=P())(w, X, y)
+
+@jax.jit
+def withgrad(w, X, y):
+    def s(w, xb, yb):
+        print("  shard xb shape:", xb.shape)
+        g = jax.grad(loss_fn)(w, xb, yb)
+        return jax.lax.pmean(g, "hvd")
+    return shard_map(s, mesh=mesh, in_specs=(P(), P("hvd"), P("hvd")),
+                     out_specs=P())(w, X, y)
+
+print("manual  ", np.asarray(manual(w, X, y)))
+print("withgrad", np.asarray(withgrad(w, X, y)))
+print("global  ", np.asarray(jax.grad(loss_fn)(w, X, y)))

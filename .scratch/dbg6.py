@@ -1,0 +1,29 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P, Mesh
+mesh = Mesh(np.array(jax.devices()), ("hvd",))
+rng = np.random.RandomState(0)
+X = rng.randn(64, 4).astype(np.float32)
+y = X @ np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+w = jnp.zeros(4)
+
+def loss_fn(w, xb, yb):
+    return jnp.mean((xb @ w - yb) ** 2)
+
+@jax.jit
+def pershard(w, X, y):
+    def s(w, xb, yb):
+        g = jax.grad(loss_fn)(w, xb, yb)
+        return g[None]  # keep per-shard
+    return shard_map(s, mesh=mesh, in_specs=(P(), P("hvd"), P("hvd")),
+                     out_specs=P("hvd"))(w, X, y)
+
+gs = np.asarray(pershard(w, X, y))
+print("per-shard grads:\n", gs)
+print("mean of per-shard:", gs.mean(0))
+print("global:", np.asarray(jax.grad(loss_fn)(w, X, y)))

@@ -1,0 +1,35 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# Big tensor -> ring path; check against star-computed truth
+x = np.arange(100000, dtype=np.float32) * (r + 1)
+out = hvd.allreduce(x, op=hvd.ReduceOp.SUM, name="big")
+expect = np.arange(100000, dtype=np.float32) * sum(i + 1 for i in range(n))
+assert np.allclose(np.asarray(out), expect), "ring sum wrong"
+
+avg = hvd.allreduce(x, op=hvd.ReduceOp.AVERAGE, name="bigavg")
+assert np.allclose(np.asarray(avg), expect / n)
+
+# MIN/MAX/PRODUCT eager (small -> star; large -> ring)
+for size in (10, 50000):
+    y = (np.arange(size, dtype=np.float64) + 1) * (r + 1)
+    mn = hvd.allreduce(y, op=hvd.ReduceOp.MIN, name=f"min{size}")
+    assert np.allclose(np.asarray(mn), (np.arange(size) + 1) * 1.0), "min wrong"
+    mx = hvd.allreduce(y, op=hvd.ReduceOp.MAX, name=f"max{size}")
+    assert np.allclose(np.asarray(mx), (np.arange(size) + 1) * n), "max wrong"
+    pr = hvd.allreduce(np.full(size, float(r + 2)), op=hvd.ReduceOp.PRODUCT, name=f"pr{size}")
+    expect_pr = np.prod([i + 2 for i in range(n)])
+    assert np.allclose(np.asarray(pr), expect_pr), "product wrong"
+
+# join still works with ring enabled (joined -> falls back to star)
+if r == 0:
+    z = hvd.allreduce(np.ones(60000, np.float32), name="uneven.ring")
+    assert np.allclose(np.asarray(z), 1.0 / n)  # zeros from joined ranks dilute the average
+hvd.join()
+print(f"rank {r}: RING OK")

@@ -1,0 +1,51 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+import numpy as np
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+print("size", hvd.size())
+# size-1 fast paths
+x = tf.constant([1.0, 2.0])
+assert np.allclose(hvd.allreduce(x).numpy(), [1.0, 2.0])
+assert np.allclose(hvd.allgather(x).numpy(), [1.0, 2.0])
+assert np.allclose(hvd.broadcast(x, 0).numpy(), [1.0, 2.0])
+out, splits = hvd.alltoall(x)
+assert np.allclose(out.numpy(), [1.0, 2.0])
+
+# DistributedGradientTape
+w = tf.Variable([1.0, 2.0])
+with tf.GradientTape() as tape:
+    loss = tf.reduce_sum(w * w)
+tape = hvd.DistributedGradientTape(tape)
+g = tape.gradient(loss, [w])
+assert np.allclose(g[0].numpy(), [2.0, 4.0]), g
+
+# keras DistributedOptimizer single-rank fit
+import horovod_tpu.keras as hk
+import keras
+model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
+opt = hk.DistributedOptimizer(keras.optimizers.SGD(0.05))
+model.compile(optimizer=opt, loss="mse")
+X = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+Y = X @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+h = model.fit(X, Y, epochs=12, batch_size=16, verbose=0,
+              callbacks=[hk.callbacks.MetricAverageCallback(),
+                         hk.callbacks.BroadcastGlobalVariablesCallback(0)])
+l0, l1 = h.history["loss"][0], h.history["loss"][-1]
+assert l1 < l0 * 0.2, (l0, l1)
+
+# SyncBatchNorm single-rank
+sbn = hvd.SyncBatchNormalization()
+y = sbn(tf.random.normal((8, 4)), training=True)
+assert y.shape == (8, 4)
+
+# elastic state
+st = hvd.__dict__.get("TensorFlowKerasState")
+from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+s = TensorFlowKerasState(model, opt, epoch=0, batch=0)
+s.save(); s.restore(); s.commit()
+print("TF SMOKE OK", l0, "->", l1)

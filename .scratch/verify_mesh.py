@@ -1,0 +1,34 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, optax, jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+assert hvd.size() == 8, hvd.size()
+rng = np.random.RandomState(0)
+X = rng.randn(64, 4).astype(np.float32)
+w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+y = X @ w_true
+
+tx = hvd.DistributedOptimizer(optax.sgd(0.3), axis_name="hvd")
+w = jnp.zeros(4)
+ostate = tx.init(w)
+
+def loss_fn(w, xb, yb):
+    return jnp.mean((xb @ w - yb) ** 2)
+
+@hvd.wrap_step
+def step(carry, xb, yb):
+    w, ostate = carry
+    g = jax.grad(loss_fn)(w, xb, yb)
+    u, ostate2 = tx.update(g, ostate)
+    return w + u, ostate2
+
+for i in range(30):
+    w, ostate = step((w, ostate), X, y)
+l = float(loss_fn(w, X, y))
+assert l < 1e-3, l
+print("MESH MODE OK loss=%.2e" % l)

@@ -1,0 +1,34 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert hvd.mode() == "process", hvd.mode()
+
+# broadcast
+x = np.full((4,), float(r), np.float32)
+b = hvd.broadcast(x, root_rank=1)
+assert np.allclose(np.asarray(b), 1.0), b
+
+# allreduce average
+a = hvd.allreduce(np.full((8,), float(r + 1), np.float32))
+expect = np.mean([i + 1 for i in range(n)])
+assert np.allclose(np.asarray(a), expect), (a, expect)
+
+# variable-first-dim allgather
+g = hvd.allgather(np.arange((r + 1) * 3, dtype=np.int32).reshape(r + 1, 3))
+assert np.asarray(g).shape == (sum(i + 1 for i in range(n)), 3), g.shape
+
+# allgather_object
+objs = hvd.allgather_object({"rank": r, "tag": "x" * (r + 1)})
+assert [o["rank"] for o in objs] == list(range(n)), objs
+
+# join with uneven steps: rank 0 does 2 extra allreduces
+extra = 2 if r == 0 else 0
+for i in range(extra):
+    out = hvd.allreduce(np.ones(4, np.float32), name=f"uneven.{i}")
+j = hvd.join()
+print(f"rank {r}: ALL OK (join returned {j})")

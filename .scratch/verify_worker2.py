@@ -1,0 +1,33 @@
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+try:
+    hvd.rank()
+    print("FAIL: no error before init"); sys.exit(1)
+except Exception as e:
+    assert "init" in str(e).lower() or "NotInitialized" in type(e).__name__, e
+
+hvd.init()
+r = hvd.rank()
+# uint8 through allgather
+g = hvd.allgather(np.arange(4, dtype=np.uint8))
+assert np.asarray(g).shape == (8,), g
+# shape mismatch must raise with op + shapes named
+try:
+    hvd.allreduce(np.ones((2 + r, 3), np.float32), name="mismatch")
+    print("FAIL: mismatch not raised"); sys.exit(1)
+except Exception as e:
+    msg = str(e)
+    assert "mismatch" in msg.lower() or "shape" in msg.lower(), msg
+# kill rank 1 mid-run; rank 0 must raise HorovodInternalError
+if r == 1:
+    os._exit(1)
+try:
+    hvd.allreduce(np.ones(4, np.float32), name="afterkill")
+    print("FAIL: rank0 did not error"); sys.exit(1)
+except HorovodInternalError:
+    print(f"rank {r}: ERROR PROBES OK")

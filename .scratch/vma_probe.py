@@ -1,0 +1,23 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"; os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, jax.numpy as jnp
+import horovod_tpu as hvd
+
+hvd.init()
+X = np.arange(32, dtype=np.float32).reshape(32, 1)  # shards differ
+w = jnp.ones(1)
+
+def loss_fn(w, xb):
+    return jnp.mean(xb[:, 0] * w[0])
+
+@hvd.wrap_step
+def step(w, xb):
+    g = jax.grad(loss_fn)(w, xb)
+    return hvd.allreduce(g, op=hvd.ReduceOp.AVERAGE)
+
+got = np.asarray(step(w, X))
+true_avg = np.asarray(jax.grad(loss_fn)(w, jnp.asarray(X)))
+print("wrap_step result:", got, "true global avg:", true_avg,
+      "ratio:", got / true_avg)

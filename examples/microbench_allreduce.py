@@ -1,0 +1,75 @@
+"""Eager-engine allreduce micro-benchmark: latency / bandwidth vs size.
+
+Measures the process-mode data plane the way the reference community
+benchmarks Gloo vs MPI backends — per-op latency for small tensors and
+achieved bus bandwidth for large ones, for both the ring and star
+algorithms (ref methodology: gloo ring allreduce,
+horovod/common/ops/gloo_operations.cc:119-166).
+
+Run under the launcher (2-8 processes):
+
+    hvdrun -np 2 python examples/microbench_allreduce.py
+    hvdrun -np 4 python examples/microbench_allreduce.py --algo star
+
+Rank 0 prints a table and one JSON summary line.
+"""
+import argparse
+import json
+import os
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", default="1024,16384,262144,4194304",
+                   help="comma-separated element counts (float32)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--algo", choices=["ring", "star"], default=None,
+                   help="force the data-plane algorithm (default: auto)")
+    args = p.parse_args()
+
+    if args.algo == "star":
+        os.environ["HOROVOD_CPU_OPERATIONS"] = "star"
+    elif args.algo == "ring":
+        os.environ["HOROVOD_RING_THRESHOLD"] = "0"
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    rows = []
+    for count in [int(s) for s in args.sizes.split(",")]:
+        x = np.ones(count, np.float32)
+        for i in range(args.warmup):
+            hvd.allreduce(x, name=f"warm.{count}.{i}")
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            hvd.allreduce(x, name=f"bench.{count}.{i}")
+        dt = (time.perf_counter() - t0) / args.iters
+        # Bus bandwidth uses the ring-allreduce wire factor 2(n-1)/n
+        # (bytes each rank moves per link), the NCCL-tests convention.
+        busbw = x.nbytes * 2 * (n - 1) / n / dt
+        rows.append({"bytes": x.nbytes, "lat_us": dt * 1e6,
+                     "busbw_MBps": busbw / 1e6})
+    if r == 0:
+        print(f"{'bytes':>12} {'latency(us)':>14} {'busbw(MB/s)':>14}")
+        for row in rows:
+            print(f"{row['bytes']:>12} {row['lat_us']:>14.1f} "
+                  f"{row['busbw_MBps']:>14.1f}")
+        print(json.dumps({
+            "metric": "eager_allreduce",
+            "np": n,
+            "algo": args.algo or "auto",
+            "rows": [{k: round(v, 1) for k, v in row.items()}
+                     for row in rows],
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Ring allreduce over point-to-point links — the bandwidth-optimal
+CPU data plane (ref: GlooAllreduce's ring algorithm,
+horovod/common/ops/gloo_operations.cc:119-166).
+
+The star mixin funnels every byte through rank 0: O(N·bytes) on one
+link. The ring moves each byte across each link ~2(N-1)/N times total —
+flat per-rank bandwidth regardless of N. Reduce-scatter then allgather,
+the classic two-phase schedule:
+
+  phase 1 (N-1 steps): send chunk (r-s), recv chunk (r-s-1), reduce in.
+  phase 2 (N-1 steps): send chunk (r-s+1), recv chunk (r-s) verbatim.
+
+Selection: ring runs for elementwise ops when the payload exceeds
+HOROVOD_RING_THRESHOLD bytes; smaller tensors stay on the star path
+(latency-optimal). Sizes are coordinator-negotiated — every rank,
+including joined ranks (which the engine hands full-shape zero
+buffers), holds the same element count, so the decision is local yet
+globally consistent. HOROVOD_CPU_OPERATIONS=star forces the old path.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ..common.types import ReduceOp
+from .base import _reduce
+from .star import StarCollectivesMixin, pack_array, unpack_array
+
+# Measured crossover on loopback (examples/microbench_allreduce.py,
+# np=3): star wins <=64KB (fewer rounds), parity ~1MB, ring 1.5x at
+# 16MB. Real networks shift this left as N grows (star's rank-0 link
+# saturates at O(N*bytes)); the env knob tunes it per deployment.
+DEFAULT_RING_THRESHOLD = 262144  # bytes; smaller tensors stay on star
+
+_RING_OPS = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
+             ReduceOp.PRODUCT)
+
+
+class RingCollectivesMixin(StarCollectivesMixin):
+    """Adds a ring allreduce on transports providing p2p primitives
+    `send_to(rank, bytes)` / `recv_from(rank) -> bytes`."""
+
+    def _ring_enabled(self) -> bool:
+        if os.environ.get("HOROVOD_CPU_OPERATIONS", "").lower() == "star":
+            return False
+        return hasattr(self, "send_to") and hasattr(self, "recv_from")
+
+    def _ring_threshold(self) -> int:
+        try:
+            return int(os.environ.get("HOROVOD_RING_THRESHOLD",
+                                      DEFAULT_RING_THRESHOLD))
+        except ValueError:
+            return DEFAULT_RING_THRESHOLD
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        if self.size == 1:
+            return arr.copy()
+        if (
+            not self._ring_enabled()
+            or op not in _RING_OPS
+            or arr.nbytes < self._ring_threshold()
+        ):
+            return super().allreduce(arr, op)
+        # No eligibility exchange is needed: allreduce sizes are
+        # negotiated by the coordinator, so every rank (including joined
+        # ranks, which the engine hands full-shape zero buffers) holds
+        # the same element count and reaches the same ring/star decision
+        # from its own arr.nbytes.
+        return self._ring_allreduce(arr, op)
+
+    # ------------------------------------------------------------------
+    def _sendrecv(self, dest: int, payload: bytes, src: int) -> bytes:
+        """Simultaneous send+recv (MPI_Sendrecv shape): the send runs on
+        a helper thread so a full socket buffer cannot deadlock the ring
+        (every rank sends right while receiving left)."""
+        err: List[BaseException] = []
+
+        def _send():
+            try:
+                self.send_to(dest, payload)
+            except BaseException as e:  # pragma: no cover - network death
+                err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        data = self.recv_from(src)
+        t.join()
+        if err:
+            raise err[0]
+        return data
+
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        n = self.size
+        right = (self.rank + 1) % n
+        left = (self.rank - 1) % n
+        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+        # Chunk boundaries (last chunk absorbs the remainder).
+        base = flat.size // n
+        bounds = [i * base for i in range(n)] + [flat.size]
+
+        def chunk(i):
+            i %= n
+            return flat[bounds[i]: bounds[i + 1]]
+
+        # Phase 1: reduce-scatter. After step s, chunk (r-s-1) holds the
+        # partial reduction of s+2 ranks; after N-1 steps chunk (r+1) is
+        # fully reduced here (ref: gloo ring reduce-scatter schedule).
+        for s in range(n - 1):
+            send_c = chunk(self.rank - s)
+            recv_buf = self._sendrecv(right, send_c.tobytes(), left)
+            incoming = np.frombuffer(recv_buf, dtype=flat.dtype)
+            tgt = chunk(self.rank - s - 1)
+            tgt[:] = _reduce(
+                op if op != ReduceOp.AVERAGE else ReduceOp.SUM,
+                [tgt, incoming],
+            )
+
+        # Phase 2: allgather the reduced chunks around the ring.
+        for s in range(n - 1):
+            send_c = chunk(self.rank - s + 1)
+            recv_buf = self._sendrecv(right, send_c.tobytes(), left)
+            chunk(self.rank - s)[:] = np.frombuffer(recv_buf, dtype=flat.dtype)
+
+        if op == ReduceOp.AVERAGE:
+            flat = (flat / n).astype(arr.dtype)
+        return flat.reshape(arr.shape)

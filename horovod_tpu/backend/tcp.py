@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from .rendezvous import RendezvousClient
-from .star import StarCollectivesMixin
+from .ring import RingCollectivesMixin
 
 logger = get_logger()
 
@@ -50,7 +50,7 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
-class TcpBackend(StarCollectivesMixin):
+class TcpBackend(RingCollectivesMixin):
     """Full-mesh sockets; rank 0 doubles as the coordinator."""
 
     def __init__(
@@ -146,6 +146,13 @@ class TcpBackend(StarCollectivesMixin):
         return _recv_frame(self.peers[0])
 
     # ------------------------------------------------------------------
+    def send_to(self, peer: int, payload: bytes):
+        """Point-to-point framed send (ring data plane primitive)."""
+        _send_all(self.peers[peer], payload)
+
+    def recv_from(self, peer: int) -> bytes:
+        return _recv_frame(self.peers[peer])
+
     def shutdown(self):
         for s in self.peers.values():
             try:

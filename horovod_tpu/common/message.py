@@ -80,10 +80,15 @@ class Request:
     tensor_shape: Tuple[int, ...] = ()
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # ReduceOp for ALLREDUCE (SUM/MIN/MAX/PRODUCT; AVERAGE lowers to
+    # SUM+postscale before enqueue). The reference encodes this in the
+    # op layer; here it rides the wire so the coordinator can validate
+    # cross-rank agreement (ref: message.h Request op semantics).
+    reduce_op: int = 0
 
     def serialize(self) -> bytes:
         head = struct.pack(
-            "<iiiiidd",
+            "<iiiiiddi",
             self.request_rank,
             int(self.request_type),
             int(self.tensor_type),
@@ -91,17 +96,20 @@ class Request:
             self.device,
             self.prescale_factor,
             self.postscale_factor,
+            self.reduce_op,
         )
         return head + _pack_str(self.tensor_name) + _pack_i64list(self.tensor_shape)
 
     @staticmethod
     def deserialize(buf: bytes, off: int = 0) -> Tuple["Request", int]:
-        rr, rt, tt, root, dev, pre, post = struct.unpack_from("<iiiiidd", buf, off)
-        off += struct.calcsize("<iiiiidd")
+        rr, rt, tt, root, dev, pre, post, rop = struct.unpack_from(
+            "<iiiiiddi", buf, off)
+        off += struct.calcsize("<iiiiiddi")
         name, off = _unpack_str(buf, off)
         shape, off = _unpack_i64list(buf, off)
         return (
-            Request(rr, RequestType(rt), DataType(tt), name, root, dev, tuple(shape), pre, post),
+            Request(rr, RequestType(rt), DataType(tt), name, root, dev,
+                    tuple(shape), pre, post, rop),
             off,
         )
 
@@ -150,15 +158,17 @@ class Response:
     # response cache with an identical key, keeping cache-bit assignment
     # rank-consistent (ref: response_cache.cc put-from-response).
     tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
+    reduce_op: int = 0
 
     def serialize(self) -> bytes:
         out = struct.pack(
-            "<iiddi",
+            "<iiddii",
             int(self.response_type),
             int(self.tensor_type),
             self.prescale_factor,
             self.postscale_factor,
             self.last_joined_rank,
+            self.reduce_op,
         )
         out += struct.pack("<I", len(self.tensor_names))
         for n in self.tensor_names:
@@ -173,8 +183,8 @@ class Response:
 
     @staticmethod
     def deserialize(buf: bytes, off: int = 0) -> Tuple["Response", int]:
-        rt, tt, pre, post, ljr = struct.unpack_from("<iiddi", buf, off)
-        off += struct.calcsize("<iiddi")
+        rt, tt, pre, post, ljr, rop = struct.unpack_from("<iiddii", buf, off)
+        off += struct.calcsize("<iiddii")
         (n,) = struct.unpack_from("<I", buf, off)
         off += 4
         names = []
@@ -192,7 +202,7 @@ class Response:
             shapes.append(tuple(int(d) for d in shp))
         return (
             Response(ResponseType(rt), names, err, [int(d) for d in devices],
-                     sizes, DataType(tt), pre, post, ljr, shapes),
+                     sizes, DataType(tt), pre, post, ljr, shapes, rop),
             off,
         )
 

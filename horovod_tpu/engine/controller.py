@@ -36,7 +36,7 @@ from ..common.message import (
     ResponseList,
     ResponseType,
 )
-from ..common.types import DataType, dtype_size
+from ..common.types import DataType, ReduceOp, dtype_size
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from .response_cache import CacheState, ResponseCache
@@ -80,7 +80,12 @@ class _TensorRecord:
 
 
 class Controller:
-    def __init__(self, transport: ControllerTransport, size: int, rank: int):
+    def __init__(self, transport: ControllerTransport, size: int, rank: int,
+                 timeline=None):
+        # Coordinator-side timeline hook: negotiation phases are only
+        # observable here (ref: timeline written on coordinator only,
+        # operations.cc:416-429).
+        self.timeline = timeline
         self.transport = transport
         self.size = size
         self.rank = rank
@@ -262,6 +267,16 @@ class Controller:
     # ------------------------------------------------------------------
     def _increment_tensor_count(self, req: Request) -> bool:
         """(ref: IncrementTensorCount, controller.cc:837-860)"""
+        if self.timeline is not None:
+            if req.tensor_name not in self.message_table:
+                # First rank's request opens the NEGOTIATE_<OP> phase
+                # (ref: Timeline::NegotiateStart, timeline.h:87-95).
+                self.timeline.negotiate_start(
+                    req.tensor_name, req.request_type.name
+                )
+            self.timeline.negotiate_rank_ready(
+                req.tensor_name, req.request_rank
+            )
         rec = self.message_table.setdefault(req.tensor_name, _TensorRecord())
         if req.request_rank not in rec.ranks:
             rec.requests.append(req)
@@ -274,6 +289,12 @@ class Controller:
         """Validate cross-rank consistency and build the Response
         (ref: ConstructResponse, controller.cc:380-657)."""
         rec = self.message_table.pop(name)
+        if self.timeline is not None:
+            # Negotiation closes the moment the response is formed
+            # (ref: Timeline::NegotiateEnd, timeline.h:96-104).
+            self.timeline.negotiate_end(
+                name, rec.requests[0].request_type.name
+            )
         self.stall_inspector.remove(name)
         reqs = rec.requests
         first = reqs[0]
@@ -298,6 +319,11 @@ class Controller:
                 or r.postscale_factor != first.postscale_factor
             ):
                 return error("Mismatched prescale/postscale factors.")
+            if r.reduce_op != first.reduce_op:
+                return error(
+                    f"Mismatched reduce ops: One rank requested op "
+                    f"{first.reduce_op}, another {r.reduce_op}."
+                )
 
         rt = first.request_type
         # Join compatibility gate FIRST: with joined ranks, not every rank
@@ -310,6 +336,15 @@ class Controller:
         ):
             return error(
                 f"{rt.name} is not supported while some ranks have joined."
+            )
+        if self.joined_ranks and first.reduce_op not in (
+            0, int(ReduceOp.SUM)
+        ):
+            # Joined ranks contribute zeros — the identity only for SUM
+            # (ref: JoinOp zero-contribution semantics).
+            return error(
+                "MIN/MAX/PRODUCT allreduce is not supported while some "
+                "ranks have joined."
             )
 
         tensor_sizes: List[int] = []
@@ -369,6 +404,7 @@ class Controller:
             prescale_factor=first.prescale_factor,
             postscale_factor=first.postscale_factor,
             tensor_shapes=[tuple(first.tensor_shape)],
+            reduce_op=first.reduce_op,
         )
 
     # ------------------------------------------------------------------
@@ -399,6 +435,7 @@ class Controller:
                     and cand.devices == base.devices
                     and cand.prescale_factor == base.prescale_factor
                     and cand.postscale_factor == base.postscale_factor
+                    and cand.reduce_op == base.reduce_op
                     and not cand.error_message
                 ):
                     cand_bytes = sum(self._byte_size(cand, n) for n in cand.tensor_names)
@@ -414,8 +451,21 @@ class Controller:
         return fused
 
     def _byte_size(self, resp: Response, name: str) -> int:
-        # Byte size recorded at request time; fall back to 0.
-        return self._sizes_by_name.get(name, 0)
+        # Byte size recorded at request time. A coordinator that joined
+        # never enqueued the tensor, so derive the size from the
+        # response's own shape+dtype rather than defaulting to 0 (which
+        # would let such responses fuse past the threshold unbounded).
+        n = self._sizes_by_name.get(name)
+        if n is not None:
+            return n
+        try:
+            idx = resp.tensor_names.index(name)
+            count = 1
+            for d in resp.tensor_shapes[idx]:
+                count *= d
+            return count * dtype_size(DataType(resp.tensor_type))
+        except (ValueError, IndexError):
+            return 0
 
     def record_tensor_size(self, name: str, nbytes: int):
         self._sizes_by_name[name] = nbytes

@@ -122,6 +122,11 @@ class Engine:
         self._init_error: Optional[BaseException] = None
         self._op_counter: Dict[str, int] = {}
         self._counter_lock = threading.Lock()
+        # Persistent fusion buffer, one per dtype, grown to the largest
+        # fused payload seen (ref: FusionBufferManager's per-device
+        # persistent buffer, fusion_buffer_manager.h:30-56). Only the
+        # background thread touches it.
+        self._fusion_storage: Dict[str, np.ndarray] = {}
 
     # ------------------------------------------------------------------
     def start(self):
@@ -148,7 +153,8 @@ class Engine:
 
                 self.backend = TcpBackend(self.rank, self.size,
                                           scope=self._scope)
-            self.controller = Controller(self.backend, self.size, self.rank)
+            self.controller = Controller(self.backend, self.size, self.rank,
+                                         timeline=self.timeline)
             from .parameter_manager import ParameterManager
 
             self.param_manager = ParameterManager(
@@ -211,6 +217,11 @@ class Engine:
     def _perform_operation(self, resp: Response):
         """(ref: PerformOperation, operations.cc:253-330)"""
         entries = self.tensor_queue.get_tensor_entries(resp.tensor_names)
+        for e in entries:
+            # Top-level op phase opens when execution begins
+            # (ref: Timeline::Start, timeline.h:106-110); activities
+            # nest inside; _finish closes it.
+            self.timeline.start(e.tensor_name, resp.response_type.name)
         try:
             if resp.response_type == ResponseType.ERROR:
                 for e in entries:
@@ -254,14 +265,29 @@ class Engine:
         adasum = resp.response_type == ResponseType.ADASUM
         pre, post = resp.prescale_factor, resp.postscale_factor
         if not entries:
-            # This rank joined: contribute nothing; star data plane treats
-            # missing contributions as zeros (ref: JoinOp semantics,
-            # controller.cc:220-231).
+            # This rank joined: contribute zeros of the full negotiated
+            # shape (ref: JoinOp semantics, controller.cc:220-231). Full
+            # shape — not empty — so ring and star ranks see identical
+            # element counts and take the same data-plane path; zeros
+            # are the identity for the SUM join supports.
             if self.size > 1:
+                from ..common.types import from_wire_dtype
+
+                count = 0
+                for shp in resp.tensor_shapes:
+                    c = 1
+                    for d in shp:
+                        c *= d
+                    count += c
+                zeros = np.zeros(
+                    count, from_wire_dtype(resp.tensor_type)
+                )
                 if adasum:
-                    self.backend.adasum_allreduce_all(np.zeros(0, np.float32))
+                    self.backend.adasum_allreduce_all(zeros)
                 else:
-                    self.backend.allreduce(np.zeros(0, np.float32), ReduceOp.SUM)
+                    self.backend.allreduce(
+                        zeros, ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
+                    )
             return
         name0 = entries[0].tensor_name
         if len(entries) == 1:
@@ -273,13 +299,7 @@ class Engine:
             # the C++ core is built).
             self.timeline.activity_start(name0, MEMCPY_IN_FUSION_BUFFER)
             shapes = [e.tensor.shape for e in entries]
-            from ..cc import native
-
-            packed = native.pack([e.tensor for e in entries])
-            if packed is not None:
-                buf = packed.view(entries[0].tensor.dtype)
-            else:
-                buf = np.concatenate([np.ravel(e.tensor) for e in entries])
+            buf = self._pack_fusion(entries)
             self.timeline.activity_end(name0)
         if pre != 1.0:
             buf = _scale_np(buf, pre)
@@ -288,7 +308,9 @@ class Engine:
         if adasum:
             red = self.backend.adasum_allreduce_all(np.asarray(buf))
         else:
-            red = self.backend.allreduce(np.asarray(buf), ReduceOp.SUM)
+            red = self.backend.allreduce(
+                np.asarray(buf), ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
+            )
         self.timeline.activity_end(name0)
         if post != 1.0:
             red = _scale_np(red, post)
@@ -302,6 +324,31 @@ class Engine:
                 self._finish(e, Status.OK(), red[off : off + n].reshape(shape))
                 off += n
             self.timeline.activity_end(name0)
+
+    def _pack_fusion(self, entries: List[TensorTableEntry]) -> np.ndarray:
+        """Copy entries into the persistent fusion buffer (one concat
+        target reused across cycles; the native threaded memcpy packs
+        when the C++ core is built)."""
+        from ..cc import native
+
+        dtype = entries[0].tensor.dtype
+        total = sum(int(e.tensor.size) for e in entries)
+        # Native threaded memcpy stays the fast path every cycle; the
+        # persistent buffer only backs the pure-python fallback.
+        packed = native.pack([e.tensor for e in entries])
+        if packed is not None:
+            return packed.view(dtype)[:total]
+        key = dtype.str
+        storage = self._fusion_storage.get(key)
+        if storage is None or storage.size < total:
+            storage = np.empty(max(total, 1), dtype)
+            self._fusion_storage[key] = storage
+        off = 0
+        for e in entries:
+            n = int(e.tensor.size)
+            storage[off : off + n] = np.ravel(e.tensor)
+            off += n
+        return storage[:total]
 
     def _finish(self, entry: TensorTableEntry, status: Status, result):
         self.timeline.end(entry.tensor_name, entry.tensor_name.split(".")[0])
@@ -327,6 +374,7 @@ class Engine:
         prescale: float = 1.0,
         postscale: float = 1.0,
         splits: Optional[List[int]] = None,
+        reduce_op: ReduceOp = ReduceOp.SUM,
     ) -> int:
         handle = self.handles.allocate()
         req = Request(
@@ -339,6 +387,7 @@ class Engine:
             tensor_shape=tuple(arr.shape) if arr is not None else (),
             prescale_factor=prescale,
             postscale_factor=postscale,
+            reduce_op=int(reduce_op),
         )
         if arr is not None and self.controller is not None:
             self.controller.record_tensor_size(name, arr.nbytes)
@@ -353,7 +402,6 @@ class Engine:
             callback=callback,
             splits=splits,
         )
-        self.timeline.negotiate_start(name, req_type.name)
         status = self.tensor_queue.add_to_tensor_queue(entry, req)
         if not status.ok():
             self.handles.mark_done(handle, status, None)
@@ -375,13 +423,12 @@ class Engine:
         rt = RequestType.ADASUM if op == ReduceOp.ADASUM else RequestType.ALLREDUCE
         if op == ReduceOp.ADASUM and self.size & (self.size - 1):
             raise ValueError("Adasum requires a power-of-2 number of ranks")
-        if op in (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT):
-            raise NotImplementedError(
-                "MIN/MAX/PRODUCT eager allreduce lands with the C++ engine; "
-                "use the traced path"
-            )
+        reduce_op = op if op in (
+            ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT
+        ) else ReduceOp.SUM
         return self._enqueue(
-            rt, np.asarray(arr), self._auto_name("allreduce", name), 0, prescale, postscale
+            rt, np.asarray(arr), self._auto_name("allreduce", name), 0,
+            prescale, postscale, reduce_op=reduce_op,
         )
 
     def enqueue_allgather(self, arr: np.ndarray, name: Optional[str] = None) -> int:

@@ -29,6 +29,7 @@ def _request_key(req: Request) -> Tuple:
         req.root_rank,
         req.prescale_factor,
         req.postscale_factor,
+        req.reduce_op,
     )
 
 

@@ -110,8 +110,10 @@ def DistributedOptimizer(
             self._hvd_count += 1
             if self._hvd_count % k:
                 return None
+            # Accumulated passes are NOT rescaled by 1/k: the effective
+            # batch grows, matching the reference default
+            # (average_aggregated_gradients=False) and the torch adapter.
             reduced = allreduce_grads([a.value() for a in self._hvd_acc])
-            reduced = [r / float(k) for r in reduced]
             for a in self._hvd_acc:
                 a.assign(tf.zeros_like(a))
             return cls.apply(self, reduced, trainable_variables)

@@ -92,7 +92,10 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
 
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch
-        if self.staircase:
+        # Smooth schedules without a known steps_per_epoch still update
+        # once per epoch — never silently skip (the reference derives
+        # steps from Keras params; ref: _keras/callbacks.py:117-136).
+        if self.staircase or not self.steps_per_epoch:
             self._set_lr(epoch)
 
     def on_batch_begin(self, batch, logs=None):

@@ -122,7 +122,18 @@ def discover_tpu_hosts() -> Optional[List[HostInfo]]:
     """TPU-VM slice topology → hosts (one slot per host process; chips
     are addressed through the jax mesh, not extra ranks). Returns None
     off-TPU. (Replaces the reference's ssh+NIC probing,
-    ref: runner/driver/driver_service.py:124-192, per SURVEY.md §5.8.)"""
+    ref: runner/driver/driver_service.py:124-192, per SURVEY.md §5.8.)
+
+    Detection order: Cloud TPU VM metadata env (TPU_WORKER_HOSTNAMES,
+    set on every worker of a pod slice), then an initialized
+    jax.distributed process group."""
+    import os
+
+    names = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if names:
+        hosts = [h.strip() for h in names.split(",") if h.strip()]
+        if len(hosts) > 1:
+            return [HostInfo(h, 1) for h in hosts]
     try:
         import jax
 

@@ -264,14 +264,54 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-discovery-script", default=None)
     p.add_argument("--slots-per-host", type=int, default=None)
     p.add_argument("--reset-limit", type=int, default=None)
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of flag defaults "
+                   "(ref: horovodrun --config-file, launch.py:212+)")
     config_parser.add_engine_args(p)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command, e.g. python train.py")
     return p
 
 
+def _apply_config_file(parser: argparse.ArgumentParser, args):
+    """Fill unset args from a YAML config file: flat `dest: value`
+    mapping, with nested sections flattened (`a: {b-c: 1}` → dest
+    `b_c`), mirroring the reference's config-file layering where CLI
+    flags win over file values (ref: launch.py:212+,
+    runner/common/util/config_parser.py)."""
+    import yaml
+
+    with open(args.config_file) as f:
+        data = yaml.safe_load(f) or {}
+    flat = {}
+
+    def walk(d):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                walk(v)
+            else:
+                flat[str(k).replace("-", "_")] = v
+
+    walk(data)
+    known = {a.dest for a in parser._actions}
+    unknown = sorted(set(flat) - known)
+    if unknown:
+        raise SystemExit(
+            f"hvdrun: unknown config-file keys: {', '.join(unknown)}"
+        )
+    for dest, val in flat.items():
+        # Fill only values still at their parser default — an explicit
+        # CLI `0` must not be clobbered (0 == False would match a
+        # naive None/False sentinel check).
+        if getattr(args, dest, None) == parser.get_default(dest):
+            setattr(args, dest, val)
+
+
 def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(parser, args)
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]
@@ -291,8 +331,24 @@ def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     elif args.hosts:
         hosts = parse_hosts(args.hosts)
     else:
-        np_ = args.num_proc or 1
-        hosts = [HostInfo("localhost", np_)]
+        # No explicit hosts: auto-detect TPU-VM slice topology (one
+        # worker process per pod host; SURVEY.md §5.8 — slice metadata
+        # replaces the reference's ssh+NIC probing). Engage only when
+        # the requested -np fits the slice (np unset, or one rank per
+        # pod host); otherwise keep the historical local launch so
+        # `hvdrun -np 4` on a pod worker still runs 4 local processes.
+        from .hosts import discover_tpu_hosts
+
+        hosts = discover_tpu_hosts()
+        if hosts and args.num_proc not in (None, len(hosts)):
+            hosts = None
+        if hosts:
+            if args.verbose:
+                print(f"hvdrun: discovered TPU slice hosts: "
+                      f"{','.join(h.hostname for h in hosts)}")
+        else:
+            np_ = args.num_proc or 1
+            hosts = [HostInfo("localhost", np_)]
     np_ = args.num_proc or sum(h.slots for h in hosts)
     slots = get_host_assignments(hosts, np_, np_)
     if args.verbose:

@@ -40,6 +40,8 @@ from ..common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
 from . import compression as _compression_mod
 from .compression import Compression  # noqa: F401
 from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from .elastic import TorchState  # noqa: F401
 
 
 def _to_numpy(tensor) -> np.ndarray:
@@ -157,15 +159,55 @@ def _sync_single(tensor, op: ReduceOp, prescale, postscale):
     return _from_numpy(arr * prescale * postscale, tensor).reshape(tensor.shape)
 
 
-def allreduce(tensor, average=None, name=None, op=None,
-              prescale_factor=1.0, postscale_factor=1.0):
-    rop = _resolve_op(op, average)
+def _allreduce_impl(tensor, name, rop, prescale_factor, postscale_factor):
     if _basics.size() == 1:
         return _sync_single(tensor, rop, prescale_factor, postscale_factor)
     return synchronize(
         allreduce_async(tensor, None, name, rop, prescale_factor,
                         postscale_factor)
     )
+
+
+class _HorovodAllreduce:
+    """Autograd bridge: backward of allreduce is allreduce of the
+    cotangent with the same op (ref: torch/mpi_ops.py:161-177
+    HorovodAllreduce autograd Function)."""
+
+    _cls = None
+
+    @classmethod
+    def get(cls):
+        if cls._cls is None:
+            import torch
+
+            class F(torch.autograd.Function):
+                @staticmethod
+                def forward(ctx, tensor, name, rop, pre, post):
+                    ctx.hvd_args = (name, rop, pre, post)
+                    return _allreduce_impl(tensor, name, rop, pre, post)
+
+                @staticmethod
+                def backward(ctx, grad_output):
+                    name, rop, pre, post = ctx.hvd_args
+                    g = _allreduce_impl(
+                        grad_output.contiguous(),
+                        f"{name}.grad" if name else None, rop, pre, post,
+                    )
+                    return g, None, None, None, None
+
+            cls._cls = F
+        return cls._cls
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    rop = _resolve_op(op, average)
+    if getattr(tensor, "requires_grad", False):
+        return _HorovodAllreduce.get().apply(
+            tensor, name, rop, prescale_factor, postscale_factor
+        )
+    return _allreduce_impl(tensor, name, rop, prescale_factor,
+                           postscale_factor)
 
 
 def allreduce_(tensor, average=None, name=None, op=None,

@@ -1,0 +1,48 @@
+"""Elastic state for torch models
+(ref: horovod/torch/elastic.py:51-84 TorchState).
+
+In-memory deepcopy save/restore + rank-0 broadcast sync of model and
+optimizer state_dicts, composing with the shared ObjectState for scalar
+attributes (epoch/batch), per the reference's contract.
+"""
+from __future__ import annotations
+
+import copy
+
+from ..elastic.state import ObjectState
+
+
+class TorchState(ObjectState):
+    """(ref: torch/elastic.py:51-84)"""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_model_state = None
+        self._saved_opt_state = None
+        super().__init__(**kwargs)
+
+    def save(self):
+        if self.model is not None:
+            self._saved_model_state = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._saved_opt_state = copy.deepcopy(self.optimizer.state_dict())
+        super().save()
+
+    def restore(self):
+        if self.model is not None and self._saved_model_state is not None:
+            self.model.load_state_dict(self._saved_model_state)
+        if self.optimizer is not None and self._saved_opt_state is not None:
+            self.optimizer.load_state_dict(self._saved_opt_state)
+        super().restore()
+
+    def sync(self):
+        from . import broadcast_object, broadcast_parameters
+
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            from . import broadcast_optimizer_state
+
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        super().sync()

@@ -1,29 +1,31 @@
 """DistributedOptimizer for torch — hook-fired async allreduce of grads
 with synchronization in step() (ref: horovod/torch/optimizer.py:32-207,
 factory at :337-414).
+
+The wrapper is a dynamic subclass of the wrapped optimizer's own class
+(the reference's pattern, ref: optimizer.py:337-356), so
+`isinstance(opt, torch.optim.Optimizer)` holds and
+`torch.optim.lr_scheduler` accepts it. It aliases the wrapped
+instance's state (shared __dict__), overriding step/zero_grad and
+adding synchronize/skip_synchronize.
 """
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+from contextlib import contextmanager
 
 from ..common import basics as _basics
 from ..common.types import ReduceOp
 from .compression import Compression
 
 
-class _DistributedOptimizer:
-    """Proxy wrapping a torch.optim.Optimizer. Gradients are allreduced
-    asynchronously as they become ready (post-accumulate-grad hooks, the
-    engine overlapping communication with the rest of backward — the
-    reference's core trick) and joined in step()."""
+class _DistributedMixin:
+    """Methods grafted onto the dynamic subclass."""
 
-    def __init__(self, optimizer, named_parameters=None,
-                 compression=Compression.none,
-                 backward_passes_per_step: int = 1,
-                 op: ReduceOp = ReduceOp.AVERAGE,
-                 prescale_factor: float = 1.0,
-                 postscale_factor: float = 1.0):
-        self._opt = optimizer
+    def _hvd_init(self, optimizer, named_parameters, compression,
+                  backward_passes_per_step, op, prescale_factor,
+                  postscale_factor):
+        object.__setattr__(self, "__dict__", optimizer.__dict__)
+        self._hvd_opt_cls = type(optimizer)
         self._compression = compression
         self._op = op
         self._prescale = prescale_factor
@@ -51,19 +53,7 @@ class _DistributedOptimizer:
         if _basics.size() > 1:
             self._register_hooks(p for _, p in named)
 
-    # -- attribute proxying ------------------------------------------------
-    def __getattr__(self, item):
-        return getattr(self._opt, item)
-
-    @property
-    def param_groups(self):
-        return self._opt.param_groups
-
-    @property
-    def state(self):
-        return self._opt.state
-
-    # ----------------------------------------------------------------------
+    # ------------------------------------------------------------------
     def _register_hooks(self, params):
         for p in params:
             if not p.requires_grad:
@@ -124,8 +114,6 @@ class _DistributedOptimizer:
         self._handles.clear()
         self._synchronized = True
 
-    from contextlib import contextmanager
-
     @contextmanager
     def skip_synchronize(self):
         """For manual synchronize() + grad clipping before step()
@@ -144,19 +132,13 @@ class _DistributedOptimizer:
         self._synchronized = False
         if not boundary:
             return None
-        return self._opt.step(closure)
+        return self._hvd_opt_cls.step(self, closure)
 
     def zero_grad(self, *a, **kw):
         if self._passes % self.backward_passes_per_step != 0:
             # Keep accumulating locally between boundaries.
             return None
-        return self._opt.zero_grad(*a, **kw)
-
-    def state_dict(self):
-        return self._opt.state_dict()
-
-    def load_state_dict(self, sd):
-        return self._opt.load_state_dict(sd)
+        return self._hvd_opt_cls.zero_grad(self, *a, **kw)
 
 
 def DistributedOptimizer(optimizer, named_parameters=None,
@@ -166,7 +148,15 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0):
     """(ref: horovod/torch/optimizer.py:337-414)"""
-    return _DistributedOptimizer(
-        optimizer, named_parameters, compression, backward_passes_per_step,
-        op, prescale_factor, postscale_factor,
-    )
+    base_cls = type(optimizer)
+    members = {
+        k: v for k, v in vars(_DistributedMixin).items()
+        if not k.startswith("__")
+    }
+    cls = type(f"Distributed{base_cls.__name__}", (base_cls,), members)
+
+    inst = cls.__new__(cls)
+    inst._hvd_init(optimizer, named_parameters, compression,
+                   backward_passes_per_step, op, prescale_factor,
+                   postscale_factor)
+    return inst

@@ -1,0 +1,94 @@
+"""Cross-rank synchronized BatchNorm for torch
+(ref: horovod/torch/sync_batch_norm.py:30-199 — allreduce of batch
+mean/var so every rank normalizes with global statistics).
+
+The reference implements a custom autograd Function with
+allgather+allreduce in forward/backward. Here the cross-rank moments
+ride the engine's allreduce; gradients flow through the local
+normalization (the moment statistics are treated as constants w.r.t.
+the graph on other ranks, the standard sync-BN approximation for the
+mean/var terms is preserved by autograd on the local contributions).
+"""
+from __future__ import annotations
+
+import torch
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Drop-in replacement for torch.nn.BatchNorm*d in process mode
+    (ref: sync_batch_norm.py:30-77)."""
+
+    # Deterministic per-instance id: construction order is identical
+    # across ranks (same model code), while id(self) is not — collective
+    # names must match cross-rank or negotiation never completes.
+    _instances = 0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._sbn_id = SyncBatchNorm._instances
+        SyncBatchNorm._instances += 1
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)"
+            )
+
+    def forward(self, input):
+        from ..common import basics as _basics
+
+        if (not self.training) or _basics.size() == 1:
+            return super().forward(input)
+
+        import horovod_tpu.torch as hvd
+
+        self._check_input_dim(input)
+        dims = [0] + list(range(2, input.dim()))
+        count = input.numel() // input.shape[1]
+
+        # Global moments via allreduce of E[x], E[x^2] weighted by count
+        # (ref: sync_batch_norm.py _SyncBatchNorm forward).
+        mean = input.mean(dims)
+        sq = (input * input).mean(dims)
+        counts = hvd.allreduce(
+            torch.tensor([float(count)]), op=hvd.Sum,
+            name=f"sbn.{self._sbn_id}.count",
+        )
+        total = float(counts.item())
+        # Differentiable allreduce: gradients flow back through the
+        # batch statistics (backward = allreduce of the cotangent), so
+        # the -dmu/dx and -dvar/dx terms survive like the reference's
+        # custom Function backward (ref: sync_batch_norm.py:80-160).
+        g_mean = hvd.allreduce(
+            mean * (count / total), op=hvd.Sum,
+            name=f"sbn.{self._sbn_id}.mean",
+        )
+        g_sq = hvd.allreduce(
+            sq * (count / total), op=hvd.Sum,
+            name=f"sbn.{self._sbn_id}.sq",
+        )
+        var = g_sq - g_mean * g_mean
+
+        if self.momentum is None:
+            momentum = 0.0
+        else:
+            momentum = self.momentum
+        if self.track_running_stats:
+            with torch.no_grad():
+                unbiased = var * (total / max(total - 1, 1))
+                self.running_mean.mul_(1 - momentum).add_(
+                    g_mean * momentum
+                )
+                self.running_var.mul_(1 - momentum).add_(
+                    unbiased * momentum
+                )
+                if self.num_batches_tracked is not None:
+                    self.num_batches_tracked += 1
+
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        out = (input - g_mean.view(shape)) / torch.sqrt(
+            var.view(shape) + self.eps
+        )
+        if self.affine:
+            out = out * self.weight.view(shape) + self.bias.view(shape)
+        return out

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Minimal CI pipeline (ref: .buildkite/gen-pipeline.sh:10-27 runs the
+# test suite across framework combos; this single-node variant runs the
+# full suite, the multichip sharding dryrun, and a CPU bench smoke).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== unit + integration tests ==="
+python -m pytest tests/ -x -q
+
+echo "=== multichip sharding dryrun (8 virtual devices) ==="
+python __graft_entry__.py
+
+echo "=== bench smoke (CPU) ==="
+python bench.py --cpu --no-scaling
+
+echo "CI OK"

@@ -185,3 +185,44 @@ def test_hvdrun_cli_end_to_end(tmp_path):
     )
     assert out.returncode == 0, out.stderr
     assert "[0]<stdout>:" in out.stdout and "[1]<stdout>:" in out.stdout
+
+
+def test_config_file_yaml(tmp_path):
+    """YAML config fills unset flags; CLI wins; unknown keys rejected
+    (ref: horovodrun --config-file, launch.py:212+)."""
+    from horovod_tpu.runner.launch import make_parser, _apply_config_file
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "num-proc: 4\ntuning:\n  fusion-threshold-mb: 8\n  cycle-time-ms: 2\n"
+    )
+    parser = make_parser()
+    args = parser.parse_args(
+        ["--config-file", str(cfg), "--cycle-time-ms", "9", "x"]
+    )
+    _apply_config_file(parser, args)
+    assert args.num_proc == 4
+    assert args.fusion_threshold_mb == 8
+    assert args.cycle_time_ms == 9  # CLI beats file
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("not-a-flag: 1\n")
+    args2 = parser.parse_args(["--config-file", str(bad), "x"])
+    try:
+        _apply_config_file(parser, args2)
+        assert False, "unknown key accepted"
+    except SystemExit as e:
+        assert "not_a_flag" in str(e)
+
+
+def test_discover_tpu_hosts_env(monkeypatch):
+    """TPU-VM slice metadata drives host discovery (SURVEY.md §5.8)."""
+    from horovod_tpu.runner.hosts import discover_tpu_hosts
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "tpu-a,tpu-b,tpu-c")
+    hosts = discover_tpu_hosts()
+    assert [h.hostname for h in hosts] == ["tpu-a", "tpu-b", "tpu-c"]
+    assert all(h.slots == 1 for h in hosts)
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "solo")
+    assert discover_tpu_hosts() is None  # single host -> not a pod

@@ -33,3 +33,51 @@ def test_timeline_writes_valid_chrome_trace(tmp_path, monkeypatch):
     for e in events:
         assert e["ph"] in ("B", "E", "i")
         assert "ts" in e and "tid" in e
+
+
+def test_timeline_phase_nesting(tmp_path, monkeypatch):
+    """The per-tensor state machine must match the reference:
+    NEGOTIATE_<OP> (with per-rank ready instants inside) closes before
+    the top-level op phase opens; activities nest inside the op phase
+    (ref: timeline.h:81-126 NEGOTIATING->TOP_LEVEL->ACTIVITY)."""
+    path = tmp_path / "timeline.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+
+    def fn(eng, rank):
+        eng.synchronize(
+            eng.enqueue_allreduce(np.ones(4, np.float32), name="nest"),
+            timeout=30)
+        return True
+
+    run_ranks(2, fn)
+    events = json.loads(path.read_text())
+
+    # Find the lane (tid) carrying the allreduce.* tensor negotiation.
+    neg_b = [e for e in events
+             if e["ph"] == "B" and e["name"] == "NEGOTIATE_ALLREDUCE"]
+    assert neg_b, events
+    tid = neg_b[0]["tid"]
+    lane = [e for e in events if e["tid"] == tid]
+
+    # Phase sequence on the lane: NEGOTIATE B ... rank instants ... E,
+    # then op B ... activities ... E, with balanced B/E throughout.
+    seq = [(e["ph"], e.get("name")) for e in lane]
+    i_neg_b = seq.index(("B", "NEGOTIATE_ALLREDUCE"))
+    i_op_b = seq.index(("B", "ALLREDUCE"))
+    assert i_neg_b < i_op_b
+    # rank-ready instants for both ranks land inside negotiation
+    ready = [i for i, (ph, nm) in enumerate(seq)
+             if ph == "i" and nm in ("0", "1")]
+    assert len(ready) >= 2
+    neg_e = seq.index(("E", "NEGOTIATE_ALLREDUCE"))
+    assert all(i_neg_b < i < i_op_b for i in ready[:2])
+    assert i_neg_b < neg_e <= i_op_b
+    # B/E balance on the lane (activities nested in op phase)
+    depth = 0
+    for ph, _ in seq:
+        if ph == "B":
+            depth += 1
+        elif ph == "E":
+            depth -= 1
+            assert depth >= 0
+    assert depth == 0, seq
