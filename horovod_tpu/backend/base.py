@@ -29,6 +29,23 @@ class Backend(ControllerTransport):
 
     rank: int = 0
     size: int = 1
+    # Host topology (ref: Controller rank/local_rank/cross_rank state,
+    # controller.h:172-188). Set by the engine via set_topology(); the
+    # hierarchical data plane needs it.
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+    # Hierarchical allreduce toggle (ref: HOROVOD_HIERARCHICAL_ALLREDUCE,
+    # operations.cc:416-513; autotune may flip it at sync boundaries).
+    hierarchical: bool = False
+
+    def set_topology(self, local_rank: int, local_size: int,
+                     cross_rank: int, cross_size: int):
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
 
     # -- data plane -----------------------------------------------------
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:

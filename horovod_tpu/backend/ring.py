@@ -59,18 +59,30 @@ class RingCollectivesMixin(StarCollectivesMixin):
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         if self.size == 1:
             return arr.copy()
-        if (
-            not self._ring_enabled()
-            or op not in _RING_OPS
-            or arr.nbytes < self._ring_threshold()
-        ):
+        if not self._ring_enabled() or op not in _RING_OPS:
             return super().allreduce(arr, op)
         # No eligibility exchange is needed: allreduce sizes are
         # negotiated by the coordinator, so every rank (including joined
         # ranks, which the engine hands full-shape zero buffers) holds
         # the same element count and reaches the same ring/star decision
-        # from its own arr.nbytes.
+        # from its own arr.nbytes. The hierarchical toggle flips only at
+        # autotune sync boundaries, collectively.
+        if arr.nbytes < self._ring_threshold():
+            return super().allreduce(arr, op)  # star: latency-optimal
+        if self.hierarchical and self._hierarchy_valid():
+            return self._hierarchical_allreduce(arr, op)
         return self._ring_allreduce(arr, op)
+
+    def _hierarchy_valid(self) -> bool:
+        """Hierarchical needs a homogeneous contiguous host packing
+        (rank == cross_rank*local_size + local_rank), like the
+        reference's is_homogeneous gate (nccl_operations.cc:190-405)."""
+        return (
+            self.local_size > 1
+            and self.cross_size > 1
+            and self.size == self.local_size * self.cross_size
+            and self.rank == self.cross_rank * self.local_size + self.local_rank
+        )
 
     # ------------------------------------------------------------------
     def _sendrecv(self, dest: int, payload: bytes, src: int) -> bytes:
@@ -93,38 +105,100 @@ class RingCollectivesMixin(StarCollectivesMixin):
             raise err[0]
         return data
 
-    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
-        n = self.size
-        right = (self.rank + 1) % n
-        left = (self.rank - 1) % n
-        flat = np.ascontiguousarray(arr).reshape(-1).copy()
-        # Chunk boundaries (last chunk absorbs the remainder).
-        base = flat.size // n
-        bounds = [i * base for i in range(n)] + [flat.size]
+    # -- group-parameterized ring phases -------------------------------
+    # `group` is the ordered list of global ranks forming the ring; this
+    # rank's position is group.index(self.rank). With group == all ranks
+    # this is the flat ring; the hierarchical path runs the same phases
+    # over the local and cross subgroups (disjoint socket pairs, so
+    # concurrent subgroup rings never interleave frames).
+
+    @staticmethod
+    def _bounds(total: int, n: int) -> List[int]:
+        base = total // n
+        return [i * base for i in range(n)] + [total]
+
+    def _ring_reduce_scatter(self, group: List[int], flat: np.ndarray,
+                             op: ReduceOp):
+        """In-place ring reduce-scatter over `group`. On return, the rank
+        at position p holds group-chunk (p+1)%n fully reduced (ref: gloo
+        ring reduce-scatter schedule, gloo_operations.cc:119-166)."""
+        n = len(group)
+        pos = group.index(self.rank)
+        right, left = group[(pos + 1) % n], group[(pos - 1) % n]
+        bounds = self._bounds(flat.size, n)
 
         def chunk(i):
             i %= n
             return flat[bounds[i]: bounds[i + 1]]
 
-        # Phase 1: reduce-scatter. After step s, chunk (r-s-1) holds the
-        # partial reduction of s+2 ranks; after N-1 steps chunk (r+1) is
-        # fully reduced here (ref: gloo ring reduce-scatter schedule).
         for s in range(n - 1):
-            send_c = chunk(self.rank - s)
+            send_c = chunk(pos - s)
             recv_buf = self._sendrecv(right, send_c.tobytes(), left)
             incoming = np.frombuffer(recv_buf, dtype=flat.dtype)
-            tgt = chunk(self.rank - s - 1)
+            tgt = chunk(pos - s - 1)
             tgt[:] = _reduce(
                 op if op != ReduceOp.AVERAGE else ReduceOp.SUM,
                 [tgt, incoming],
             )
 
-        # Phase 2: allgather the reduced chunks around the ring.
+    def _ring_allgather_chunks(self, group: List[int], flat: np.ndarray):
+        """Ring allgather of the per-position chunks: position p starts
+        owning chunk (p+1)%n; after n-1 rotations every rank holds all."""
+        n = len(group)
+        pos = group.index(self.rank)
+        right, left = group[(pos + 1) % n], group[(pos - 1) % n]
+        bounds = self._bounds(flat.size, n)
+
+        def chunk(i):
+            i %= n
+            return flat[bounds[i]: bounds[i + 1]]
+
         for s in range(n - 1):
-            send_c = chunk(self.rank - s + 1)
+            send_c = chunk(pos - s + 1)
             recv_buf = self._sendrecv(right, send_c.tobytes(), left)
-            chunk(self.rank - s)[:] = np.frombuffer(recv_buf, dtype=flat.dtype)
+            chunk(pos - s)[:] = np.frombuffer(recv_buf, dtype=flat.dtype)
+
+    def _ring_allreduce_group(self, group: List[int], flat: np.ndarray,
+                              op: ReduceOp):
+        self._ring_reduce_scatter(group, flat, op)
+        self._ring_allgather_chunks(group, flat)
+
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+        self._ring_allreduce_group(list(range(self.size)), flat, op)
+        if op == ReduceOp.AVERAGE:
+            flat = (flat / self.size).astype(arr.dtype)
+        return flat.reshape(arr.shape)
+
+    def _hierarchical_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+        """Local reduce-scatter -> cross allreduce per slice -> local
+        allgather (ref: NCCLHierarchicalAllreduce's ReduceScatter /
+        cross-MPI_Allreduce / AllGather shape, nccl_operations.cc:190-405;
+        here the cross phase rides the DCN-equivalent links while each
+        local ring stays on its host's links)."""
+        L = self.local_size
+        base = self.cross_rank * L
+        local_group = list(range(base, base + L))
+        cross_group = [self.local_rank + h * L for h in range(self.cross_size)]
+        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+
+        # Phase A: local reduce-scatter; position local_rank ends owning
+        # local chunk (local_rank+1)%L, reduced across the host.
+        self._ring_reduce_scatter(local_group, flat, op)
+
+        # Phase B: cross-host ring allreduce on the owned slice only —
+        # every local rank drives its own cross ring concurrently, so
+        # cross bandwidth scales with local_size like the reference's
+        # parallel per-local-rank MPI_Allreduce slices.
+        bounds = self._bounds(flat.size, L)
+        own = (self.local_rank + 1) % L
+        own_slice = flat[bounds[own]: bounds[own + 1]]
+        if own_slice.size:
+            self._ring_allreduce_group(cross_group, own_slice, op)
+
+        # Phase C: local allgather of the fully reduced chunks.
+        self._ring_allgather_chunks(local_group, flat)
 
         if op == ReduceOp.AVERAGE:
-            flat = (flat / n).astype(arr.dtype)
+            flat = (flat / self.size).astype(arr.dtype)
         return flat.reshape(arr.shape)

@@ -14,7 +14,7 @@ import struct
 import threading
 from typing import List, Optional
 
-from .star import StarCollectivesMixin
+from .ring import RingCollectivesMixin
 
 
 class ThreadedGroup:
@@ -22,12 +22,18 @@ class ThreadedGroup:
         self.size = size
         self.up = [queue.Queue() for _ in range(size)]    # rank -> root
         self.down = [queue.Queue() for _ in range(size)]  # root -> rank
+        # Point-to-point channels keyed (src, dst) — the queue analogue
+        # of the TCP mesh's per-pair sockets (ring/hierarchical planes).
+        self.p2p = {
+            (s_, d): queue.Queue()
+            for s_ in range(size) for d in range(size) if s_ != d
+        }
 
     def backend(self, rank: int) -> "ThreadedBackend":
         return ThreadedBackend(self, rank)
 
 
-class ThreadedBackend(StarCollectivesMixin):
+class ThreadedBackend(RingCollectivesMixin):
     def __init__(self, group: ThreadedGroup, rank: int):
         self.group = group
         self.rank = rank
@@ -66,3 +72,10 @@ class ThreadedBackend(StarCollectivesMixin):
             return payloads[0]
         return self.group.down[self.rank].get(timeout=60)
 
+
+    # -- p2p primitives (ring/hierarchical data planes) ----------------
+    def send_to(self, peer: int, payload: bytes):
+        self.group.p2p[(self.rank, peer)].put(payload)
+
+    def recv_from(self, peer: int) -> bytes:
+        return self.group.p2p[(peer, self.rank)].get(timeout=60)

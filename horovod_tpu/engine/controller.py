@@ -91,7 +91,7 @@ class Controller:
         self.rank = rank
         self.is_coordinator = rank == 0
         self.response_cache = ResponseCache(env_cfg.cache_capacity())
-        self.cache_enabled = env_cfg.get_int(env_cfg.CACHE_CAPACITY, 1) != 0
+        self.cache_enabled = env_cfg.cache_enabled()
         self.fusion_threshold = env_cfg.fusion_threshold_bytes()
         self.stall_inspector = StallInspector(size)
         # Coordinator state

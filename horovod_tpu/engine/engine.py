@@ -153,6 +153,8 @@ class Engine:
 
                 self.backend = TcpBackend(self.rank, self.size,
                                           scope=self._scope)
+            self.backend.set_topology(self.local_rank, self.local_size,
+                                      self.cross_rank, self.cross_size)
             self.controller = Controller(self.backend, self.size, self.rank,
                                          timeline=self.timeline)
             from .parameter_manager import ParameterManager
@@ -166,6 +168,28 @@ class Engine:
             return
         self._initialized.set()
         try:
+            # Hierarchical allreduce requires every rank to take the
+            # same data-plane path, so validity (homogeneous contiguous
+            # host packing) is agreed collectively — a single bitwise
+            # AND word, like the reference's is_homogeneous check at
+            # controller init (mpi_controller.cc:26-82). Runs after
+            # _initialized so start() stays non-collective; every rank's
+            # background thread performs it before its first cycle.
+            self._hier_valid = False
+            if self.size > 1 and hasattr(self.backend, "_hierarchy_valid"):
+                word = 1 if self.backend._hierarchy_valid() else 0
+                self._hier_valid = bool(
+                    self.backend.allreduce_words([word], "and")[0] & 1
+                )
+            # Static toggle (ref: HOROVOD_HIERARCHICAL_ALLREDUCE,
+            # operations.cc:468-478); autotune may flip it later at
+            # parameter-sync boundaries.
+            self.backend.hierarchical = self._hier_valid and env_cfg.get_bool(
+                env_cfg.HIERARCHICAL_ALLREDUCE, False
+            )
+            # Arms rebuild happens before the first cycle, hence before
+            # any sample window can open.
+            self.param_manager.set_tune_hierarchical(self._hier_valid)
             while self._run_loop_once():
                 pass
         except BaseException as e:
@@ -186,11 +210,14 @@ class Engine:
         resp_list, should_shutdown = self.controller.compute_response_list(
             messages, shutdown=want_shutdown
         )
-        for resp in resp_list.responses:
-            self._perform_operation(resp)
         # Autotune (ref: operations.cc:592-600): windows are counted in
         # response cycles, identical on all ranks, so the parameter-sync
-        # broadcast below lines up as a collective.
+        # broadcast below lines up as a collective. It runs BEFORE this
+        # cycle's completion callbacks fire so that when a caller is
+        # unblocked by any handle completed in this cycle, the tuner
+        # state (notably `done`) is already identical on every rank —
+        # otherwise two ranks polling `done` after each op can observe
+        # the flip one op apart and desync their enqueue streams.
         if (self.param_manager is not None and not self.param_manager.done
                 and resp_list.responses):
             nbytes = sum(
@@ -208,6 +235,15 @@ class Engine:
                     self.param_manager.fusion_threshold
                 )
                 self.cycle_time_s = self.param_manager.cycle_time_ms / 1000.0
+                # Categorical toggles land collectively at the same
+                # boundary on every rank (ref: parameter_manager.h:163-228
+                # hierarchical/cache CategoricalParameterChains).
+                self.controller.cache_enabled = self.param_manager.cache_enabled
+                self.backend.hierarchical = (
+                    self._hier_valid and self.param_manager.hierarchical
+                )
+        for resp in resp_list.responses:
+            self._perform_operation(resp)
         if should_shutdown:
             self.tensor_queue.finalize(Status.Aborted("Horovod has been shut down."))
             return False

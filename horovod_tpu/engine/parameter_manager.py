@@ -8,6 +8,12 @@ Controller::SynchronizeParameters, controller.cc:34-48. Enabled by
 HOROVOD_AUTOTUNE, CSV log via HOROVOD_AUTOTUNE_LOG,
 operations.cc:497-507.)
 
+Categorical knobs (ref: parameter_manager.h:163-228 tunes
+hierarchical_allreduce and cache_enabled as CategoricalParameterChains):
+the tuner enumerates (hierarchical, cache) arms round-robin, each arm
+carrying its own GP over the continuous (fusion, cycle) box; the final
+pick is the best-scoring (arm, fusion, cycle) triple seen.
+
 Only rank 0 tunes; every cycle the engine reports processed bytes, and
 at window boundaries rank 0 either registers the score + proposes the
 next sample (still tuning) or pins the best-seen parameters (done).
@@ -42,6 +48,8 @@ class ParameterManager:
         cycles_per_sample: int = 10,
         max_samples: int = 20,
         log_path: Optional[str] = None,
+        tune_hierarchical: bool = False,
+        tune_cache: bool = True,
     ):
         self.enabled = (
             env_cfg.get_bool(env_cfg.AUTOTUNE, False)
@@ -52,9 +60,6 @@ class ParameterManager:
         self.cycles_per_sample = cycles_per_sample
         self.max_samples = max_samples
         self.done = not self.enabled
-        self._bo = BayesianOptimization(
-            [FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS]
-        )
         self._samples = 0
         self._warmups_left = warmup_samples
         self._cycle_count = 0
@@ -62,19 +67,62 @@ class ParameterManager:
         self._window_start = time.monotonic()
         self.fusion_threshold = env_cfg.fusion_threshold_bytes()
         self.cycle_time_ms = env_cfg.cycle_time_ms()
+        self.hierarchical = env_cfg.get_bool(
+            env_cfg.HIERARCHICAL_ALLREDUCE, False
+        )
+        self.cache_enabled = env_cfg.cache_enabled()
+        # Categorical arms: (hierarchical, cache_enabled) combos, each
+        # with its own GP over the continuous box.
+        self._tune_cache = tune_cache
+        self._build_arms(tune_hierarchical)
         self._log_path = log_path if log_path is not None else (
             env_cfg.get_str(env_cfg.AUTOTUNE_LOG) or None
         )
         if self.enabled and self.is_coordinator and self._log_path:
             with open(self._log_path, "w") as f:
-                f.write("sample,fusion_mb,cycle_ms,score_bytes_per_sec\n")
+                f.write(
+                    "sample,fusion_mb,cycle_ms,hierarchical,cache,"
+                    "score_bytes_per_sec\n"
+                )
+
+    def _build_arms(self, tune_hierarchical: bool):
+        hs = (False, True) if tune_hierarchical else (False,)
+        cs = (True, False) if self._tune_cache else (True,)
+        self._arms: List[Tuple[bool, bool]] = [
+            (h, c) for h in hs for c in cs
+        ]
+        self._arm_bo = [
+            BayesianOptimization([FUSION_MB_BOUNDS, CYCLE_MS_BOUNDS])
+            for _ in self._arms
+        ]
+        # Start on the arm matching the state the first window actually
+        # runs with (the engine's env-derived toggles), so sample 0's
+        # score is credited to the right categorical combo. If that
+        # state isn't a tunable arm (e.g. env asked hierarchical but the
+        # topology vetoed it), clamp to arm 0 — which is what the engine
+        # will run.
+        state = (self.hierarchical, self.cache_enabled)
+        if state in self._arms:
+            self._arm_idx = self._arms.index(state)
+        else:
+            self._arm_idx = 0
+            self.hierarchical, self.cache_enabled = self._arms[0]
+
+    def set_tune_hierarchical(self, eligible: bool):
+        """Rebuild the arm set once topology validity is known (the
+        engine agrees it collectively after init). Must be called before
+        the first sample window closes; no samples are lost because
+        windows only open once response cycles flow."""
+        if self._samples == 0:
+            self._build_arms(eligible)
 
     # ------------------------------------------------------------------
     def update(self, nbytes: int) -> bool:
         """Record one engine cycle's processed bytes. Returns True at a
         sync boundary — the caller must then run the collective
         parameter sync (coordinator serializes, workers apply) and
-        re-read (fusion_threshold, cycle_time_ms).
+        re-read (fusion_threshold, cycle_time_ms, hierarchical,
+        cache_enabled).
 
         Cycle/window counting is driven by response cycles, which are
         identical on every rank, so all ranks reach boundaries together
@@ -101,7 +149,7 @@ class ParameterManager:
         return True
 
     def _on_sample(self, score: float) -> bool:
-        self._bo.register(
+        self._arm_bo[self._arm_idx].register(
             [self.fusion_threshold / (1024.0 * 1024.0), self.cycle_time_ms],
             score,
         )
@@ -110,20 +158,33 @@ class ParameterManager:
                 f.write(
                     f"{self._samples},"
                     f"{self.fusion_threshold / (1024.0 * 1024.0):.2f},"
-                    f"{self.cycle_time_ms:.2f},{score:.1f}\n"
+                    f"{self.cycle_time_ms:.2f},"
+                    f"{int(self.hierarchical)},{int(self.cache_enabled)},"
+                    f"{score:.1f}\n"
                 )
         self._samples += 1
         if self._samples >= self.max_samples:
-            best, best_y = self._bo.best
-            self.fusion_threshold = int(best[0] * 1024 * 1024)
-            self.cycle_time_ms = float(best[1])
+            best_arm, best_x, best_y = 0, None, -np.inf
+            for i, bo in enumerate(self._arm_bo):
+                x, y = bo.best  # (None, -inf) when the arm is unsampled
+                if x is not None and y > best_y:
+                    best_arm, best_x, best_y = i, x, y
+            if best_x is not None:
+                self.fusion_threshold = int(best_x[0] * 1024 * 1024)
+                self.cycle_time_ms = float(best_x[1])
+                self.hierarchical, self.cache_enabled = self._arms[best_arm]
             self.done = True
             logger.info(
-                "autotune done: fusion=%.1fMB cycle=%.2fms (%.0f bytes/s)",
-                best[0], best[1], best_y,
+                "autotune done: fusion=%.1fMB cycle=%.2fms hier=%s cache=%s "
+                "(%.0f bytes/s)",
+                self.fusion_threshold / 1048576.0, self.cycle_time_ms,
+                self.hierarchical, self.cache_enabled, best_y,
             )
             return True
-        nxt = self._bo.next_sample()
+        # Rotate to the next arm and draw its next continuous sample.
+        self._arm_idx = (self._arm_idx + 1) % len(self._arms)
+        self.hierarchical, self.cache_enabled = self._arms[self._arm_idx]
+        nxt = self._arm_bo[self._arm_idx].next_sample()
         self.fusion_threshold = int(nxt[0] * 1024 * 1024)
         self.cycle_time_ms = float(nxt[1])
         return True
@@ -134,6 +195,8 @@ class ParameterManager:
         return json.dumps({
             "fusion_threshold": self.fusion_threshold,
             "cycle_time_ms": self.cycle_time_ms,
+            "hierarchical": self.hierarchical,
+            "cache_enabled": self.cache_enabled,
             "done": self.done,
         }).encode()
 
@@ -141,4 +204,6 @@ class ParameterManager:
         d = json.loads(payload.decode())
         self.fusion_threshold = int(d["fusion_threshold"])
         self.cycle_time_ms = float(d["cycle_time_ms"])
+        self.hierarchical = bool(d.get("hierarchical", False))
+        self.cache_enabled = bool(d.get("cache_enabled", True))
         self.done = bool(d["done"])

@@ -92,3 +92,9 @@ def cycle_time_ms() -> float:
 
 def cache_capacity() -> int:
     return get_int(CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY)
+
+
+def cache_enabled() -> bool:
+    """HOROVOD_CACHE_CAPACITY=0 disables the response cache
+    (ref: operations.cc:455-462)."""
+    return cache_capacity() != 0

@@ -1,0 +1,258 @@
+"""Hierarchical (local/cross) eager allreduce + autotune categorical arms.
+
+(ref: NCCLHierarchicalAllreduce, nccl_operations.cc:190-405 — intra-node
+reduce-scatter, cross-node allreduce per slice, intra-node allgather;
+parameter_manager.h:163-228 — hierarchical/cache categorical tuning.)
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.backend.threaded import ThreadedGroup
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.engine.engine import Engine
+from horovod_tpu.engine.parameter_manager import ParameterManager
+
+
+def _run_backend_ranks(size, topo, fn):
+    """fn(backend, rank) on `size` ThreadedBackends with topology set."""
+    group = ThreadedGroup(size)
+    backends = []
+    for r in range(size):
+        b = group.backend(r)
+        lr, ls, cr, cs = topo(r)
+        b.set_topology(lr, ls, cr, cs)
+        b.hierarchical = True
+        backends.append(b)
+    results = [None] * size
+    errors = [None] * size
+
+    def worker(r):
+        try:
+            results[r] = fn(backends[r], r)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+def _topo_2x2(r):
+    # 2 hosts x 2 slots, contiguous packing: rank = cross*2 + local.
+    return (r % 2, 2, r // 2, 2)
+
+
+@pytest.mark.parametrize("n", [1, 3, 8, 1000, 4096 + 3])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_hierarchical_matches_sum(n, dtype):
+    def fn(b, r):
+        arr = (np.arange(n, dtype=dtype) + r * 10.0).reshape(-1)
+        return b._hierarchical_allreduce(arr, ReduceOp.SUM)
+
+    out = _run_backend_ranks(4, _topo_2x2, fn)
+    expect = sum(np.arange(n, dtype=dtype) + r * 10.0 for r in range(4))
+    for o in out:
+        np.testing.assert_allclose(o, expect, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op,combine", [
+    (ReduceOp.MIN, lambda xs: np.minimum.reduce(xs)),
+    (ReduceOp.MAX, lambda xs: np.maximum.reduce(xs)),
+    (ReduceOp.PRODUCT, lambda xs: np.multiply.reduce(xs)),
+    (ReduceOp.AVERAGE, lambda xs: np.add.reduce(xs) / len(xs)),
+])
+def test_hierarchical_ops(op, combine):
+    rng = np.random.RandomState(0)
+    inputs = [rng.rand(257).astype(np.float64) + 0.5 for _ in range(4)]
+
+    def fn(b, r):
+        return b._hierarchical_allreduce(inputs[r].copy(), op)
+
+    out = _run_backend_ranks(4, _topo_2x2, fn)
+    expect = combine(inputs)
+    for o in out:
+        np.testing.assert_allclose(o, expect, rtol=1e-10)
+
+
+def test_allreduce_dispatches_hierarchical(monkeypatch):
+    """backend.allreduce takes the hierarchical path when toggled, the
+    topology is valid, and the payload clears the ring threshold; it
+    falls back to star below the threshold and to flat ring on invalid
+    topology."""
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "64")
+    calls = []
+
+    def fn(b, r):
+        orig = b._hierarchical_allreduce
+
+        def spy(arr, op):
+            calls.append(r)
+            return orig(arr, op)
+
+        b._hierarchical_allreduce = spy
+        return b.allreduce(np.ones(100, np.float32), ReduceOp.SUM)
+
+    out = _run_backend_ranks(4, _topo_2x2, fn)
+    for o in out:
+        np.testing.assert_allclose(o, np.full(100, 4.0))
+    assert sorted(calls) == [0, 1, 2, 3]
+
+    # Sub-threshold payloads stay on the latency-optimal star path.
+    calls.clear()
+
+    def fn_small(b, r):
+        b._hierarchical_allreduce = lambda arr, op: calls.append(r)
+        return b.allreduce(np.ones(4, np.float32), ReduceOp.SUM)
+
+    out = _run_backend_ranks(4, _topo_2x2, fn_small)
+    for o in out:
+        np.testing.assert_allclose(o, np.full(4, 4.0))
+    assert calls == []
+
+    # Invalid topology (local_size=1): falls back to flat even when the
+    # toggle is on.
+    calls.clear()
+    out = _run_backend_ranks(4, lambda r: (0, 1, r, 4), fn)
+    for o in out:
+        np.testing.assert_allclose(o, np.full(100, 4.0))
+    assert calls == []
+
+
+def test_engine_hierarchical_end_to_end(monkeypatch):
+    """4 engines with 2x2 topology + HOROVOD_HIERARCHICAL_ALLREDUCE=1:
+    the negotiated eager path produces correct sums over the
+    hierarchical data plane (engine agrees validity collectively)."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    group = ThreadedGroup(4)
+    engines = []
+    for r in range(4):
+        e = Engine(rank=r, size=4, backend=group.backend(r),
+                   local_rank=r % 2, local_size=2,
+                   cross_rank=r // 2, cross_size=2)
+        e.cycle_time_s = 0.001
+        engines.append(e)
+    for e in engines:
+        e.start()
+
+    results = [None] * 4
+    errors = [None] * 4
+
+    def worker(r):
+        try:
+            eng = engines[r]
+            outs = []
+            for i in range(3):
+                h = eng.enqueue_allreduce(
+                    np.full(300, float(r + 1), np.float32), name=f"t{i}"
+                )
+                outs.append(eng.synchronize(h, timeout=30))
+            results[r] = outs
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # By now every loop has run (allreduces completed), so the
+    # collectively-agreed toggle is observable.
+    for e in engines:
+        assert e.backend.hierarchical, "validity agreement should pass"
+    stop = [threading.Thread(target=e.shutdown) for e in engines]
+    for t in stop:
+        t.start()
+    for t in stop:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    for r in range(4):
+        for o in results[r]:
+            np.testing.assert_allclose(o, np.full(300, 10.0))
+
+
+def test_engine_rejects_mixed_hierarchy(monkeypatch):
+    """One rank with a non-contiguous packing vetoes hierarchical on
+    every rank (collective AND), so no rank diverges onto a different
+    data-plane algorithm."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    group = ThreadedGroup(2)
+    topos = [(0, 2, 0, 1), (0, 1, 1, 2)]  # inconsistent packing
+    engines = [
+        Engine(rank=r, size=2, backend=group.backend(r),
+               local_rank=topos[r][0], local_size=topos[r][1],
+               cross_rank=topos[r][2], cross_size=topos[r][3])
+        for r in range(2)
+    ]
+    for e in engines:
+        e.cycle_time_s = 0.001
+        e.start()
+    try:
+        # Run one allreduce so both loops have passed the agreement.
+        def worker(r):
+            h = engines[r].enqueue_allreduce(
+                np.ones(4, np.float32), name="t"
+            )
+            engines[r].synchronize(h, timeout=30)
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        for e in engines:
+            assert not e.backend.hierarchical
+    finally:
+        stop = [threading.Thread(target=e.shutdown) for e in engines]
+        for t in stop:
+            t.start()
+        for t in stop:
+            t.join(timeout=60)
+
+
+def test_autotune_categorical_arms():
+    """The tuner cycles (hierarchical, cache) arms and pins the best
+    combination at the end."""
+    pm = ParameterManager(
+        is_coordinator=True, enabled=True, warmup_samples=0,
+        cycles_per_sample=1, max_samples=8, tune_hierarchical=True,
+    )
+    assert len(pm._arms) == 4
+    seen = set()
+    # Score arms so (hierarchical=True, cache=True) wins decisively.
+    while not pm.done:
+        seen.add((pm.hierarchical, pm.cache_enabled))
+        score = 100.0 if (pm.hierarchical and pm.cache_enabled) else 1.0
+        pm._on_sample(score)
+    assert seen == {(False, True), (False, False), (True, True),
+                    (True, False)}  # rotated through every arm
+    assert pm.hierarchical is True
+    assert pm.cache_enabled is True
+
+
+def test_autotune_serialize_roundtrip_categorical():
+    pm = ParameterManager(is_coordinator=True, enabled=True,
+                          tune_hierarchical=True)
+    pm.hierarchical = True
+    pm.cache_enabled = False
+    pm.fusion_threshold = 123456
+    pm.cycle_time_ms = 7.5
+    pm.done = True
+    other = ParameterManager(is_coordinator=False, enabled=True)
+    other.apply(pm.serialize())
+    assert other.hierarchical is True
+    assert other.cache_enabled is False
+    assert other.fusion_threshold == 123456
+    assert other.cycle_time_ms == 7.5
+    assert other.done is True
