@@ -2,6 +2,11 @@
 
 (ref: horovod/runner/http/http_client.py:17-45 read_data_from_kvstore /
 put_data_into_kvstore; the C++ consumer is gloo_context.cc:70-151.)
+
+Requests are HMAC-signed with the per-job secret from
+HOROVOD_SECRET_KEY when one is set (ref: the reference's service-
+protocol HMAC, runner/common/util/network.py:50-110; here extended to
+the rendezvous KV — see runner/rendezvous_server.py).
 """
 from __future__ import annotations
 
@@ -11,18 +16,37 @@ from typing import Optional
 
 
 class RendezvousClient:
-    def __init__(self, addr: str, port: int, timeout: float = 60.0):
+    def __init__(self, addr: str, port: int, timeout: float = 60.0,
+                 secret_key: Optional[bytes] = None):
         self.addr = addr
         self.port = port
         self.timeout = timeout
+        if secret_key is None:
+            from ..runner.util import secret as secret_util
+
+            secret_key = secret_util.key_from_env()
+        self.secret_key = secret_key
 
     def _conn(self) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.addr, self.port, timeout=10.0)
 
+    def _headers(self, method: str, path: str, body: bytes = b"") -> dict:
+        if self.secret_key is None:
+            return {}
+        from ..runner.rendezvous_server import sign_request
+
+        return {
+            "X-Horovod-Digest": sign_request(
+                self.secret_key, method, path, body
+            )
+        }
+
     def put(self, scope: str, key: str, value: bytes):
         c = self._conn()
+        path = f"/{scope}/{key}"
         try:
-            c.request("PUT", f"/{scope}/{key}", body=value)
+            c.request("PUT", path, body=value,
+                      headers=self._headers("PUT", path, value))
             r = c.getresponse()
             r.read()
             if r.status != 200:
@@ -32,12 +56,18 @@ class RendezvousClient:
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         c = self._conn()
+        path = f"/{scope}/{key}"
         try:
-            c.request("GET", f"/{scope}/{key}")
+            c.request("GET", path, headers=self._headers("GET", path))
             r = c.getresponse()
             body = r.read()
             if r.status == 200:
                 return body
+            if r.status == 403:
+                raise PermissionError(
+                    "rendezvous rejected request: bad or missing "
+                    "HOROVOD_SECRET_KEY digest"
+                )
             return None
         finally:
             c.close()
@@ -55,8 +85,10 @@ class RendezvousClient:
 
     def delete(self, scope: str):
         c = self._conn()
+        path = f"/{scope}"
         try:
-            c.request("DELETE", f"/{scope}")
+            c.request("DELETE", path,
+                      headers=self._headers("DELETE", path))
             c.getresponse().read()
         finally:
             c.close()

@@ -96,14 +96,22 @@ def _dense_attention_masked(cfg: TransformerConfig, q, k, v, mask):
     S = q.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Hd)
     scores = scores.astype(jnp.float32)
+    valid = None
     if cfg.causal:
-        causal = jnp.tril(jnp.ones((S, S), dtype=bool))
-        scores = jnp.where(causal[None, None], scores, -1e30)
+        valid = jnp.tril(jnp.ones((S, S), dtype=bool))[None, None]
     if mask is not None:
         # mask: (B, S) 1 = attend, 0 = pad.
-        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        km = mask[:, None, None, :].astype(bool)
+        valid = km if valid is None else jnp.logical_and(valid, km)
+    if valid is not None:
+        scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if valid is not None:
+        # Fully-masked query rows yield zeros, not a uniform average of
+        # every value — matching the sp kernels' convention
+        # (parallel/ring.py _flash_block_update).
+        probs = jnp.where(valid, probs, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
 
 
 def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
@@ -116,10 +124,6 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
     if am is None or cfg.sp_axis not in am.axis_names \
             or am.shape[cfg.sp_axis] == 1:
         return _dense_attention_masked(cfg, q, k, v, mask)
-    if mask is not None:
-        raise NotImplementedError(
-            "padding masks are not supported by the sp attention kernels"
-        )
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.ring import ring_attention
@@ -129,14 +133,26 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
     impl = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
     spec = P(None, cfg.sp_axis)
 
+    if mask is None:
+        fn = shard_map(
+            lambda q, k, v: impl(q, k, v, cfg.sp_axis, causal=cfg.causal),
+            mesh=am,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={cfg.sp_axis},
+        )
+        return fn(q, k, v)
+    # Padding mask rides sequence-sharded like K/V; each kernel handles
+    # distribution itself (ring rotates it, Ulysses all-gathers it).
     fn = shard_map(
-        lambda q, k, v: impl(q, k, v, cfg.sp_axis, causal=cfg.causal),
+        lambda q, k, v, m: impl(q, k, v, cfg.sp_axis, causal=cfg.causal,
+                                mask=m),
         mesh=am,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, spec),
         out_specs=spec,
         axis_names={cfg.sp_axis},
     )
-    return fn(q, k, v)
+    return fn(q, k, v, mask)
 
 
 class MultiHeadAttention(nn.Module):

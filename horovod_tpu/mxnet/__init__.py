@@ -1,0 +1,164 @@
+"""`horovod_tpu.mxnet` — drop-in surface of `horovod.mxnet` for MXNet
+users (ref: horovod/mxnet/__init__.py:38-164, horovod/mxnet/mpi_ops.py).
+
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    trainer = hvd.DistributedTrainer(params, opt)
+    hvd.broadcast_parameters(model.collect_params(), root_rank=0)
+
+NDArrays ride the same asynchronous name-negotiated engine as the JAX
+eager path (numpy bridge); on TPU hardware the JAX path is the
+performance surface — this adapter exists for capability parity and
+CPU-cluster jobs, like the torch adapter.
+"""
+from __future__ import annotations
+
+import types
+import warnings
+
+import mxnet as mx
+
+from .functions import allgather_object, broadcast_object  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    allgather,
+    allreduce,
+    allreduce_,
+    alltoall,
+    broadcast,
+    broadcast_,
+    ccl_built,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    rocm_built,
+    shutdown,
+    size,
+)
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wraps an mx optimizer: allreduce(SUM) each grad in update(), with
+    averaging folded into rescale_grad for performance
+    (ref: horovod/mxnet/__init__.py:38-83)."""
+
+    def __init__(self, optimizer, gradient_predivide_factor=1.0):
+        self._optimizer = optimizer
+        # Folding 1/size into rescale_grad is equivalent to averaging in
+        # allreduce but cheaper (ref: __init__.py:44-47).
+        self._optimizer.rescale_grad *= (gradient_predivide_factor / size())
+        self._gradient_predivide_factor = gradient_predivide_factor
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if size() == 1:
+            return
+        if isinstance(index, (tuple, list)):
+            for i in range(len(index)):
+                allreduce_(grad[i], average=False, name=str(index[i]),
+                           priority=-i,
+                           prescale_factor=1.0 / self._gradient_predivide_factor)
+        else:
+            allreduce_(grad, average=False, name=str(index),
+                       prescale_factor=1.0 / self._gradient_predivide_factor)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """gluon Trainer whose _allreduce_grads runs the engine's allreduce
+    instead of kvstore push/pull (ref: horovod/mxnet/__init__.py:91-120)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 gradient_predivide_factor=1.0):
+        if isinstance(optimizer, DistributedOptimizer):
+            optimizer = optimizer._optimizer
+            warnings.warn(
+                "DistributedTrainer does not take DistributedOptimizer as "
+                "its optimizer. We have unwrapped it for you."
+            )
+        super().__init__(params, optimizer,
+                         optimizer_params=optimizer_params, kvstore=None)
+        # _scale feeds rescale_grad in Trainer.step(); dividing by size
+        # turns the summed allreduce into an average.
+        self._scale *= (gradient_predivide_factor / size())
+        self._gradient_predivide_factor = gradient_predivide_factor
+
+    def _allreduce_grads(self):
+        if size() == 1:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                allreduce_(param.list_grad()[0], average=False,
+                           name=param.name, priority=-i,
+                           prescale_factor=1.0 / self._gradient_predivide_factor)
+
+
+def _append_broadcast_init(param, root_rank):
+    """Inject a broadcast after deferred parameter initialization
+    (ref: horovod/mxnet/__init__.py:121-127)."""
+    init_impl = getattr(param, "_init_impl")
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank, name=self.name)
+
+    return wrapped_init_impl
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast a dict / gluon ParameterDict of parameters from
+    root_rank (ref: horovod/mxnet/__init__.py:129-164)."""
+    if size() == 1:
+        return
+    tensors = []
+    names = []
+    if isinstance(params, dict):
+        names, tensors = zip(*sorted(params.items()))
+    else:
+        # gluon ParameterDict (or any mapping of name -> Parameter).
+        deferred_error = getattr(
+            mx.gluon.parameter, "DeferredInitializationError", Exception
+        )
+        for name, p in sorted(params.items()):
+            try:
+                tensors.append(p.data())
+                names.append(name)
+            except deferred_error:
+                p._init_impl = types.MethodType(
+                    _append_broadcast_init(p, root_rank), p
+                )
+    for tensor, name in zip(tensors, names):
+        broadcast_(tensor, root_rank, name=str(name))

@@ -1,0 +1,12 @@
+"""Object helpers for the MXNet adapter.
+
+(ref: horovod/mxnet/functions.py:22-97 broadcast_object/allgather_object
+— pickle + broadcast/allgather of byte tensors; here delegated to the
+framework-agnostic implementations in common.functions.)
+"""
+from __future__ import annotations
+
+from ..common.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+)

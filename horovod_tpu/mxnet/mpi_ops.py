@@ -1,0 +1,160 @@
+"""MXNet collective ops over the engine's numpy bridge.
+
+(ref: horovod/mxnet/mpi_ops.py:51-306 — the reference pushes ops onto
+MXNet's async engine with priorities; here NDArrays bridge through
+numpy into the same asynchronous name-negotiated engine the JAX eager
+path and the torch adapter use. MXNet's own async scheduler is fronted
+by `wait_to_read()` before handoff, which plays the role of the
+reference's dependency registration.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common import basics as _basics
+from ..common.basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
+    gloo_built,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+)
+from ..common.exceptions import HorovodInternalError
+from ..common.types import ReduceOp
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return True
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def _engine():
+    eng = _basics.engine()
+    if eng is None:
+        raise HorovodInternalError(
+            "horovod_tpu.mxnet collectives need process mode (hvdrun) or "
+            "size()==1"
+        )
+    return eng
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    tensor.wait_to_read()
+    return tensor.asnumpy()
+
+
+def _write_back(tensor, arr: np.ndarray):
+    tensor[:] = arr.reshape(tensor.shape)
+    return tensor
+
+
+def _like(tensor, arr: np.ndarray):
+    import mxnet as mx
+
+    return mx.nd.array(arr, ctx=tensor.context, dtype=arr.dtype)
+
+
+def _resolve_op(average: Optional[bool]) -> ReduceOp:
+    return ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+
+
+def allreduce(tensor, average=True, name=None, priority=0,
+              prescale_factor=1.0, postscale_factor=1.0):
+    """(ref: mxnet/mpi_ops.py allreduce — returns a new NDArray.)"""
+    rop = _resolve_op(average)
+    arr = _to_numpy(tensor)
+    if _basics.size() == 1:
+        return _like(tensor, arr * prescale_factor * postscale_factor)
+    out = _engine().synchronize(_engine().enqueue_allreduce(
+        arr, name=name, op=rop,
+        prescale=prescale_factor, postscale=postscale_factor,
+    ))
+    return _like(tensor, np.asarray(out))
+
+
+def allreduce_(tensor, average=True, name=None, priority=0,
+               prescale_factor=1.0, postscale_factor=1.0):
+    """In-place allreduce (ref: mxnet/mpi_ops.py allreduce_)."""
+    rop = _resolve_op(average)
+    arr = _to_numpy(tensor)
+    if _basics.size() == 1:
+        return _write_back(tensor, arr * prescale_factor * postscale_factor)
+    out = _engine().synchronize(_engine().enqueue_allreduce(
+        arr, name=name, op=rop,
+        prescale=prescale_factor, postscale=postscale_factor,
+    ))
+    return _write_back(tensor, np.asarray(out))
+
+
+def allgather(tensor, name=None, priority=0):
+    """Concatenate along dim 0 across ranks (variable first dim OK)."""
+    arr = _to_numpy(tensor)
+    if _basics.size() == 1:
+        return _like(tensor, arr)
+    out = _engine().synchronize(_engine().enqueue_allgather(arr, name=name))
+    return _like(tensor, np.asarray(out))
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    arr = _to_numpy(tensor)
+    if _basics.size() == 1:
+        return _like(tensor, arr)
+    out = _engine().synchronize(
+        _engine().enqueue_broadcast(arr, root_rank, name=name)
+    )
+    return _like(tensor, np.asarray(out))
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    arr = _to_numpy(tensor)
+    if _basics.size() == 1:
+        return tensor
+    out = _engine().synchronize(
+        _engine().enqueue_broadcast(arr, root_rank, name=name)
+    )
+    return _write_back(tensor, np.asarray(out))
+
+
+def alltoall(tensor, splits=None, name=None, priority=0):
+    arr = _to_numpy(tensor)
+    if _basics.size() == 1:
+        return _like(tensor, arr)
+    out, _recv = _engine().synchronize(_engine().enqueue_alltoall(
+        arr, list(splits.asnumpy()) if hasattr(splits, "asnumpy")
+        else (list(splits) if splits is not None else None),
+        name=name,
+    ))
+    return _like(tensor, np.asarray(out))

@@ -22,19 +22,34 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale, causal):
+def _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale, causal,
+                        kmask=None):
     """Fold one K/V block into the streaming-softmax state.
 
     o: (B, Sq, H, D) f32 accumulated (unnormalized) output
     m, l: (B, H, Sq) f32 running max / normalizer
+    kmask: optional (B, Sk) key-validity block (1 = attend, 0 = pad)
+
+    Invalid probabilities are zeroed explicitly (not just pushed to
+    -1e30 in the scores): when an entire block is masked, exp(s - m_new)
+    would otherwise collapse to exp(0)=1 for every masked entry and
+    poison l — explicit zeroing keeps the accumulator exact for any
+    mask pattern, including all-padding blocks.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    valid = None
     if causal:
-        mask = kpos[None, :] <= qpos[:, None]  # (Sq, Sk)
-        s = jnp.where(mask[None, None], s, -1e30)
+        valid = (kpos[None, :] <= qpos[:, None])[None, None]  # (1,1,Sq,Sk)
+    if kmask is not None:
+        km = kmask.astype(bool)[:, None, None, :]             # (B,1,1,Sk)
+        valid = km if valid is None else jnp.logical_and(valid, km)
+    if valid is not None:
+        s = jnp.where(valid, s, -1e30)
     m_blk = jnp.max(s, axis=-1)                      # (B,H,Sq)
     m_new = jnp.maximum(m, m_blk)
     p = jnp.exp(s - m_new[..., None])                # (B,H,Sq,Sk)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
     corr = jnp.exp(m - m_new)                        # (B,H,Sq)
     l_new = l * corr + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
@@ -48,10 +63,16 @@ def ring_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over the global sequence with q/k/v sharded on dim 1
     across `axis_name`. Returns the local output block (B, S/n, H, D) in
-    q.dtype. Differentiable (used in training steps)."""
+    q.dtype. Differentiable (used in training steps).
+
+    `mask` is this rank's key-validity block (B, S/n), 1 = attend,
+    0 = pad; it rotates around the ring with its K/V block. Fully-padded
+    query rows produce zeros (their normalizer is clamped), the BERT
+    convention — the loss must mask them anyway."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -63,32 +84,51 @@ def ring_attention(
     m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, H, Sq), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    kmask = None if mask is None else mask.astype(jnp.float32)
 
     def body(carry, t):
-        o, m, l, k, v = carry
+        o, m, l, k, v, km = carry
         # After t rotations this rank holds the block that started at
         # rank (idx - t) mod n.
         src = (idx - t) % n
         kpos = src * Sk + jnp.arange(Sk)
         o, m, l = _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale,
-                                      causal)
+                                      causal, kmask=km)
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        return (o, m, l, k, v), None
+        if km is not None:
+            km = jax.lax.ppermute(km, axis_name, perm)
+        return (o, m, l, k, v, km), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
-                                      jnp.arange(n))
-    out = o / l.transpose(0, 2, 1)[..., None]
+    (o, m, l, _, _, _), _ = jax.lax.scan(body, (o, m, l, k, v, kmask),
+                                         jnp.arange(n))
+    # Clamp the normalizer: fully-masked rows have l == 0 (and o == 0),
+    # so they come out as zeros instead of NaN.
+    l_safe = jnp.maximum(l, 1e-30)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
-def dense_attention(q, k, v, causal: bool = True) -> jax.Array:
-    """Single-device reference attention (same layout, no sharding)."""
+def dense_attention(q, k, v, causal: bool = True,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
+    """Single-device reference attention (same layout, no sharding).
+    `mask`: optional (B, Sk) key validity, 1 = attend, 0 = pad."""
     D = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+    valid = None
     if causal:
         Sq, Sk = q.shape[1], k.shape[1]
-        mask = jnp.arange(Sk)[None, :] <= jnp.arange(Sq)[:, None]
-        s = jnp.where(mask[None, None], s, -1e30)
+        valid = (jnp.arange(Sk)[None, :]
+                 <= jnp.arange(Sq)[:, None])[None, None]
+    if mask is not None:
+        km = mask.astype(bool)[:, None, None, :]
+        valid = km if valid is None else jnp.logical_and(valid, km)
+    if valid is not None:
+        s = jnp.where(valid, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if valid is not None:
+        # Zero masked probabilities so fully-masked rows yield 0, not a
+        # uniform distribution over -1e30 logits (matches the ring
+        # kernel's convention).
+        p = jnp.where(valid, p, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)

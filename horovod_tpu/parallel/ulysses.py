@@ -12,6 +12,8 @@ Use inside shard_map with the sp axis manual.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -24,9 +26,13 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """q/k/v: local blocks (B, S/n, H, D); H must divide by the axis
-    size. Returns (B, S/n, H, D)."""
+    size. Returns (B, S/n, H, D). `mask` is this rank's key-validity
+    block (B, S/n); the head-sharded dense attention needs the full
+    sequence's mask, so it is all-gathered along the sp axis (tiny:
+    one bit per token)."""
     n = jax.lax.axis_size(axis_name)
     H = q.shape[2]
     if H % n != 0:
@@ -42,5 +48,8 @@ def ulysses_attention(
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = dense_attention(qh, kh, vh, causal=causal)
+    full_mask = None
+    if mask is not None:
+        full_mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    out = dense_attention(qh, kh, vh, causal=causal, mask=full_mask)
     return heads_to_seq(out)

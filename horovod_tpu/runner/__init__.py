@@ -39,7 +39,9 @@ def run(
     host_list = parse_hosts(hosts) if hosts else [HostInfo("localhost", np)]
     slots = get_host_assignments(host_list, np, np)
 
-    server = RendezvousServer()
+    from .util import secret as secret_util
+
+    server = RendezvousServer(secret_key=secret_util.make_secret_key())
     server.start()
     try:
         with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:

@@ -36,7 +36,9 @@ def launch_elastic(args, command: Sequence[str],
     min_np = args.min_np or np_
     max_np = args.max_np or args.num_proc
 
-    server = RendezvousServer()
+    from ..util import secret as secret_util
+
+    server = RendezvousServer(secret_key=secret_util.make_secret_key())
     port = server.start()
     driver = ElasticDriver(
         server, discovery, min_np=min_np, max_np=max_np,
@@ -45,7 +47,8 @@ def launch_elastic(args, command: Sequence[str],
 
     def create_worker(slot, worker_extra_env):
         env = slot_env(slot, "127.0.0.1" if is_local_host(slot.hostname)
-                       else _driver_addr(), port, extra_env, elastic=True)
+                       else _driver_addr(), port, extra_env, elastic=True,
+                       secret_key=server.secret_key)
         env.update(worker_extra_env)
         handle = spawn_worker(
             slot, list(command), env,

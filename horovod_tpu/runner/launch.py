@@ -55,6 +55,7 @@ def slot_env(
     rendezvous_port: int,
     extra_env: Optional[Dict[str, str]] = None,
     elastic: bool = False,
+    secret_key: Optional[bytes] = None,
 ) -> Dict[str, str]:
     """The worker env contract (ref: gloo_run.py:65-198 _slot_info_to_command)."""
     env = {
@@ -72,6 +73,10 @@ def slot_env(
     }
     if elastic:
         env[env_cfg.ELASTIC] = "1"
+    if secret_key is not None:
+        from .util import secret as secret_util
+
+        env[env_cfg.SECRET_KEY] = secret_util.key_to_env(secret_key)
     if extra_env:
         env.update(extra_env)
     return env
@@ -81,7 +86,16 @@ def build_ssh_command(
     hostname: str, command: Sequence[str], env: Dict[str, str],
     ssh_port: Optional[int] = None, ssh_identity_file: Optional[str] = None,
 ) -> List[str]:
-    """ssh invocation for a remote slot (ref: runner/util/remote.py)."""
+    """ssh invocation for a remote slot (ref: runner/util/remote.py).
+
+    The per-job HMAC secret must never appear on a command line — it
+    would be world-readable via /proc/*/cmdline on both ends for the
+    whole run. When `env` carries it, the remote command instead reads
+    one line from stdin into HOROVOD_SECRET_KEY; the caller writes the
+    key to the ssh client's stdin (see spawn_worker)."""
+    env = dict(env)
+    has_secret = env_cfg.SECRET_KEY in env
+    env.pop(env_cfg.SECRET_KEY, None)
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
     )
@@ -93,14 +107,119 @@ def build_ssh_command(
     remote_cmd = f"cd {shlex.quote(os.getcwd())} && env {exports} " + " ".join(
         shlex.quote(c) for c in command
     )
+    if has_secret:
+        remote_cmd = (
+            f"IFS= read -r {env_cfg.SECRET_KEY} && "
+            f"export {env_cfg.SECRET_KEY} && " + remote_cmd
+        )
     return ssh + [hostname, remote_cmd]
 
 
 class WorkerHandle:
+    """One launched worker. Subclasses change the transport (direct
+    subprocess / ssh vs authenticated task service)."""
+
     def __init__(self, slot: SlotInfo, proc: subprocess.Popen):
         self.slot = slot
         self.proc = proc
         self.threads: List[threading.Thread] = []
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        return self.proc.wait(timeout=timeout)
+
+    def terminate(self):
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def kill(self):
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class TaskServiceWorkerHandle(WorkerHandle):
+    """Worker driven through a remote host's authenticated TaskService
+    (ref: the reference launches remote commands through
+    BasicTaskService RPC instead of a long-lived ssh per worker,
+    common/service/task_service.py). `proc` is the ssh/local bootstrap
+    that hosts the service; the worker command itself runs as the
+    service's subprocess."""
+
+    # RPC polls open a TCP connection each time; 4 Hz is plenty for
+    # exit detection and keeps per-worker overhead trivial.
+    POLL_INTERVAL = 0.25
+
+    def __init__(self, slot: SlotInfo, proc: subprocess.Popen, client,
+                 prefix_output: bool = True):
+        super().__init__(slot, proc)
+        self.client = client
+        self._prefix = prefix_output
+        self._out_off = 0
+        self._rc: Optional[int] = None
+        self._last_poll = 0.0
+
+    def _emit(self, delta: bytes):
+        if not self._prefix or not delta:
+            return
+        for line in delta.decode(errors="replace").splitlines():
+            sys.stdout.write(f"[{self.slot.rank}]<stdout>:{line}\n")
+        sys.stdout.flush()
+
+    def poll(self) -> Optional[int]:
+        import time as _time
+
+        if self._rc is not None:
+            return self._rc
+        now = _time.monotonic()
+        if now - self._last_poll < self.POLL_INTERVAL:
+            return None
+        self._last_poll = now
+        try:
+            # Offset-based: only new output crosses the wire.
+            r = self.client.command_exit_code(self._out_off)
+        except (ConnectionError, OSError, EOFError):
+            # Service unreachable: fall back to the bootstrap process.
+            rc = self.proc.poll()
+            if rc is not None:
+                self._rc = rc if rc != 0 else 1
+            return self._rc
+        self._emit(r.output)
+        self._out_off += len(r.output)
+        if r.terminated:
+            self._rc = r.exit_code
+        return self._rc
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and _time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("task-service-worker",
+                                                timeout)
+            _time.sleep(0.1)
+        return self._rc
+
+    def terminate(self):
+        try:
+            self.client.terminate()          # SIGTERM the worker command
+        except (ConnectionError, OSError, EOFError, RuntimeError):
+            pass
+        try:
+            self.client.shutdown_service()   # stop the remote service
+        except (ConnectionError, OSError, EOFError, RuntimeError):
+            pass
+        super().terminate()  # the bootstrap ssh/local client process
+
+    def kill(self):
+        self.terminate()
+        super().kill()
 
 
 def _forward_stream(stream, sink, prefix: str):
@@ -124,18 +243,29 @@ def spawn_worker(
 ) -> WorkerHandle:
     full_env = dict(os.environ)
     full_env.update(env)
-    if is_local_host(slot.hostname):
-        argv = list(command)
-    else:
+    remote = not is_local_host(slot.hostname)
+    secret = env.get(env_cfg.SECRET_KEY) if remote else None
+    if remote:
         argv = build_ssh_command(slot.hostname, command, env, ssh_port,
                                  ssh_identity_file)
+    else:
+        argv = list(command)
     proc = subprocess.Popen(
         argv,
         env=full_env,
+        stdin=subprocess.PIPE if secret else None,
         stdout=subprocess.PIPE if prefix_output else None,
         stderr=subprocess.PIPE if prefix_output else None,
         start_new_session=True,  # own process group for clean teardown
     )
+    if secret:
+        # The remote command's leading `read` consumes this line; the
+        # key rides the encrypted channel, not the command line.
+        try:
+            proc.stdin.write((secret + "\n").encode())
+            proc.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass
     handle = WorkerHandle(slot, proc)
     if prefix_output:
         # Rank-prefixed output forwarding, reference format "[1]<stdout>:"
@@ -156,19 +286,13 @@ def spawn_worker(
 
 def terminate_workers(handles: List[WorkerHandle]):
     for h in handles:
-        if h.proc.poll() is None:
-            try:
-                os.killpg(os.getpgid(h.proc.pid), signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                pass
+        if h.poll() is None:
+            h.terminate()
     for h in handles:
         try:
-            h.proc.wait(timeout=10)
+            h.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(h.proc.pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+            h.kill()
 
 
 def launch_static(
@@ -184,27 +308,67 @@ def launch_static(
     """Run one process per slot; first failure tears everything down
     (ref: gloo_run.py:243-258). Returns the first nonzero exit code or 0."""
     own_server = rendezvous is None
-    server = rendezvous or RendezvousServer()
+    if own_server:
+        # Per-job shared secret: workers must present it to the KV
+        # store (ref: secret.py make_secret_key; shipped via env like
+        # the reference's _HOROVOD_SECRET_KEY plumbing).
+        from .util import secret as secret_util
+
+        server = RendezvousServer(secret_key=secret_util.make_secret_key())
+    else:
+        server = rendezvous
     port = server.start() if own_server else server.port
     addr = (
         "127.0.0.1"
         if all(is_local_host(s.hostname) for s in slots)
         else _driver_addr()
     )
-    handles = [
-        spawn_worker(
-            slot, command,
-            slot_env(slot, addr, port, extra_env),
-            verbose, prefix_output, ssh_port, ssh_identity_file,
-        )
-        for slot in slots
-    ]
+    # HVDRUN_USE_TASK_SERVICE routes worker exec through per-slot
+    # authenticated TaskServices instead of long-lived ssh sessions
+    # ("1": remote slots only; "all": every slot — the no-ssh test
+    # spelling). Requires the per-job secret, so only with own_server
+    # or a keyed external server.
+    ts_mode = os.environ.get("HVDRUN_USE_TASK_SERVICE", "")
+    driver_service = None
+    if ts_mode and server.secret_key is not None:
+        ts_slots = [
+            i for i, s in enumerate(slots)
+            if ts_mode == "all" or not is_local_host(s.hostname)
+        ]
+    else:
+        ts_slots = []
+    # Everything from here shares one cleanup block: a failure while
+    # spawning (ssh missing, task-service registration timeout, ...)
+    # must tear down already-started workers, task-service bootstraps,
+    # the DriverService, and the rendezvous server — not leak them.
+    handles: List[WorkerHandle] = []
     exit_code = 0
     try:
+        if ts_slots:
+            driver_service, ts_handles = _spawn_via_task_service(
+                [slots[i] for i in ts_slots], command,
+                [slot_env(slots[i], addr, port, extra_env,
+                          secret_key=server.secret_key) for i in ts_slots],
+                server.secret_key, prefix_output, ssh_port,
+                ssh_identity_file,
+            )
+        else:
+            ts_handles = []
+        ts_iter = iter(ts_handles)
+        for i, slot in enumerate(slots):
+            if i in ts_slots:
+                handles.append(next(ts_iter))
+            else:
+                handles.append(spawn_worker(
+                    slot, command,
+                    slot_env(slot, addr, port, extra_env,
+                             secret_key=server.secret_key),
+                    verbose, prefix_output, ssh_port, ssh_identity_file,
+                ))
         pending = set(range(len(handles)))
         while pending:
             for i in sorted(pending):
-                rc = handles[i].proc.poll()
+                rc = handles[i].poll()
                 if rc is None:
                     continue
                 pending.discard(i)
@@ -224,13 +388,103 @@ def launch_static(
                 import time
 
                 time.sleep(0.05)
+    except BaseException:
+        # Spawn-time failure: stop whatever already started.
+        terminate_workers(handles)
+        raise
     finally:
         for h in handles:
             for t in h.threads:
                 t.join(timeout=5)
+        for h in handles:
+            # Task-service bootstraps outlive their worker command;
+            # shut them down explicitly.
+            if isinstance(h, TaskServiceWorkerHandle):
+                h.terminate()
+        if driver_service is not None:
+            driver_service.shutdown()
         if own_server:
             server.stop()
     return exit_code
+
+
+def _spawn_via_task_service(
+    slots: List[SlotInfo],
+    command: Sequence[str],
+    envs: List[Dict[str, str]],
+    secret_key: bytes,
+    prefix_output: bool,
+    ssh_port: Optional[int],
+    ssh_identity_file: Optional[str],
+):
+    """Bootstrap one TaskService per slot (ssh for remote hosts, plain
+    subprocess for local ones), wait for their authenticated
+    registrations at the DriverService, then start each worker command
+    through TaskClient.run_command (ref: the reference's driver/task
+    service launch flow, common/service/driver_service.py +
+    task_service.py; ssh only bootstraps, exec rides the HMAC RPC)."""
+    from .service import DriverClient, DriverService, TaskClient
+    from .util import secret as secret_util
+
+    driver_service = DriverService(num_tasks=len(slots), key=secret_key)
+    driver_addr = (
+        "127.0.0.1" if all(is_local_host(s.hostname) for s in slots)
+        else _driver_addr()
+    )
+    boot_env = {env_cfg.SECRET_KEY: secret_util.key_to_env(secret_key)}
+    boots = []
+    for i, slot in enumerate(slots):
+        boot_cmd = [
+            sys.executable, "-m", "horovod_tpu.runner.task_runner",
+            "--task-service", "--index", str(i),
+            "--driver", f"{driver_addr}:{driver_service.port}",
+        ]
+        remote = not is_local_host(slot.hostname)
+        if remote:
+            # build_ssh_command strips the secret from the command line;
+            # it is written to the ssh client's stdin below.
+            argv = build_ssh_command(slot.hostname, boot_cmd, boot_env,
+                                     ssh_port, ssh_identity_file)
+            full_env = dict(os.environ)
+        else:
+            argv = boot_cmd
+            full_env = dict(os.environ)
+            full_env.update(boot_env)
+        p = subprocess.Popen(
+            argv, env=full_env, start_new_session=True,
+            stdin=subprocess.PIPE if remote else None,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        if remote:
+            try:
+                p.stdin.write(
+                    (boot_env[env_cfg.SECRET_KEY] + "\n").encode())
+                p.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass
+        boots.append(p)
+    handles: List[WorkerHandle] = []
+    try:
+        addrs = driver_service.wait_for_all_tasks(timeout=120)
+        for i, slot in enumerate(slots):
+            host = ("127.0.0.1" if is_local_host(slot.hostname)
+                    else slot.hostname)
+            ts_port = next(iter(addrs[i].values()))
+            client = TaskClient(host, ts_port, secret_key)
+            client.run_command(list(command), env=envs[i])
+            handles.append(TaskServiceWorkerHandle(
+                slot, boots[i], client, prefix_output=prefix_output,
+            ))
+    except BaseException:
+        # Registration timeout or a run_command failure: none of the
+        # bootstraps may leak (they never exit on their own).
+        for h in handles:
+            h.terminate()
+        for p in boots:
+            p.kill()
+        driver_service.shutdown()
+        raise
+    return driver_service, handles
 
 
 def _driver_addr() -> str:

@@ -6,12 +6,39 @@ values (socket addresses, rank assignments) under scoped keys; the Gloo-
 equivalent TCP backend uses it to build its full mesh, and the elastic
 driver uses it to hand out new rank assignments on membership changes
 (ref: horovod/runner/elastic/rendezvous.py:28-52).
+
+When constructed with a per-job secret (the launcher generates one and
+ships it to workers via HOROVOD_SECRET_KEY), every request must carry an
+HMAC-SHA256 digest over ``method\\npath\\nbody`` in the
+``X-Horovod-Digest`` header; unauthenticated requests get 403. This
+extends the reference's HMAC service protocol (ref: runner/common/util/
+network.py:50-110, secret.py:26-34) to the KV store itself, closing the
+reference's own gap of an unauthenticated rendezvous.
 """
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+
+from .util import secret as secret_util
+
+
+def sign_request(key: bytes, method: str, path: str, body: bytes) -> str:
+    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
+    return secret_util.compute_digest(key, msg).hex()
+
+
+def _check_request(key: bytes, method: str, path: str, body: bytes,
+                   digest_hex: Optional[str]) -> bool:
+    if not digest_hex:
+        return False
+    try:
+        digest = bytes.fromhex(digest_hex)
+    except ValueError:
+        return False
+    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
+    return secret_util.check_digest(key, msg, digest)
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -23,7 +50,23 @@ class _KVHandler(BaseHTTPRequestHandler):
     def _key(self) -> str:
         return self.path.lstrip("/")
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        server: RendezvousServer = self.server.rendezvous  # type: ignore
+        if server.secret_key is None:
+            return True
+        ok = _check_request(
+            server.secret_key, self.command, self.path, body,
+            self.headers.get("X-Horovod-Digest"),
+        )
+        if not ok:
+            self.send_response(403)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        return ok
+
     def do_GET(self):
+        if not self._authorized():
+            return
         server: RendezvousServer = self.server.rendezvous  # type: ignore
         val = server.handle_get(self._key())
         if val is None:
@@ -40,6 +83,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         server: RendezvousServer = self.server.rendezvous  # type: ignore
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if not self._authorized(body):
+            return
         server.handle_put(self._key(), body)
         self.send_response(200)
         self.send_header("Content-Length", "0")
@@ -47,6 +92,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         # Scope finalization (ref: http_server.py RendezvousHandler DELETE)
+        if not self._authorized():
+            return
         server: RendezvousServer = self.server.rendezvous  # type: ignore
         server.handle_delete(self._key())
         self.send_response(200)
@@ -55,7 +102,9 @@ class _KVHandler(BaseHTTPRequestHandler):
 
 
 class RendezvousServer:
-    def __init__(self, verbose: int = 0):
+    def __init__(self, verbose: int = 0,
+                 secret_key: Optional[bytes] = None):
+        self.secret_key = secret_key
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None

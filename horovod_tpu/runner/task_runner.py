@@ -1,10 +1,44 @@
-"""Worker-side entry for `run(func)` mode: load the pickled function,
-execute it under hvd, PUT the pickled result to the rendezvous KV
-(ref: horovod/runner/launch.py:552-574 --run-func result collection)."""
+"""Worker-side entries.
+
+* `python -m horovod_tpu.runner.task_runner <func.pkl>` — `run(func)`
+  mode: load the pickled function, execute it under hvd, PUT the
+  pickled result to the rendezvous KV (ref: horovod/runner/
+  launch.py:552-574 --run-func result collection).
+* `python -m horovod_tpu.runner.task_runner --task-service --index I
+  --driver HOST:PORT` — host a per-slot authenticated TaskService and
+  register it with the launcher's DriverService; the launcher then
+  starts the worker command through the HMAC RPC (ref: runner/
+  task_fn.py + common/service/task_service.py bootstrap flow).
+"""
 from __future__ import annotations
 
 import pickle
 import sys
+
+
+def task_service_main(index: int, driver: str):
+    import os
+
+    from .service import DriverClient, TaskService
+    from .util import secret as secret_util
+
+    key = secret_util.key_from_env()
+    if key is None:
+        print("task_runner: HOROVOD_SECRET_KEY is required for "
+              "--task-service", file=sys.stderr)
+        return 2
+    svc = TaskService(index=index, key=key)
+    host, port = driver.rsplit(":", 1)
+    DriverClient(host, int(port), key).register_task(
+        index, {os.uname().nodename: svc.port}, os.uname().nodename
+    )
+    # Serve until the launcher sends ShutdownServiceRequest (killing the
+    # local ssh client would NOT stop this remote process — without a
+    # pty sshd leaves the command running, so an explicit RPC is the
+    # teardown path) or the process group is signalled.
+    svc.shutdown_requested.wait()
+    svc.shutdown()
+    return 0
 
 
 def main(func_path: str):
@@ -30,4 +64,13 @@ def main(func_path: str):
 
 
 if __name__ == "__main__":
+    if "--task-service" in sys.argv:
+        import argparse
+
+        p = argparse.ArgumentParser()
+        p.add_argument("--task-service", action="store_true")
+        p.add_argument("--index", type=int, required=True)
+        p.add_argument("--driver", required=True)
+        args = p.parse_args()
+        sys.exit(task_service_main(args.index, args.driver) or 0)
     main(sys.argv[1])

@@ -1,0 +1,46 @@
+"""Shared-secret HMAC helpers for the runner's service protocol.
+
+(ref: horovod/runner/common/util/secret.py:21-37 — a per-job 32-byte
+secret distributed to workers through their environment; every service
+message carries an HMAC-SHA256 digest checked before deserialization.)
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Optional
+
+SECRET_LENGTH = 32  # bytes
+DIGEST_LENGTH = 32  # bytes (sha256)
+
+# Env var carrying the hex-encoded per-job secret (the reference ships
+# it as _HOROVOD_SECRET_KEY through Open MPI / Spark env plumbing).
+SECRET_ENV = "HOROVOD_SECRET_KEY"
+
+
+def make_secret_key() -> bytes:
+    return os.urandom(SECRET_LENGTH)
+
+
+def compute_digest(key: bytes, message: bytes) -> bytes:
+    return hmac.new(key, message, hashlib.sha256).digest()
+
+
+def check_digest(key: bytes, message: bytes, digest: bytes) -> bool:
+    return hmac.compare_digest(compute_digest(key, message), digest)
+
+
+def key_from_env() -> Optional[bytes]:
+    """Decode the job secret from the environment, if set."""
+    v = os.environ.get(SECRET_ENV)
+    if not v:
+        return None
+    try:
+        return bytes.fromhex(v)
+    except ValueError:
+        return None
+
+
+def key_to_env(key: bytes) -> str:
+    return key.hex()

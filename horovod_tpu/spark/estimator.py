@@ -2,16 +2,24 @@
 horovod/spark/torch/estimator.py — fit framework models on DataFrames).
 
 `JaxEstimator.fit(df)` trains a flax model data-parallel across Spark
-tasks: the DataFrame's feature/label columns are collected per
-partition, each task trains on its shard with grads allreduced through
-the engine, and rank 0's params come back in a `JaxModel` transformer.
-Works with pandas DataFrames directly for local use.
+tasks. With a `store` (ref: horovod/spark/common/store.py), the
+DataFrame is materialized ONCE to store Parquet and every worker reads
+its own shard from there — the reference's
+DataFrame→Parquet→worker-reader pipeline (common/util.py prepare_data)
+without shipping the dataset through the driver's pickled closure — and
+rank 0 checkpoints params to the store per epoch, resuming from the
+last checkpoint when fit() restarts. Without a store, partitions are
+collected and shipped in the closure (small-data mode). Works with
+pandas DataFrames directly for local use.
 """
 from __future__ import annotations
 
+import uuid
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
+
+from .store import Store
 
 
 class JaxModel:
@@ -37,7 +45,8 @@ class JaxModel:
 
 class JaxEstimator:
     """(ref: estimator params subset — model, optimizer, loss, epochs,
-    batch_size, feature/label cols.)"""
+    batch_size, feature/label cols, store/run_id for the checkpointing
+    data path.)"""
 
     def __init__(
         self,
@@ -51,6 +60,8 @@ class JaxEstimator:
         epochs: int = 1,
         batch_size: int = 32,
         seed: int = 0,
+        store: Optional[Store] = None,
+        run_id: Optional[str] = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -62,6 +73,10 @@ class JaxEstimator:
         self.epochs = epochs
         self.batch_size = batch_size
         self.seed = seed
+        self.store = store
+        # Stable per-estimator run id so re-fitting resumes
+        # (ref: estimator.py _has_checkpoint/run_id semantics).
+        self.run_id = run_id or f"jax-estimator-{uuid.uuid4().hex[:8]}"
 
     # ------------------------------------------------------------------
     def _collect(self, df):
@@ -72,9 +87,32 @@ class JaxEstimator:
         y = pdf[self.label_col].to_numpy()
         return x, y
 
+    def _prepare_data(self, df) -> str:
+        """Materialize df into the store's train-data Parquet path
+        unless an identical materialization already exists — identity is
+        a content fingerprint, so fitting different data on the same
+        store re-materializes instead of silently reusing stale rows
+        (ref: common/util.py prepare_data's dataset keying)."""
+        path = self.store.get_train_data_path()
+        if not (self.store.is_parquet_dataset(path)
+                and self.store.matches_fingerprint(df, path)):
+            self.store.save_data_frame(df, path)
+        return path
+
     def fit(self, df) -> JaxModel:
-        x, y = self._collect(df)
         est = self
+        if self.store is not None:
+            data_path = self._prepare_data(df)
+            store = self.store
+            run_id = self.run_id
+            data_fp = store.dataset_fingerprint(df)
+            x = y = None
+        else:
+            x, y = self._collect(df)
+            store = None
+            run_id = None
+            data_path = None
+            data_fp = None
 
         def train():
             import jax
@@ -83,20 +121,66 @@ class JaxEstimator:
             import horovod_tpu as hvd
 
             hvd.init()
-            xs = x[hvd.rank()::hvd.size()]
-            ys = y[hvd.rank()::hvd.size()]
-            params = est.model.init(
-                jax.random.PRNGKey(est.seed), xs[: est.batch_size]
-            )
+            if store is not None:
+                # Worker-side shard read from store Parquet — the
+                # dataset never rides the pickled closure, only needed
+                # columns are read, and with enough part files each
+                # rank reads only its own parts.
+                cols = est.feature_cols + [est.label_col]
+                by_parts = store.sharding_by_parts(data_path, hvd.size())
+                pdf = store.read_parquet(
+                    data_path, columns=cols,
+                    shard_rank=hvd.rank(), shard_size=hvd.size(),
+                )
+                xs_full = np.stack(
+                    [pdf[c].to_numpy() for c in est.feature_cols], axis=-1
+                ).astype(np.float32)
+                ys_full = pdf[est.label_col].to_numpy()
+                if by_parts:
+                    # Already a disjoint per-rank shard.
+                    xs, ys = xs_full, ys_full
+                else:
+                    xs = xs_full[hvd.rank()::hvd.size()]
+                    ys = ys_full[hvd.rank()::hvd.size()]
+            else:
+                xs = x[hvd.rank()::hvd.size()]
+                ys = y[hvd.rank()::hvd.size()]
+
+            start_epoch = 0
+            saved_opt = None
+            params = None
+            if store is not None and store.has_checkpoint(run_id):
+                ckpt = store.load_checkpoint(run_id)
+                # A checkpoint is only a valid resume point for the SAME
+                # dataset: a differing fingerprint means the caller
+                # re-fit with new data, so training must restart instead
+                # of silently returning the old params.
+                ck_fp = ckpt.get("data_fp")
+                if data_fp is None or ck_fp == data_fp:
+                    params = ckpt["params"]
+                    start_epoch = int(ckpt.get("epoch", -1)) + 1
+                    saved_opt = ckpt.get("opt_state")
+            if params is None:
+                params = est.model.init(
+                    jax.random.PRNGKey(est.seed), xs[: est.batch_size]
+                )
             params = hvd.broadcast_parameters(params, root_rank=0)
             tx = hvd.DistributedOptimizer(est.optimizer)
-            opt_state = tx.init(params)
+            opt_state = saved_opt if saved_opt is not None else tx.init(params)
 
             grad_fn = jax.jit(jax.value_and_grad(
                 lambda p, bx, by: est.loss(est.model.apply(p, bx), by)
             ))
-            steps = max(len(xs) // est.batch_size, 1)
-            for epoch in range(est.epochs):
+            # Per-epoch step count must be identical on every rank —
+            # each step's grad allreduce is a collective, and by-parts
+            # shards can be ragged. Agree on the minimum shard length.
+            n_local = len(xs)
+            if hvd.size() > 1:
+                n_local = min(hvd.allgather_object(n_local))
+            # Agreed-empty shard → zero steps everywhere (no rank may
+            # break out of the loop alone; each step is a collective).
+            steps = 0 if n_local == 0 else max(n_local // est.batch_size, 1)
+            for epoch in range(start_epoch, est.epochs):
                 perm = np.random.RandomState(epoch).permutation(len(xs))
                 for i in range(steps):
                     idx = perm[i * est.batch_size:(i + 1) * est.batch_size]
@@ -105,6 +189,16 @@ class JaxEstimator:
                     _, grads = grad_fn(params, xs[idx], ys[idx])
                     upd, opt_state = tx.update(grads, opt_state, params)
                     params = optax.apply_updates(params, upd)
+                if store is not None and hvd.rank() == 0:
+                    # Per-epoch checkpoint to the store, rank 0 only
+                    # (ref: keras/remote.py checkpoint callback; §5.4
+                    # only-rank-0-writes convention).
+                    store.save_checkpoint(run_id, {
+                        "params": jax.tree.map(np.asarray, params),
+                        "opt_state": jax.tree.map(np.asarray, opt_state),
+                        "epoch": epoch,
+                        "data_fp": data_fp,
+                    }, epoch=epoch)
             return jax.tree.map(np.asarray, params)
 
         num_proc = self.num_proc or 1

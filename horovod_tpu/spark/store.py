@@ -1,0 +1,281 @@
+"""Storage layer for the estimator data path.
+
+TPU-native re-design of the reference's Store
+(ref: horovod/spark/common/store.py:29-433 — Store/FilesystemStore/
+LocalStore/HDFSStore: a prefix path holding materialized Parquet
+training data, per-run checkpoints, and logs; estimators materialize a
+DataFrame to store Parquet once and every worker reads its shard from
+there, ref: horovod/spark/common/util.py prepare_data).
+
+Here `LocalStore` covers any locally-mounted filesystem (POSIX path or
+``file://`` URL — on TPU-VMs GCS typically arrives via gcsfuse mounts,
+so a mounted path is the common case). A true ``hdfs://``/``gs://``
+client layer is deliberately out of scope; `Store.create` says so
+explicitly rather than failing downstream.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import time
+from typing import Any, List, Optional
+
+
+class Store:
+    """(ref: store.py:29-144 — path scheme + checkpoint/log IO.)"""
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def get_runs_path(self) -> str:
+        raise NotImplementedError
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes):
+        raise NotImplementedError
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def get_parquet_dataset(self, path: str):
+        raise NotImplementedError
+
+    def read_parquet(self, path: str, columns: Optional[List[str]] = None,
+                     shard_rank: Optional[int] = None,
+                     shard_size: Optional[int] = None):
+        """Dataset (or one worker's shard of it) as a pandas DataFrame;
+        the estimator's worker closure depends on this."""
+        raise NotImplementedError
+
+    def save_data_frame(self, df, path: str):
+        raise NotImplementedError
+
+    def sharding_by_parts(self, path: str, shard_size: int) -> bool:
+        """Whether read_parquet(shard_rank=..., shard_size=...) returns
+        disjoint per-rank shards (conservative default: no)."""
+        return False
+
+    def dataset_fingerprint(self, df) -> Optional[str]:
+        """Cheap content identity for materialization reuse; None means
+        'unknown — always re-materialize'."""
+        return None
+
+    def matches_fingerprint(self, df, path: str) -> bool:
+        return False
+
+    # -- checkpoint helpers (pickle pytrees; ref: keras/remote.py
+    # checkpoint callbacks write per-epoch files) ----------------------
+    def save_checkpoint(self, run_id: str, obj: Any, epoch: Optional[int] = None):
+        path = self.get_checkpoint_path(run_id)
+        if epoch is not None:
+            base, ext = os.path.splitext(path)
+            self.write(f"{base}.epoch{epoch}{ext}", pickle.dumps(obj))
+        self.write(path, pickle.dumps(obj))
+
+    def load_checkpoint(self, run_id: str) -> Any:
+        return pickle.loads(self.read(self.get_checkpoint_path(run_id)))
+
+    def has_checkpoint(self, run_id: str) -> bool:
+        return self.exists(self.get_checkpoint_path(run_id))
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """(ref: store.py:141-146 Store.create dispatches on URL
+        scheme.)"""
+        if prefix_path.startswith(("hdfs://", "gs://", "s3://")):
+            raise ValueError(
+                f"remote filesystem URL {prefix_path!r} is not natively "
+                "supported: mount it (gcsfuse / hdfs-fuse) and pass the "
+                "mounted path, the idiomatic arrangement on TPU-VMs"
+            )
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class LocalStore(Store):
+    """Filesystem-backed store (ref: store.py LocalStore:148-260; the
+    same path scheme: <prefix>/intermediate_train_data,
+    <prefix>/runs/<run_id>/{checkpoint, logs})."""
+
+    FS_PREFIX = "file://"
+
+    def __init__(self, prefix_path: str, train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 runs_path: Optional[str] = None):
+        if prefix_path.startswith(self.FS_PREFIX):
+            prefix_path = prefix_path[len(self.FS_PREFIX):]
+        self.prefix_path = os.path.abspath(prefix_path)
+        self._train_path = train_path or os.path.join(
+            self.prefix_path, "intermediate_train_data")
+        self._val_path = val_path or os.path.join(
+            self.prefix_path, "intermediate_val_data")
+        self._runs_path = runs_path or os.path.join(self.prefix_path, "runs")
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+    def _idx(self, path: str, idx: Optional[int]) -> str:
+        return path if idx is None else f"{path}.{idx}"
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        return self._idx(self._train_path, idx)
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        return self._idx(self._val_path, idx)
+
+    def get_runs_path(self) -> str:
+        return self._runs_path
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._runs_path, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint.pkl")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    # -- IO ------------------------------------------------------------
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: readers never see partial files
+
+    # -- parquet data path --------------------------------------------
+    def is_parquet_dataset(self, path: str) -> bool:
+        """(ref: store.py:167-175 — a directory of parquet part
+        files, or a single parquet file.)"""
+        if os.path.isfile(path):
+            return path.endswith(".parquet")
+        if not os.path.isdir(path):
+            return False
+        return any(
+            n.endswith(".parquet") for n in os.listdir(path)
+        ) or os.path.exists(os.path.join(path, "_SUCCESS"))
+
+    def get_parquet_dataset(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetDataset(path)
+
+    def read_parquet(self, path: str, columns: Optional[List[str]] = None,
+                     shard_rank: Optional[int] = None,
+                     shard_size: Optional[int] = None):
+        """One worker's view of the dataset as a pandas DataFrame.
+
+        Column pruning always applies. When the dataset has at least
+        `shard_size` part files, each rank reads only parts
+        rank::size (the reference's Petastorm readers similarly shard
+        by row group, common/util.py); otherwise the caller must
+        row-slice the returned frame itself."""
+        import pyarrow.parquet as pq
+
+        parts = self._part_files(path)
+        if (shard_rank is not None and shard_size is not None
+                and shard_size > 1 and len(parts) >= shard_size):
+            tables = [
+                pq.read_table(p, columns=columns)
+                for p in parts[shard_rank::shard_size]
+            ]
+            import pyarrow as pa
+
+            return pa.concat_tables(tables).to_pandas()
+        return pq.read_table(path, columns=columns).to_pandas()
+
+    def sharding_by_parts(self, path: str, shard_size: int) -> bool:
+        """True when read_parquet(shard_rank=..., shard_size=...) will
+        return disjoint per-rank shards (enough part files)."""
+        return shard_size > 1 and len(self._part_files(path)) >= shard_size
+
+    def _part_files(self, path: str) -> List[str]:
+        if os.path.isfile(path):
+            return [path]
+        if not os.path.isdir(path):
+            return []
+        return sorted(
+            os.path.join(path, n) for n in os.listdir(path)
+            if n.endswith(".parquet")
+        )
+
+    def save_data_frame(self, df, path: str):
+        """Materialize a DataFrame (Spark or pandas) to store Parquet
+        (ref: common/util.py prepare_data's
+        df.write.parquet(train_data_path)). Writes a fingerprint marker
+        so a later fit with different data re-materializes instead of
+        silently training on stale rows."""
+        fp = self.dataset_fingerprint(df)
+        if hasattr(df, "write"):  # real pyspark DataFrame
+            df.write.mode("overwrite").parquet(f"{self.FS_PREFIX}{path}")
+        else:
+            pdf = df.toPandas() if hasattr(df, "toPandas") else df
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            os.makedirs(path, exist_ok=True)
+            pdf.to_parquet(os.path.join(path, "part-00000.parquet"))
+            # Spark-compatible completion marker.
+            with open(os.path.join(path, "_SUCCESS"), "w"):
+                pass
+        if fp is not None:
+            self.write(self._fingerprint_path(path), fp.encode())
+
+    def _fingerprint_path(self, path: str) -> str:
+        return f"{path}._fingerprint"
+
+    def dataset_fingerprint(self, df) -> Optional[str]:
+        if hasattr(df, "write"):
+            # Spark DataFrames have no cheap content hash; None forces
+            # re-materialization every fit (correct, if conservative).
+            return None
+        pdf = df.toPandas() if hasattr(df, "toPandas") else df
+        try:
+            import pandas as pd
+
+            h = pd.util.hash_pandas_object(pdf, index=False)
+            return f"{len(pdf)}-{int(h.sum()) & 0xFFFFFFFFFFFFFFFF:x}"
+        except Exception:
+            return None
+
+    def matches_fingerprint(self, df, path: str) -> bool:
+        """True iff `path` holds a materialization of exactly `df`."""
+        fp = self.dataset_fingerprint(df)
+        if fp is None:
+            return False
+        mark = self._fingerprint_path(path)
+        return self.exists(mark) and self.read(mark).decode() == fp
+
+
+class HDFSStore(Store):
+    """Placeholder matching the reference's class name
+    (ref: store.py:263-433). Native HDFS clients are out of scope on
+    TPU-VMs; use a FUSE mount + LocalStore."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "HDFSStore is not supported in horovod_tpu: mount HDFS "
+            "(hdfs-fuse) and use LocalStore on the mounted path"
+        )

@@ -123,3 +123,35 @@ def test_train_step_resnet_dp_mesh():
         state, loss = step_fn(state, x, y)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_bert_ring_attention_with_padding_mask():
+    """BERT-style encoder with attn_impl=ring + padding mask on a dp x sp
+    mesh matches the dense-attention forward (the BASELINE BERT configs
+    are padded-batch workloads; VERDICT r1 flagged this gap)."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models.transformer import BERT_CONFIGS
+
+    base = dataclasses.replace(
+        BERT_CONFIGS["bert-tiny"], max_len=32, n_layers=1, dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    ids = np.random.RandomState(0).randint(0, 1000, (2, 32), dtype=np.int32)
+    mask = np.ones((2, 32), np.float32)
+    mask[0, 24:] = 0.0
+    mask[1, 10:] = 0.0
+
+    m_dense = TransformerEncoder(dataclasses.replace(base, attn_impl="dense"))
+    variables = m_dense.init(jax.random.PRNGKey(0), ids, mask=mask)
+    want = m_dense.apply(variables, ids, mask=mask)
+
+    mesh = create_mesh({"dp": 2, "sp": 4})
+    m_ring = TransformerEncoder(dataclasses.replace(base, attn_impl="ring"))
+    with jax.sharding.set_mesh(mesh):
+        got = jax.jit(lambda v, i, mk: m_ring.apply(v, i, mask=mk))(
+            variables, ids, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

@@ -1,0 +1,53 @@
+"""MXNet adapter tests (skipped when mxnet is not installed — the
+reference gates the binding the same way via HOROVOD_WITH_MXNET;
+ref: horovod/mxnet/__init__.py:17-19 check_extension).
+
+The numpy-bridge machinery underneath is the same code path the torch
+adapter exercises with 2 real ranks in test_torch_adapter.py.
+"""
+import numpy as np
+import pytest
+
+mx = pytest.importorskip("mxnet")
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hvd():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def test_size1_allreduce():
+    t = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = hvd.allreduce(t, average=True, name="t")
+    np.testing.assert_allclose(out.asnumpy(), t.asnumpy())
+
+
+def test_size1_allreduce_inplace():
+    t = mx.nd.array(np.ones((4,), np.float32))
+    hvd.allreduce_(t, average=True, name="t2")
+    np.testing.assert_allclose(t.asnumpy(), np.ones(4))
+
+
+def test_size1_broadcast_and_allgather():
+    t = mx.nd.array(np.arange(4, dtype=np.float32))
+    out = hvd.broadcast(t, root_rank=0, name="b")
+    np.testing.assert_allclose(out.asnumpy(), t.asnumpy())
+    g = hvd.allgather(t, name="g")
+    np.testing.assert_allclose(g.asnumpy(), t.asnumpy())
+
+
+def test_distributed_trainer_smoke():
+    net = mx.gluon.nn.Dense(2)
+    net.initialize()
+    params = net.collect_params()
+    trainer = hvd.DistributedTrainer(params, "sgd",
+                                     {"learning_rate": 0.1})
+    x = mx.nd.ones((3, 4))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(3)

@@ -226,3 +226,24 @@ def test_discover_tpu_hosts_env(monkeypatch):
 
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "solo")
     assert discover_tpu_hosts() is None  # single host -> not a pod
+
+
+def test_ssh_command_keeps_secret_off_cmdline():
+    """The per-job HMAC key must ride ssh stdin, never the command line
+    (visible in /proc/*/cmdline otherwise)."""
+    from horovod_tpu.runner.launch import build_ssh_command
+    from horovod_tpu.utils import env as env_cfg
+
+    env = {"HOROVOD_RANK": "3", env_cfg.SECRET_KEY: "deadbeef" * 8}
+    argv = build_ssh_command("hostA", ["python", "train.py"], env)
+    joined = " ".join(argv)
+    assert "deadbeef" not in joined
+    assert "HOROVOD_RANK=3" in joined
+    # The remote command reads the key from stdin instead.
+    assert f"IFS= read -r {env_cfg.SECRET_KEY}" in joined
+    assert f"export {env_cfg.SECRET_KEY}" in joined
+
+    # Without a secret, no stdin plumbing is injected.
+    argv2 = build_ssh_command("hostA", ["python", "train.py"],
+                              {"HOROVOD_RANK": "3"})
+    assert "read -r" not in " ".join(argv2)

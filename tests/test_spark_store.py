@@ -1,0 +1,167 @@
+"""Store + estimator data-path tests.
+
+(ref: horovod/spark/common/store.py:29-260 LocalStore path scheme and
+parquet checks; horovod/spark/keras/estimator.py per-epoch checkpoints
+written to the store, resume from last checkpoint.)
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.spark.store import HDFSStore, LocalStore, Store
+
+
+def test_store_create_dispatch(tmp_path):
+    s = Store.create(str(tmp_path / "prefix"))
+    assert isinstance(s, LocalStore)
+    with pytest.raises(ValueError):
+        Store.create("hdfs://nn:8020/path")
+    with pytest.raises(NotImplementedError):
+        HDFSStore()
+
+
+def test_local_store_paths(tmp_path):
+    s = LocalStore(f"file://{tmp_path}/st")
+    assert s.prefix_path == str(tmp_path / "st")
+    assert s.get_train_data_path().endswith("intermediate_train_data")
+    assert s.get_train_data_path(2).endswith("intermediate_train_data.2")
+    assert s.get_run_path("r1") == os.path.join(s.get_runs_path(), "r1")
+    assert s.get_checkpoint_path("r1").endswith("r1/checkpoint.pkl")
+
+
+def test_write_read_atomic(tmp_path):
+    s = LocalStore(str(tmp_path))
+    p = os.path.join(s.prefix_path, "sub", "blob.bin")
+    s.write(p, b"payload")
+    assert s.exists(p)
+    assert s.read(p) == b"payload"
+    # No temp files left behind.
+    assert sorted(os.listdir(os.path.dirname(p))) == ["blob.bin"]
+
+
+def test_parquet_materialization(tmp_path):
+    s = LocalStore(str(tmp_path))
+    df = pd.DataFrame({"a": [1.0, 2.0, 3.0], "y": [0, 1, 0]})
+    path = s.get_train_data_path()
+    assert not s.is_parquet_dataset(path)
+    s.save_data_frame(df, path)
+    assert s.is_parquet_dataset(path)
+    back = s.read_parquet(path)
+    np.testing.assert_allclose(back["a"].to_numpy(), [1.0, 2.0, 3.0])
+    # Re-materialization overwrites cleanly.
+    s.save_data_frame(pd.DataFrame({"a": [9.0], "y": [1]}), path)
+    assert len(s.read_parquet(path)) == 1
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    s = LocalStore(str(tmp_path))
+    assert not s.has_checkpoint("run")
+    s.save_checkpoint("run", {"params": np.arange(3), "epoch": 0}, epoch=0)
+    s.save_checkpoint("run", {"params": np.arange(3) * 2, "epoch": 1}, epoch=1)
+    assert s.has_checkpoint("run")
+    ck = s.load_checkpoint("run")
+    assert ck["epoch"] == 1
+    np.testing.assert_array_equal(ck["params"], np.arange(3) * 2)
+    # Per-epoch history kept alongside the latest.
+    run_dir = s.get_run_path("run")
+    names = sorted(os.listdir(run_dir))
+    assert "checkpoint.epoch0.pkl" in names and "checkpoint.epoch1.pkl" in names
+
+
+# ---------------------------------------------------------------------------
+def _toy_df(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n).astype(np.float32)
+    return pd.DataFrame({"x": x, "y": 3.0 * x + 1.0})
+
+
+def _make_estimator(store=None, run_id=None, epochs=2, num_proc=None):
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.spark.estimator import JaxEstimator
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x).squeeze(-1)
+
+    return JaxEstimator(
+        model=Lin(),
+        optimizer=optax.sgd(0.5),
+        loss=lambda pred, y: jnp.mean((pred - y) ** 2),
+        feature_cols=["x"],
+        label_col="y",
+        epochs=epochs,
+        batch_size=16,
+        num_proc=num_proc,
+        store=store,
+        run_id=run_id,
+    )
+
+
+def test_estimator_fit_with_store_checkpoints(tmp_path):
+    store = LocalStore(str(tmp_path))
+    est = _make_estimator(store=store, run_id="fit1", epochs=8)
+    df = _toy_df()
+    model = est.fit(df)
+    # Data was materialized to store parquet.
+    assert store.is_parquet_dataset(store.get_train_data_path())
+    # Per-epoch checkpoints exist and the latest carries the last epoch.
+    assert store.has_checkpoint("fit1")
+    assert store.load_checkpoint("fit1")["epoch"] == 7
+    # The fitted model predicts the line reasonably.
+    pred = model.transform(df)
+    err = np.abs(pred["prediction"].to_numpy()
+                 - df["y"].to_numpy()).mean()
+    assert err < 0.5
+
+
+def test_estimator_resumes_from_checkpoint(tmp_path):
+    store = LocalStore(str(tmp_path))
+    df = _toy_df()
+    est1 = _make_estimator(store=store, run_id="resume", epochs=2)
+    est1.fit(df)
+    p0 = store.load_checkpoint("resume")
+
+    # Second fit with more epochs resumes at epoch 2, not epoch 0.
+    est2 = _make_estimator(store=store, run_id="resume", epochs=4)
+    est2.fit(df)
+    p1 = store.load_checkpoint("resume")
+    assert p0["epoch"] == 1 and p1["epoch"] == 3
+
+
+def test_estimator_without_store_still_works():
+    est = _make_estimator(epochs=2)
+    model = est.fit(_toy_df())
+    assert model.params is not None
+
+
+def test_refit_with_new_data_retrains(tmp_path):
+    """Changing the DataFrame on the same store + run_id must
+    re-materialize AND retrain — not resume past the new data."""
+    store = LocalStore(str(tmp_path))
+    df1 = _toy_df()
+    est1 = _make_estimator(store=store, run_id="swap", epochs=2)
+    est1.fit(df1)
+    assert store.load_checkpoint("swap")["epoch"] == 1
+
+    # Different data: y = -3x (opposite slope).
+    x = np.random.RandomState(1).rand(64).astype(np.float32)
+    df2 = pd.DataFrame({"x": x, "y": -3.0 * x})
+    est2 = _make_estimator(store=store, run_id="swap", epochs=2)
+    model2 = est2.fit(df2)
+    # Data was re-materialized (fingerprints differ) ...
+    assert store.matches_fingerprint(df2, store.get_train_data_path())
+    assert not store.matches_fingerprint(df1, store.get_train_data_path())
+    # ... and training restarted on df2 (checkpoint bound to df2's
+    # fingerprint, params moved toward the NEW slope).
+    ck = store.load_checkpoint("swap")
+    assert ck["data_fp"] == store.dataset_fingerprint(df2)
+    pred = model2.transform(df2)
+    err = np.abs(pred["prediction"].to_numpy() - df2["y"].to_numpy()).mean()
+    err_old = np.abs(pred["prediction"].to_numpy() - (3.0 * x + 1.0)).mean()
+    assert err < err_old  # fitted the new relation, not the old one
