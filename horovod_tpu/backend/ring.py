@@ -40,49 +40,85 @@ _RING_OPS = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
              ReduceOp.PRODUCT)
 
 
+# -- eligibility predicates -------------------------------------------
+# Shared by the mixin's own dispatch AND the engine's OperationManager
+# (Enabled() in the reference, operation_manager.cc:42-122) so the two
+# can never drift. All inputs are coordinator-negotiated or
+# collectively-agreed, so every rank reaches the same decision locally.
+
+def ring_threshold() -> int:
+    try:
+        return int(os.environ.get("HOROVOD_RING_THRESHOLD",
+                                  DEFAULT_RING_THRESHOLD))
+    except ValueError:
+        return DEFAULT_RING_THRESHOLD
+
+
+def ring_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
+    if os.environ.get("HOROVOD_CPU_OPERATIONS", "").lower() == "star":
+        return False
+    return (
+        hasattr(backend, "send_to") and hasattr(backend, "recv_from")
+        and op in _RING_OPS
+        and nbytes >= ring_threshold()
+    )
+
+
+def hierarchical_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
+    return (
+        ring_eligible(backend, nbytes, op)
+        and backend.hierarchical
+        and hierarchy_valid(backend)
+    )
+
+
+def hierarchical_capable(backend) -> bool:
+    """Static capability (used for the engine's collective validity
+    agreement at init): p2p transport + homogeneous topology. The
+    per-call gate is hierarchical_eligible (adds toggle + size + op)."""
+    return (
+        hasattr(backend, "send_to") and hasattr(backend, "recv_from")
+        and hierarchy_valid(backend)
+    )
+
+
+def hierarchy_valid(backend) -> bool:
+    """Hierarchical needs a homogeneous contiguous host packing
+    (rank == cross_rank*local_size + local_rank), like the
+    reference's is_homogeneous gate (nccl_operations.cc:190-405)."""
+    return (
+        backend.local_size > 1
+        and backend.cross_size > 1
+        and backend.size == backend.local_size * backend.cross_size
+        and backend.rank
+        == backend.cross_rank * backend.local_size + backend.local_rank
+    )
+
+
 class RingCollectivesMixin(StarCollectivesMixin):
     """Adds a ring allreduce on transports providing p2p primitives
     `send_to(rank, bytes)` / `recv_from(rank) -> bytes`."""
 
-    def _ring_enabled(self) -> bool:
-        if os.environ.get("HOROVOD_CPU_OPERATIONS", "").lower() == "star":
-            return False
-        return hasattr(self, "send_to") and hasattr(self, "recv_from")
-
     def _ring_threshold(self) -> int:
-        try:
-            return int(os.environ.get("HOROVOD_RING_THRESHOLD",
-                                      DEFAULT_RING_THRESHOLD))
-        except ValueError:
-            return DEFAULT_RING_THRESHOLD
+        return ring_threshold()
 
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         if self.size == 1:
             return arr.copy()
-        if not self._ring_enabled() or op not in _RING_OPS:
-            return super().allreduce(arr, op)
         # No eligibility exchange is needed: allreduce sizes are
         # negotiated by the coordinator, so every rank (including joined
         # ranks, which the engine hands full-shape zero buffers) holds
         # the same element count and reaches the same ring/star decision
         # from its own arr.nbytes. The hierarchical toggle flips only at
         # autotune sync boundaries, collectively.
-        if arr.nbytes < self._ring_threshold():
-            return super().allreduce(arr, op)  # star: latency-optimal
-        if self.hierarchical and self._hierarchy_valid():
+        if hierarchical_eligible(self, arr.nbytes, op):
             return self._hierarchical_allreduce(arr, op)
-        return self._ring_allreduce(arr, op)
+        if ring_eligible(self, arr.nbytes, op):
+            return self._ring_allreduce(arr, op)
+        return super().allreduce(arr, op)  # star: latency-optimal
 
     def _hierarchy_valid(self) -> bool:
-        """Hierarchical needs a homogeneous contiguous host packing
-        (rank == cross_rank*local_size + local_rank), like the
-        reference's is_homogeneous gate (nccl_operations.cc:190-405)."""
-        return (
-            self.local_size > 1
-            and self.cross_size > 1
-            and self.size == self.local_size * self.cross_size
-            and self.rank == self.cross_rank * self.local_size + self.local_rank
-        )
+        return hierarchy_valid(self)
 
     # ------------------------------------------------------------------
     def _sendrecv(self, dest: int, payload: bytes, src: int) -> bytes:

@@ -112,6 +112,7 @@ class Engine:
         self.backend = None
         self.controller: Optional[Controller] = None
         self.param_manager = None
+        self.op_manager = None
         self.tensor_queue = TensorQueue()
         self.handles = HandleManager()
         self.timeline = Timeline() if rank == 0 else Timeline(use_env=False)
@@ -176,8 +177,10 @@ class Engine:
             # _initialized so start() stays non-collective; every rank's
             # background thread performs it before its first cycle.
             self._hier_valid = False
-            if self.size > 1 and hasattr(self.backend, "_hierarchy_valid"):
-                word = 1 if self.backend._hierarchy_valid() else 0
+            if self.size > 1:
+                from ..backend.ring import hierarchical_capable
+
+                word = 1 if hierarchical_capable(self.backend) else 0
                 self._hier_valid = bool(
                     self.backend.allreduce_words([word], "and")[0] & 1
                 )
@@ -190,6 +193,11 @@ class Engine:
             # Arms rebuild happens before the first cycle, hence before
             # any sample window can open.
             self.param_manager.set_tune_hierarchical(self._hier_valid)
+            # Ordered op registry; first Enabled() implementation wins
+            # (ref: CreateOperationManager, operations.cc:142-249).
+            from .operation_manager import build_default
+
+            self.op_manager = build_default(self.backend)
             while self._run_loop_once():
                 pass
         except BaseException as e:
@@ -266,17 +274,23 @@ class Engine:
             if resp.response_type in (ResponseType.ALLREDUCE, ResponseType.ADASUM):
                 self._do_allreduce(resp, entries)
             elif resp.response_type == ResponseType.ALLGATHER:
+                op = self.op_manager.select(ResponseType.ALLGATHER)
                 for e in entries:
-                    out = self.backend.allgatherv(e.tensor, list(resp.tensor_sizes))
+                    with self.timeline.activity(e.tensor_name, op.name):
+                        out = op.execute(e.tensor, list(resp.tensor_sizes))
                     self._finish(e, Status.OK(), out)
             elif resp.response_type == ResponseType.BROADCAST:
+                op = self.op_manager.select(ResponseType.BROADCAST)
                 for e in entries:
                     arr = e.tensor if self.rank == e.root_rank else None
-                    out = self.backend.broadcast(arr, e.root_rank)
+                    with self.timeline.activity(e.tensor_name, op.name):
+                        out = op.execute(arr, e.root_rank)
                     self._finish(e, Status.OK(), out)
             elif resp.response_type == ResponseType.ALLTOALL:
+                op = self.op_manager.select(ResponseType.ALLTOALL)
                 for e in entries:
-                    out, recv_splits = self.backend.alltoallv(e.tensor, e.splits)
+                    with self.timeline.activity(e.tensor_name, op.name):
+                        out, recv_splits = op.execute(e.tensor, e.splits)
                     e.output = out
                     self._finish(e, Status.OK(), (out, recv_splits))
             elif resp.response_type == ResponseType.BARRIER:
@@ -318,12 +332,14 @@ class Engine:
                 zeros = np.zeros(
                     count, from_wire_dtype(resp.tensor_type)
                 )
-                if adasum:
-                    self.backend.adasum_allreduce_all(zeros)
-                else:
-                    self.backend.allreduce(
-                        zeros, ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
-                    )
+                # Same registry selection as contributing ranks: the
+                # negotiated byte count is identical, so the joined rank
+                # lands on the same data-plane algorithm.
+                rop = ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
+                self.op_manager.select(
+                    ResponseType.ADASUM if adasum else ResponseType.ALLREDUCE,
+                    nbytes=zeros.nbytes, reduce_op=rop,
+                ).execute(zeros, rop)
             return
         name0 = entries[0].tensor_name
         if len(entries) == 1:
@@ -333,33 +349,34 @@ class Engine:
             # Fusion buffer: flatten + concat (ref: MemcpyInFusionBuffer,
             # collective_operations.cc; native multithreaded memcpy when
             # the C++ core is built).
-            self.timeline.activity_start(name0, MEMCPY_IN_FUSION_BUFFER)
-            shapes = [e.tensor.shape for e in entries]
-            buf = self._pack_fusion(entries)
-            self.timeline.activity_end(name0)
+            with self.timeline.activity(name0, MEMCPY_IN_FUSION_BUFFER):
+                shapes = [e.tensor.shape for e in entries]
+                buf = self._pack_fusion(entries)
         if pre != 1.0:
             buf = _scale_np(buf, pre)
-        op_name = "ADASUM" if adasum else "ALLREDUCE"
-        self.timeline.activity_start(name0, op_name)
-        if adasum:
-            red = self.backend.adasum_allreduce_all(np.asarray(buf))
-        else:
-            red = self.backend.allreduce(
-                np.asarray(buf), ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
-            )
-        self.timeline.activity_end(name0)
+        buf = np.asarray(buf)
+        rop = ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
+        # First Enabled() implementation wins; the winning op's name is
+        # the timeline activity, like the reference's NCCL_ALLREDUCE /
+        # MPI_ALLREDUCE lanes (common.h:32-62).
+        op = self.op_manager.select(
+            ResponseType.ADASUM if adasum else ResponseType.ALLREDUCE,
+            nbytes=buf.nbytes, reduce_op=rop,
+        )
+        with self.timeline.activity(name0, op.name):
+            red = op.execute(buf, rop)
         if post != 1.0:
             red = _scale_np(red, post)
         if shapes is None:
             self._finish(entries[0], Status.OK(), red.reshape(entries[0].tensor.shape))
         else:
-            self.timeline.activity_start(name0, MEMCPY_OUT_FUSION_BUFFER)
-            off = 0
-            for e, shape in zip(entries, shapes):
-                n = int(np.prod(shape)) if shape else 1
-                self._finish(e, Status.OK(), red[off : off + n].reshape(shape))
-                off += n
-            self.timeline.activity_end(name0)
+            with self.timeline.activity(name0, MEMCPY_OUT_FUSION_BUFFER):
+                off = 0
+                for e, shape in zip(entries, shapes):
+                    n = int(np.prod(shape)) if shape else 1
+                    self._finish(e, Status.OK(),
+                                 red[off : off + n].reshape(shape))
+                    off += n
 
     def _pack_fusion(self, entries: List[TensorTableEntry]) -> np.ndarray:
         """Copy entries into the persistent fusion buffer (one concat

@@ -1,0 +1,117 @@
+"""Pluggable collective-op registry with Enabled() priority dispatch.
+
+(ref: horovod/common/ops/operation_manager.{h,cc}:42-122 — per response
+type an ordered list of op implementations; the first whose Enabled()
+returns true executes. The reference's lists are built at init from
+compiled backends, operations.cc:142-249 CreateOperationManager; here
+they are built from the process-mode backend's capabilities —
+hierarchical ring / flat ring / star — plus Adasum. The TPU traced
+plane (ops/traced.py) bypasses this entirely: under jit XLA is the
+operation manager.)
+
+The eligibility predicates live in backend/ring.py and are shared with
+the backend mixin's own dispatch, so engine-level selection and direct
+backend calls can never disagree — disagreement between ranks would
+deadlock a collective.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.message import ResponseType
+from ..common.types import ReduceOp
+
+
+class OpEntry:
+    """One registered implementation (ref: HorovodOp subclasses +
+    Enabled(), collective_operations.h:38-257)."""
+
+    def __init__(self, name: str,
+                 enabled: Callable[..., bool],
+                 execute: Callable[..., np.ndarray]):
+        self.name = name
+        self.enabled = enabled
+        self.execute = execute
+
+
+class OperationManager:
+    def __init__(self):
+        self._ops: Dict[ResponseType, List[OpEntry]] = {}
+
+    def register(self, response_type: ResponseType, entry: OpEntry):
+        self._ops.setdefault(response_type, []).append(entry)
+
+    def entries(self, response_type: ResponseType) -> List[OpEntry]:
+        return list(self._ops.get(response_type, []))
+
+    def select(self, response_type: ResponseType, **ctx) -> OpEntry:
+        """First enabled op wins (ref: operation_manager.cc:99-116)."""
+        for entry in self._ops.get(response_type, []):
+            if entry.enabled(**ctx):
+                return entry
+        raise RuntimeError(
+            f"no enabled op for {response_type!r} (ctx={ctx})"
+        )
+
+
+def build_default(backend) -> OperationManager:
+    """Priority order mirrors the reference's CreateOperationManager
+    (most specialized first): hierarchical ring > flat ring > star for
+    allreduce; star for the other data ops; Adasum native/NumPy VHDD."""
+    from ..backend import ring as ring_mod
+
+    mgr = OperationManager()
+
+    def _local(nbytes=0, reduce_op=None):
+        return backend.size == 1
+
+    if backend.size == 1:
+        mgr.register(ResponseType.ALLREDUCE, OpEntry(
+            "LOCAL_ALLREDUCE", _local,
+            lambda buf, rop: backend.allreduce(buf, rop),
+        ))
+    else:
+        mgr.register(ResponseType.ALLREDUCE, OpEntry(
+            "HIERARCHICAL_RING_ALLREDUCE",
+            lambda nbytes, reduce_op: ring_mod.hierarchical_eligible(
+                backend, nbytes, reduce_op),
+            lambda buf, rop: backend._hierarchical_allreduce(buf, rop),
+        ))
+        mgr.register(ResponseType.ALLREDUCE, OpEntry(
+            "RING_ALLREDUCE",
+            lambda nbytes, reduce_op: ring_mod.ring_eligible(
+                backend, nbytes, reduce_op),
+            lambda buf, rop: backend._ring_allreduce(buf, rop),
+        ))
+        from ..backend.star import StarCollectivesMixin
+
+        mgr.register(ResponseType.ALLREDUCE, OpEntry(
+            "STAR_ALLREDUCE",
+            lambda nbytes, reduce_op: True,
+            lambda buf, rop: StarCollectivesMixin.allreduce(
+                backend, buf, rop),
+        ))
+
+    mgr.register(ResponseType.ADASUM, OpEntry(
+        "ADASUM_VHDD",
+        lambda nbytes=0, reduce_op=None: True,
+        lambda buf, rop=None: backend.adasum_allreduce_all(buf),
+    ))
+    mgr.register(ResponseType.ALLGATHER, OpEntry(
+        "STAR_ALLGATHER",
+        lambda **_: True,
+        backend.allgatherv,
+    ))
+    mgr.register(ResponseType.BROADCAST, OpEntry(
+        "STAR_BROADCAST",
+        lambda **_: True,
+        backend.broadcast,
+    ))
+    mgr.register(ResponseType.ALLTOALL, OpEntry(
+        "STAR_ALLTOALL",
+        lambda **_: True,
+        backend.alltoallv,
+    ))
+    return mgr

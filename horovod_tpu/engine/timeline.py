@@ -86,6 +86,22 @@ class Timeline:
     def activity_end(self, name: str):
         self._emit({"ph": "E", "pid": 0, "tid": self._tid(name), "ts": self._ts()})
 
+    def activity(self, name: str, activity: str):
+        """Context manager: the E event fires even when the op raises,
+        keeping B/E balanced on the lane (an unbalanced lane nests every
+        later event under the dangling phase in the trace viewer)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _span():
+            self.activity_start(name, activity)
+            try:
+                yield
+            finally:
+                self.activity_end(name)
+
+        return _span()
+
     def end(self, name: str, op_name: str):
         self._emit({"ph": "E", "name": op_name, "pid": 0,
                     "tid": self._tid(name), "ts": self._ts()})

@@ -1,0 +1,115 @@
+"""Operation-manager registry tests.
+
+(ref: horovod/common/ops/operation_manager.cc:42-122 — ordered op lists
+per response type, first Enabled() implementation executes;
+operations.cc:142-249 CreateOperationManager priority order.)
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.backend.threaded import ThreadedGroup
+from horovod_tpu.common.message import ResponseType
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.engine.operation_manager import (
+    OperationManager,
+    OpEntry,
+    build_default,
+)
+
+
+def test_first_enabled_wins_and_order_matters():
+    mgr = OperationManager()
+    calls = []
+    mgr.register(ResponseType.ALLREDUCE, OpEntry(
+        "SPECIAL", lambda nbytes, reduce_op: nbytes >= 100,
+        lambda buf, rop: calls.append("SPECIAL") or buf,
+    ))
+    mgr.register(ResponseType.ALLREDUCE, OpEntry(
+        "FALLBACK", lambda nbytes, reduce_op: True,
+        lambda buf, rop: calls.append("FALLBACK") or buf,
+    ))
+    big = mgr.select(ResponseType.ALLREDUCE, nbytes=200,
+                     reduce_op=ReduceOp.SUM)
+    small = mgr.select(ResponseType.ALLREDUCE, nbytes=4,
+                       reduce_op=ReduceOp.SUM)
+    assert big.name == "SPECIAL" and small.name == "FALLBACK"
+
+
+def test_select_raises_when_nothing_enabled():
+    mgr = OperationManager()
+    mgr.register(ResponseType.ALLREDUCE, OpEntry(
+        "NEVER", lambda **_: False, lambda *a: None))
+    with pytest.raises(RuntimeError):
+        mgr.select(ResponseType.ALLREDUCE, nbytes=1, reduce_op=ReduceOp.SUM)
+
+
+def _topo(b, lr, ls, cr, cs, hier):
+    b.set_topology(lr, ls, cr, cs)
+    b.hierarchical = hier
+    return b
+
+
+def test_build_default_priority(monkeypatch):
+    """On a 2x2 hierarchical-toggled backend: hierarchical ring above
+    threshold, star below; flat ring when hierarchy invalid; star when
+    HOROVOD_CPU_OPERATIONS=star."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "64")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    g = ThreadedGroup(4)
+    b = _topo(g.backend(0), 0, 2, 0, 2, hier=True)
+    mgr = build_default(b)
+    names = [e.name for e in mgr.entries(ResponseType.ALLREDUCE)]
+    assert names == ["HIERARCHICAL_RING_ALLREDUCE", "RING_ALLREDUCE",
+                     "STAR_ALLREDUCE"]
+
+    pick = lambda n: mgr.select(ResponseType.ALLREDUCE, nbytes=n,
+                                reduce_op=ReduceOp.SUM).name
+    assert pick(1024) == "HIERARCHICAL_RING_ALLREDUCE"
+    assert pick(8) == "STAR_ALLREDUCE"
+
+    b.hierarchical = False
+    assert pick(1024) == "RING_ALLREDUCE"
+
+    # Unsupported reduce op for rings -> star regardless of size.
+    assert mgr.select(ResponseType.ALLREDUCE, nbytes=1024,
+                      reduce_op=ReduceOp.ADASUM).name == "STAR_ALLREDUCE"
+
+    monkeypatch.setenv("HOROVOD_CPU_OPERATIONS", "star")
+    b.hierarchical = True
+    assert pick(1024) == "STAR_ALLREDUCE"
+
+
+def test_engine_uses_registry_and_timelines_op_name(tmp_path, monkeypatch):
+    """End to end: the engine dispatches through the registry and the
+    timeline activity carries the winning op's name (the reference's
+    NCCL_ALLREDUCE/MPI_ALLREDUCE lanes, common.h:32-62)."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_engine import run_ranks
+
+    path = tmp_path / "tl.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "64")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+
+    def fn(eng, rank):
+        big = eng.synchronize(eng.enqueue_allreduce(
+            np.full(1000, float(rank + 1), np.float32), name="big"),
+            timeout=30)
+        small = eng.synchronize(eng.enqueue_allreduce(
+            np.full(2, float(rank + 1), np.float32), name="small"),
+            timeout=30)
+        np.testing.assert_allclose(big, np.full(1000, 3.0))
+        np.testing.assert_allclose(small, np.full(2, 3.0))
+        return True
+
+    run_ranks(2, fn)
+    events = json.loads(path.read_text())
+    names = {e.get("name") for e in events}
+    assert "RING_ALLREDUCE" in names   # big tensor rode the ring
+    assert "STAR_ALLREDUCE" in names   # small tensor stayed on star
