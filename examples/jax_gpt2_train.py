@@ -29,7 +29,7 @@ def main():
     p.add_argument("--ep", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--attn", default="dense",
-                   choices=["dense", "ring", "ulysses"])
+                   choices=["dense", "ring", "ulysses", "flash"])
     p.add_argument("--n-experts", type=int, default=0)
     p.add_argument("--remat", action="store_true")
     args = p.parse_args()

@@ -115,9 +115,51 @@ def _dense_attention_masked(cfg: TransformerConfig, q, k, v, mask):
 
 
 def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
-    """Choose dense vs sequence-parallel attention. The sp kernels run in
-    a nested shard_map that manualizes only `cfg.sp_axis`; batch/head
-    sharding stays under GSPMD."""
+    """Choose dense vs flash (Pallas) vs sequence-parallel attention.
+    The sp kernels run in a nested shard_map that manualizes only
+    `cfg.sp_axis`; batch/head sharding stays under GSPMD."""
+    if cfg.attn_impl == "flash":
+        # Fused Pallas kernel (ops/flash_attention.py): compiled on TPU,
+        # interpreter elsewhere. Not combined with sp sharding — for
+        # sequence parallelism use ring/ulysses. Under a GSPMD mesh the
+        # opaque pallas_call would otherwise force full replication
+        # (GSPMD can't partition through it), so batch/head axes are
+        # manualized with shard_map; attention is independent per
+        # (batch, head), so no collectives are needed inside.
+        from ..ops.flash_attention import flash_attention
+
+        am = jax.sharding.get_abstract_mesh()
+        manual = [
+            ax for ax in ("dp", "tp") if am is not None
+            and ax in am.axis_names and am.shape[ax] > 1
+        ]
+        if not manual:
+            return flash_attention(q, k, v, mask, causal=cfg.causal).astype(
+                cfg.dtype)
+        from jax.sharding import PartitionSpec as P
+
+        from ..utils.compat import shard_map
+
+        dp = "dp" if "dp" in manual else None
+        tp = "tp" if "tp" in manual else None
+        qkv_spec = P(dp, None, tp, None)   # (B, S, H, D)
+        mask_spec = P(dp, None)            # (B, S)
+
+        if mask is None:
+            fn = shard_map(
+                lambda q, k, v: flash_attention(q, k, v,
+                                                causal=cfg.causal),
+                mesh=am, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
+                axis_names=set(manual),
+            )
+            return fn(q, k, v).astype(cfg.dtype)
+        fn = shard_map(
+            lambda q, k, v, m: flash_attention(q, k, v, m,
+                                               causal=cfg.causal),
+            mesh=am, in_specs=(qkv_spec,) * 3 + (mask_spec,),
+            out_specs=qkv_spec, axis_names=set(manual),
+        )
+        return fn(q, k, v, mask).astype(cfg.dtype)
     if cfg.attn_impl not in ("ring", "ulysses"):
         return _dense_attention_masked(cfg, q, k, v, mask)
     am = jax.sharding.get_abstract_mesh()
