@@ -72,6 +72,19 @@ def hierarchical_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
     )
 
 
+def ring_allgather_eligible(backend, nbytes: int) -> bool:
+    """Ring allgather for large payloads (ref: GlooAllgather's ring,
+    gloo_operations.cc:184): nbytes is the negotiated TOTAL output size,
+    identical on every rank, so the decision is collectively
+    consistent."""
+    if os.environ.get("HOROVOD_CPU_OPERATIONS", "").lower() == "star":
+        return False
+    return (
+        hasattr(backend, "send_to") and hasattr(backend, "recv_from")
+        and nbytes >= ring_threshold()
+    )
+
+
 def hierarchical_capable(backend) -> bool:
     """Static capability (used for the engine's collective validity
     agreement at init): p2p transport + homogeneous topology. The
@@ -119,6 +132,49 @@ class RingCollectivesMixin(StarCollectivesMixin):
 
     def _hierarchy_valid(self) -> bool:
         return hierarchy_valid(self)
+
+    def allgatherv(self, arr: np.ndarray, first_dims: List[int]) -> np.ndarray:
+        if self.size == 1:
+            return super().allgatherv(arr, first_dims)
+        # Total output bytes from the NEGOTIATED first_dims + validated
+        # trailing shape — identical on every rank (a 0-row local block
+        # still knows its trailing shape), so the ring/star decision is
+        # collectively consistent.
+        row = int(np.prod(arr.shape[1:])) if arr.ndim else 1
+        total = sum(first_dims) * row * arr.dtype.itemsize
+        if ring_allgather_eligible(self, total):
+            return self._ring_allgatherv(arr, first_dims)
+        return super().allgatherv(arr, first_dims)
+
+    def _ring_allgatherv(self, arr: np.ndarray,
+                         first_dims: List[int]) -> np.ndarray:
+        """Ring allgather of variable-first-dim blocks: each step sends
+        the most recently received block right and receives a new one
+        from the left; after N-1 rotations every rank holds all blocks.
+        Each byte crosses each link once — flat per-rank bandwidth vs
+        star's O(N*bytes) on rank 0 (ref: gloo_operations.cc:184)."""
+        n = self.size
+        right = (self.rank + 1) % n
+        left = (self.rank - 1) % n
+        blocks: List[Optional[np.ndarray]] = [None] * n
+        blocks[self.rank] = np.ascontiguousarray(arr)
+        payload = pack_array(blocks[self.rank])
+        for s in range(n - 1):
+            payload = self._sendrecv(right, payload, left)
+            src = (self.rank - s - 1) % n
+            blocks[src] = unpack_array(payload)
+            if arr.ndim and blocks[src].shape[0] != first_dims[src]:
+                # Negotiated dims are the contract the threshold decision
+                # was made from; a mismatch means a desynced peer.
+                raise ValueError(
+                    f"allgather block from rank {src} has first dim "
+                    f"{blocks[src].shape[0]}, negotiated {first_dims[src]}"
+                )
+        if arr.ndim:
+            out = np.concatenate(blocks, axis=0)
+        else:
+            out = np.stack(blocks)
+        return out
 
     # ------------------------------------------------------------------
     def _sendrecv(self, dest: int, payload: bytes, src: int) -> bytes:

@@ -274,8 +274,15 @@ class Engine:
             if resp.response_type in (ResponseType.ALLREDUCE, ResponseType.ADASUM):
                 self._do_allreduce(resp, entries)
             elif resp.response_type == ResponseType.ALLGATHER:
-                op = self.op_manager.select(ResponseType.ALLGATHER)
                 for e in entries:
+                    # Negotiated total output bytes — identical on every
+                    # rank, so the ring/star pick is consistent.
+                    row = (int(np.prod(e.tensor.shape[1:]))
+                           if e.tensor.ndim else 1)
+                    nbytes = (sum(resp.tensor_sizes) * row
+                              * e.tensor.dtype.itemsize)
+                    op = self.op_manager.select(ResponseType.ALLGATHER,
+                                                nbytes=nbytes)
                     with self.timeline.activity(e.tensor_name, op.name):
                         out = op.execute(e.tensor, list(resp.tensor_sizes))
                     self._finish(e, Status.OK(), out)

@@ -61,6 +61,7 @@ def build_default(backend) -> OperationManager:
     (most specialized first): hierarchical ring > flat ring > star for
     allreduce; star for the other data ops; Adasum native/NumPy VHDD."""
     from ..backend import ring as ring_mod
+    from ..backend.star import StarCollectivesMixin
 
     mgr = OperationManager()
 
@@ -85,8 +86,6 @@ def build_default(backend) -> OperationManager:
                 backend, nbytes, reduce_op),
             lambda buf, rop: backend._ring_allreduce(buf, rop),
         ))
-        from ..backend.star import StarCollectivesMixin
-
         mgr.register(ResponseType.ALLREDUCE, OpEntry(
             "STAR_ALLREDUCE",
             lambda nbytes, reduce_op: True,
@@ -99,10 +98,19 @@ def build_default(backend) -> OperationManager:
         lambda nbytes=0, reduce_op=None: True,
         lambda buf, rop=None: backend.adasum_allreduce_all(buf),
     ))
+    if backend.size > 1 and hasattr(backend, "_ring_allgatherv"):
+        mgr.register(ResponseType.ALLGATHER, OpEntry(
+            "RING_ALLGATHER",
+            lambda nbytes=0: ring_mod.ring_allgather_eligible(
+                backend, nbytes),
+            backend._ring_allgatherv,
+        ))
     mgr.register(ResponseType.ALLGATHER, OpEntry(
         "STAR_ALLGATHER",
         lambda **_: True,
-        backend.allgatherv,
+        (lambda arr, dims: StarCollectivesMixin.allgatherv(
+            backend, arr, dims))
+        if backend.size > 1 else backend.allgatherv,
     ))
     mgr.register(ResponseType.BROADCAST, OpEntry(
         "STAR_BROADCAST",

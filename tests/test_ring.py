@@ -104,3 +104,109 @@ def test_ring_with_join():
         return True
 
     assert run(fn, np=3, extra_env=ENV) == [True, True, True]
+
+
+# ---------------------------------------------------------------------------
+# Ring allgather (ref: GlooAllgather ring, gloo_operations.cc:184)
+def _run_ring_backends(size, fn):
+    import threading
+
+    from horovod_tpu.backend.threaded import ThreadedGroup
+
+    group = ThreadedGroup(size)
+    backends = [group.backend(r) for r in range(size)]
+    results = [None] * size
+    errors = [None] * size
+
+    def worker(r):
+        try:
+            results[r] = fn(backends[r], r)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+def test_ring_allgatherv_variable_dims(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    dims = [2, 0, 3, 1]  # includes a zero-row rank
+
+    def fn(b, r):
+        arr = np.full((dims[r], 3), float(r), np.float32)
+        return b.allgatherv(arr, list(dims))
+
+    out = _run_ring_backends(4, fn)
+    expect = np.concatenate(
+        [np.full((dims[r], 3), float(r), np.float32) for r in range(4)]
+    )
+    for o in out:
+        np.testing.assert_allclose(o, expect)
+
+
+def test_ring_allgatherv_matches_star(monkeypatch):
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    rng = np.random.RandomState(0)
+    blocks = [rng.rand(5, 7).astype(np.float64) for _ in range(3)]
+
+    def ring_fn(b, r):
+        return b._ring_allgatherv(blocks[r].copy(), [5, 5, 5])
+
+    out = _run_ring_backends(3, ring_fn)
+    expect = np.concatenate(blocks)
+    for o in out:
+        np.testing.assert_allclose(o, expect)
+
+
+def test_small_allgather_stays_on_star(monkeypatch):
+    """Below the threshold the latency-optimal star path runs."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", str(1 << 20))
+    calls = []
+
+    def fn(b, r):
+        orig = b._ring_allgatherv
+        b._ring_allgatherv = lambda *a: calls.append(r) or orig(*a)
+        return b.allgatherv(np.ones((2, 2), np.float32), [2, 2])
+
+    out = _run_ring_backends(2, fn)
+    for o in out:
+        assert o.shape == (4, 2)
+    assert calls == []
+
+
+def test_engine_ring_allgather_end_to_end(monkeypatch, tmp_path):
+    """Engine-level: a large allgather rides the ring (timeline shows
+    RING_ALLGATHER) and returns correct variable-dim output."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_engine import run_ranks
+
+    path = tmp_path / "tl.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "64")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+
+    def fn(eng, rank):
+        arr = np.full((rank + 1, 100), float(rank), np.float32)
+        out = eng.synchronize(
+            eng.enqueue_allgather(arr, name="g"), timeout=30)
+        expect = np.concatenate([
+            np.full((r + 1, 100), float(r), np.float32) for r in range(2)
+        ])
+        np.testing.assert_allclose(out, expect)
+        return True
+
+    run_ranks(2, fn)
+    events = json.loads(path.read_text())
+    assert "RING_ALLGATHER" in {e.get("name") for e in events}
