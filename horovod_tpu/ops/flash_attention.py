@@ -11,19 +11,18 @@ device code, horovod/common/ops/cuda/cuda_kernels.cu:25-77 — the
 equivalent TPU move per SURVEY.md §2.7 is Pallas for ops XLA fusion
 can't cover).
 
-Measured on one TPU v5e chip (B=2, H=8, D=64, bf16): 2.5x faster than
-the XLA dense path at S=4096 causal, 1.1x non-causal; parity at S=1024.
-Enable per model with TransformerConfig(attn_impl="flash").
+Measured on one TPU v5e chip (H=8, D=64, bf16, causal): forward 2.5x
+the XLA dense path at S=4096; forward+backward 2.3x at S=4096 and ~20x
+at S=8192 (where dense spills its (S, S) scores to HBM). Enable per
+model with TransformerConfig(attn_impl="flash").
 
 Semantics match parallel/ring.py's dense_attention exactly, including
 the padding-mask convention (1 = attend, 0 = pad; fully-masked rows
-yield zeros). The backward pass is a custom VJP that recomputes
-attention with the jnp reference implementation: only the (B,S,H,D)
-inputs are saved (flash-style recompute), but the recompute itself is
-the DENSE path, so the backward step does materialize (B,H,S,S) scores
-in HBM — training memory matches attn_impl="dense"; the VMEM-bounded
-win applies to the forward/inference path. A blockwise Pallas backward
-is the known follow-up.
+yield zeros). The backward pass is blockwise Pallas too (Dao et al.
+structure): the forward saves only the output and the per-row
+logsumexp, and two kernels (dQ; dK/dV) recompute probability tiles
+on the fly — so neither direction ever materializes (S, S) scores in
+HBM, and causal block-skipping applies in both.
 
 Gradients therefore differentiate the same math; forward numerics agree
 with the reference to bf16/f32 tolerance (asserted in
@@ -41,6 +40,15 @@ import numpy as np
 DEFAULT_BLOCK_Q = 128
 NEG_INF = -1e30
 
+def _prec(dtype):
+    """Explicit contract precision for in-kernel dots: bf16 (and other
+    sub-f32) inputs must use the native MXU path — a global
+    jax_default_matmul_precision=float32 would otherwise inject an
+    fp32-precision bf16 matmul that Mosaic rejects ("Bad lhs type").
+    f32 inputs keep None so the global config still applies to them."""
+    return None if dtype == jnp.float32 else jax.lax.Precision.DEFAULT
+
+
 try:  # Pallas import kept optional: CPU-only deployments without the
     # TPU plugin still import this module (interpret mode covers tests).
     from jax.experimental import pallas as pl
@@ -50,12 +58,13 @@ except Exception:  # pragma: no cover
     HAVE_PALLAS = False
 
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float,
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale: float,
             causal: bool, block_q: int, block_k: int):
     """One (batch*head, q-block) grid step, streaming k-blocks.
 
     q_ref: (1, block_q, D); k_ref/v_ref: (1, S_pad, D) VMEM-resident;
-    mask_ref: (1, 1, S_pad); o_ref: (1, block_q, D)
+    mask_ref: (1, 1, S_pad); o_ref: (1, block_q, D);
+    lse_ref: (1, 1, block_q) per-row logsumexp residual
 
     Flash-style: a fori_loop folds (block_q, block_k) score tiles into a
     running (max, normalizer, accumulator) state, so peak VMEM for
@@ -83,7 +92,7 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float,
         m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(q.dtype),
         ) * scale                               # (block_q, block_k) f32
         kpos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -102,6 +111,7 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float,
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_prec(v_blk.dtype),
         )
         acc = acc * corr[:, None] + pv
         return acc, m_new, l
@@ -118,22 +128,28 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float,
     l = jnp.zeros((block_q,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc, m, l))
 
-    o = acc / jnp.maximum(l, 1e-30)[:, None]
+    l_safe = jnp.maximum(l, 1e-30)
+    o = acc / l_safe[:, None]
     o_ref[0] = o.astype(o_ref.dtype)
+    # Per-row logsumexp, the only residual the backward needs beyond the
+    # inputs (Dao et al. flash backward): p = exp(s - L) is already
+    # normalized.
+    lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
 DEFAULT_BLOCK_K = 512
 
 
-def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
-               interpret: bool) -> jax.Array:
+def _prep(q, k, v, mask, block_q: int):
+    """Shared layout/padding for forward and backward: (B,S,H,D) ->
+    (B*H,S,D) with queries padded to a block_q multiple (garbage rows
+    sliced off after) and keys/values/mask padded to a block_k multiple
+    (padded keys carry mask 0, so they never contribute). Both passes
+    MUST use identical block/pad arithmetic for the saved lse residual
+    to line up with the backward's blocks."""
     B, S, H, D = q.shape
-    scale = 1.0 / float(np.sqrt(D))
     bq = min(block_q, S)
     bk = min(DEFAULT_BLOCK_K, S)
-    # Pad queries to a bq multiple (garbage rows sliced off after) and
-    # keys/values to a bk multiple (padded keys carry mask 0, so they
-    # never contribute).
     pad_q = (-S) % bq
     pad_k = (-S) % bk
 
@@ -147,7 +163,6 @@ def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
     if pad_k:
         kb_arr = jnp.pad(kb_arr, ((0, 0), (0, pad_k), (0, 0)))
         vb = jnp.pad(vb, ((0, 0), (0, pad_k), (0, 0)))
-    Sq, Sk = S + pad_q, S + pad_k
 
     # (B, 1, Sk): the singleton sublane dim satisfies Mosaic's tiling
     # rule for the (1, 1, Sk) block (last two dims must divide (8, 128)
@@ -158,12 +173,23 @@ def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
         mask2 = mask.astype(jnp.float32).reshape(B, 1, S)
     if pad_k:
         mask2 = jnp.pad(mask2, ((0, 0), (0, 0), (0, pad_k)))
+    return qb, kb_arr, vb, mask2, to_bh, bq, bk, S + pad_q, S + pad_k
 
+
+def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
+               interpret: bool) -> "tuple[jax.Array, jax.Array]":
+    B, S, H, D = q.shape
+    scale = 1.0 / float(np.sqrt(D))
+    qb, kb_arr, vb, mask2, _, bq, bk, Sq, Sk = _prep(q, k, v, mask,
+                                                     block_q)
     grid = (B * H, Sq // bq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
@@ -172,19 +198,209 @@ def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
             # mask indexed by batch = bh // H (static H via closure).
             pl.BlockSpec((1, 1, Sk), lambda bh, qi, H=H: (bh // H, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
         interpret=interpret,
     )(qb, kb_arr, vb, mask2)
 
     out = out[:, :S]
-    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    # Slice lse to the real rows too, so the backward's re-pad is the
+    # single true padding (padded-row lse is kernel garbage here).
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3), lse[:, :, :S]
 
 
-def _reference(q, k, v, mask, causal):
-    """jnp reference (identical math; used for the recompute backward)."""
-    from ..parallel.ring import dense_attention
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, scale: float, causal: bool, block_q: int,
+               block_k: int):
+    """dQ pass: grid (B*H, q-block); stream k-blocks.
 
-    return dense_attention(q, k, v, causal=causal, mask=mask)
+    ds = p * (dO @ V^T - delta) * scale; dq = sum_k ds @ K
+    (Dao et al. flash-attention backward; p = exp(s - L) is the
+    normalized probability, delta = rowsum(dO * O))."""
+    qi = pl.program_id(1)
+    q = q_ref[0]                                 # (bq, D)
+    do = do_ref[0]                               # (bq, D), input dtype
+    L = lse_ref[0, 0]                            # (bq,)
+    delta = delta_ref[0, 0]                      # (bq,)
+    D = q.shape[-1]
+    s_pad = k_ref.shape[1]
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(q.dtype),
+        ) * scale
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = m_blk[None, :] > 0
+        if causal:
+            valid = jnp.logical_and(valid, kpos <= qpos)
+        p = jnp.where(valid, jnp.exp(s - L[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(v_blk.dtype),
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dq = dq + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(k_blk.dtype),
+        )
+        return dq
+
+    num_kb = s_pad // block_k
+    if causal:
+        last_q = (qi + 1) * block_q - 1
+        num_kb = jnp.minimum(num_kb, last_q // block_k + 1)
+    dq = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    """dK/dV pass: grid (B*H, k-block); stream q-blocks.
+
+    dv = sum_q p^T @ dO;  dk = sum_q ds^T @ Q. Causal k-blocks start at
+    the first q-block reaching their diagonal. Padded q rows carry
+    lse=+inf (set by the host wrapper), so p = 0 for them."""
+    ki = pl.program_id(1)
+    k = k_ref[0]                                 # (bk, D)
+    v = v_ref[0]
+    m_blk = mask_ref[0, 0]                       # (bk,)
+    D = k.shape[-1]
+    sq_pad = q_ref.shape[1]
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :]
+        L = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q_blk.dtype),
+        ) * scale                                # (bq, bk)
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = m_blk[None, :] > 0
+        if causal:
+            valid = jnp.logical_and(valid, kpos <= qpos)
+        p = jnp.where(valid, jnp.exp(s - L[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(do_blk.dtype),
+        )                                        # (bk, D)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(v.dtype),
+        )                                        # (bq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q_blk.dtype),
+        )                                        # (bk, D)
+        return dk, dv
+
+    num_qb = sq_pad // block_q
+    start_qb = 0
+    if causal:
+        # q-blocks entirely above this k-block's diagonal contribute
+        # nothing: start at the first block whose last row reaches it.
+        start_qb = (ki * block_k) // block_q
+    dk, dv = jax.lax.fori_loop(
+        start_qb, num_qb, body,
+        (jnp.zeros((block_k, D), jnp.float32),
+         jnp.zeros((block_k, D), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, mask, out, lse, g, causal: bool, block_q: int,
+               interpret: bool):
+    """Blockwise backward: same VMEM-bounded structure as the forward —
+    the (S, S) score matrix is never materialized in HBM."""
+    B, S, H, D = q.shape
+    scale = 1.0 / float(np.sqrt(D))
+    qb, kb_arr, vb, mask2, to_bh, bq, bk, Sq, Sk = _prep(q, k, v, mask,
+                                                         block_q)
+    pad_q = Sq - S
+    dob, ob = to_bh(g), to_bh(out)
+    if pad_q:
+        zq = ((0, 0), (0, pad_q), (0, 0))
+        dob, ob = jnp.pad(dob, zq), jnp.pad(ob, zq)
+        # Padded q rows: lse=+big makes p = exp(s - lse) vanish, so they
+        # contribute nothing to dK/dV (their own dq rows are sliced off).
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)),
+                      constant_values=1e30)
+
+    # delta = rowsum(dO * O) (tiny elementwise; jnp outside the kernel).
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1).reshape(B * H, 1, Sq)
+
+    full_k = pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0))
+    full_q = pl.BlockSpec((1, Sq, D), lambda bh, i: (bh, 0, 0))
+    row_q = pl.BlockSpec((1, 1, Sq), lambda bh, i: (bh, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, Sk), lambda bh, i, H=H: (bh // H, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        grid=(B * H, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            full_k, full_k, mask_spec,
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(qb, kb_arr, vb, mask2, dob, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        grid=(B * H, Sk // bk),
+        in_specs=[
+            full_q,
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, bk), lambda bh, ki, H=H: (bh // H, 0, ki)),
+            full_q, row_q, row_q,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        interpret=interpret,
+    )(qb, kb_arr, vb, mask2, dob, lse, delta)
+
+    def from_bh(x, S_):
+        return x[:, :S_].reshape(B, H, S_, D).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, S), from_bh(dk, S), from_bh(dv, S)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -201,8 +417,9 @@ def flash_attention(q, k, v, mask=None, causal: bool = True,
             "flash_attention needs jax.experimental.pallas; use "
             "attn_impl='dense' (or ring/ulysses) on this installation"
         )
-    return _flash_fwd(q, k, v, mask, causal, block_q,
-                      _resolve_interpret(interpret))
+    out, _ = _flash_fwd(q, k, v, mask, causal, block_q,
+                        _resolve_interpret(interpret))
+    return out
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> bool:
@@ -215,18 +432,15 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
 
 
 def _fwd(q, k, v, mask, causal, block_q, interpret):
-    out = _flash_fwd(q, k, v, mask, causal, block_q,
-                     _resolve_interpret(interpret))
-    return out, (q, k, v, mask)
+    out, lse = _flash_fwd(q, k, v, mask, causal, block_q,
+                          _resolve_interpret(interpret))
+    return out, (q, k, v, mask, out, lse)
 
 
 def _bwd(causal, block_q, interpret, residuals, g):
-    q, k, v, mask = residuals
-    # Flash-style recompute: differentiate the identical-math jnp
-    # reference; XLA fuses this into its own attention backward.
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, mask, causal),
-                     q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, mask, out, lse = residuals
+    dq, dk, dv = _flash_bwd(q, k, v, mask, out, lse, g, causal, block_q,
+                            _resolve_interpret(interpret))
     return dq, dk, dv, None
 
 

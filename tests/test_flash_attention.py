@@ -58,15 +58,26 @@ def test_flash_fully_masked_rows_zero():
     assert np.isfinite(np.asarray(got)).all()
 
 
-def test_flash_gradients_match_dense():
-    q, k, v = _qkv(S=64)
+@pytest.mark.parametrize("S", [64, 96, 130])  # incl. q-padding paths
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_flash_gradients_match_dense(S, causal, use_mask):
+    q, k, v = _qkv(S=S)
+    if use_mask:
+        mask = np.ones((2, S), np.float32)
+        mask[0, S - 10:] = 0.0
+        mask[1, S // 3:] = 0.0
+        jmask = jnp.asarray(mask)
+    else:
+        jmask = None
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64,
-                                       interpret=True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, jmask, causal=causal,
+                                       block_q=64, interpret=True) ** 2)
 
     def loss_dense(q, k, v):
-        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(dense_attention(q, k, v, causal=causal,
+                                       mask=jmask) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
