@@ -1,0 +1,50 @@
+"""MXNet gluon MNIST with horovod_tpu.mxnet (ref: the reference's
+examples/mxnet_mnist.py). Requires mxnet installed; synthetic data
+keeps it runnable offline.
+
+Run:  hvdrun -np 2 python examples/mxnet_mnist.py
+"""
+import numpy as np
+
+import mxnet as mx
+from mxnet import autograd, gluon
+
+import horovod_tpu.mxnet as hvd
+
+
+def main():
+    hvd.init()
+    mx.random.seed(42 + hvd.rank())
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+
+    # Rank 0's initial weights everywhere; trainer allreduces grads
+    # (ref: horovod/mxnet/__init__.py:91 DistributedTrainer).
+    params = net.collect_params()
+    trainer = hvd.DistributedTrainer(params, "sgd",
+                                     {"learning_rate": 0.01 * hvd.size()})
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(hvd.rank())
+    for step in range(30):
+        x = mx.nd.array(rng.rand(32, 784).astype(np.float32))
+        y = mx.nd.array(rng.randint(0, 10, 32))
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(32)
+        if step % 10 == 0 and hvd.rank() == 0:
+            print(f"step {step} loss {float(loss.asscalar()):.4f}")
+
+    final = hvd.allreduce(mx.nd.array([loss.asscalar()]), name="final")
+    if hvd.rank() == 0:
+        print(f"mean final loss across {hvd.size()} ranks: "
+              f"{float(final.asscalar()):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,15 @@ def run(
             + ([inherited] if inherited else [])
         )
         env.setdefault("PYTHONPATH", os.pathsep.join(parts))
+        # Workers default to CPU: a parent holding a single tunneled TPU
+        # (JAX_PLATFORMS=axon et al.) would otherwise leak a platform
+        # the workers cannot re-register and crash at first jax use.
+        # Callers opt workers back onto accelerators by setting
+        # JAX_PLATFORMS in extra_env — in that case the platform's
+        # bootstrap env (e.g. PALLAS_AXON_POOL_IPS) is left inherited.
+        if "JAX_PLATFORMS" not in env:
+            env["JAX_PLATFORMS"] = "cpu"
+            env.setdefault("PALLAS_AXON_POOL_IPS", "")
         rc = launch_static(slots, command, env, verbose, rendezvous=server,
                            prefix_output=not verbose)
         if rc != 0:

@@ -42,10 +42,8 @@ def task_service_main(index: int, driver: str):
 
 
 def main(func_path: str):
-    import os
-
-    # CPU-only workers unless the user's function sets up devices itself.
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The launcher (runner.run) owns the worker platform policy and
+    # always sets JAX_PLATFORMS in the worker env.
     with open(func_path, "rb") as f:
         fn = pickle.load(f)
 

@@ -132,6 +132,12 @@ def run(
     port = server.start()
     addr = _driver_addr()
     env = dict(extra_env or {})
+    # Same platform-leak guard as runner.run(): Spark task workers fork
+    # from a driver that may hold a single tunneled accelerator they
+    # cannot re-register; default them to CPU unless the caller opts in.
+    if "JAX_PLATFORMS" not in env:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
 
     # Driver-side assignment thread: wait for all registrations, then
     # publish the topology rows.
