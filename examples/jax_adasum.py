@@ -11,6 +11,11 @@ horovod/common/ops/adasum/adasum.h). Two spellings:
   * eager (process mode): the engine routes ADASUM requests through the
     native C++ VHDD kernel (horovod_tpu/cc/core.cc).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import numpy as np
 
 

@@ -10,6 +10,11 @@ optional MoE — capabilities beyond the reference's DP-only scope
     python examples/jax_gpt2_train.py --model gpt2-1p3b --dp 8 --tp 4 \
         --sp 2 --attn ring --remat
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import argparse
 import time
 

@@ -9,6 +9,11 @@ TPU-native port of the reference's first-run examples
 Uses a synthetic MNIST-shaped dataset by default (no network egress);
 pass --data-dir with the standard IDX files to train on real MNIST.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import argparse
 import gzip
 import os

@@ -7,6 +7,11 @@ script that prints the current `host[:slots]` set:
         --host-discovery-script ./discover_hosts.sh \
         python examples/jax_mnist_elastic.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import argparse
 
 import numpy as np

@@ -8,6 +8,11 @@ the backward pass on ICI.
     python examples/jax_synthetic_benchmark.py --model resnet50
     python examples/jax_synthetic_benchmark.py --model gpt2-small --batch-size 8
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import argparse
 import time
 

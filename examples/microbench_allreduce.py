@@ -13,6 +13,11 @@ Run under the launcher (2-8 processes):
 
 Rank 0 prints a table and one JSON summary line.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import argparse
 import json
 import os

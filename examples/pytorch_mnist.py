@@ -4,6 +4,11 @@ adapter. Synthetic data keeps it runnable offline.
 
 Run:  hvdrun -np 2 python examples/pytorch_mnist.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
 import numpy as np
 import torch
 import torch.nn as nn

@@ -10,6 +10,9 @@ Run:  python examples/spark_estimator.py [--num-proc 2]
 """
 import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 import tempfile
 
 import numpy as np

@@ -78,14 +78,39 @@ def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
 # Async handle API (ref: horovod/torch/mpi_ops.py:83-219)
 _handles = {}
 
+# Single-process immediate results (negative handles): the reference
+# works without horovodrun at size 1, so the async API must too — there
+# is no engine to enqueue into, the "collective" result is computed on
+# the spot (ref: a size-1 MPI world completes ops locally).
+_local_results: dict = {}
+_local_next = [0]
+
+
+def _local_handle(result) -> int:
+    # Snapshot: numpy views alias the torch tensor's storage; the engine
+    # path returns fresh buffers, so this path must too.
+    if isinstance(result, np.ndarray):
+        result = np.array(result)
+    _local_next[0] -= 1
+    _local_results[_local_next[0]] = result
+    return _local_next[0]
+
+
+def _single() -> bool:
+    return _basics.engine() is None and _basics.size() == 1
+
 
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0) -> int:
     rop = _resolve_op(op, average)
-    h = _engine().enqueue_allreduce(
-        _to_numpy(tensor), name=name, op=rop,
-        prescale=prescale_factor, postscale=postscale_factor,
-    )
+    if _single():
+        arr = _to_numpy(tensor) * prescale_factor * postscale_factor
+        h = _local_handle(arr)
+    else:
+        h = _engine().enqueue_allreduce(
+            _to_numpy(tensor), name=name, op=rop,
+            prescale=prescale_factor, postscale=postscale_factor,
+        )
     _handles[h] = ("allreduce", tensor, None)
     return h
 
@@ -100,13 +125,20 @@ def allreduce_async_(tensor, average=None, name=None, op=None,
 
 
 def allgather_async(tensor, name=None) -> int:
-    h = _engine().enqueue_allgather(_to_numpy(tensor), name=name)
+    if _single():
+        h = _local_handle(_to_numpy(tensor))
+    else:
+        h = _engine().enqueue_allgather(_to_numpy(tensor), name=name)
     _handles[h] = ("allgather", tensor, None)
     return h
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
-    h = _engine().enqueue_broadcast(_to_numpy(tensor), root_rank, name=name)
+    if _single():
+        h = _local_handle(_to_numpy(tensor))
+    else:
+        h = _engine().enqueue_broadcast(_to_numpy(tensor), root_rank,
+                                        name=name)
     _handles[h] = ("broadcast", tensor, None)
     return h
 
@@ -118,15 +150,35 @@ def broadcast_async_(tensor, root_rank, name=None) -> int:
 
 
 def alltoall_async(tensor, splits=None, name=None) -> int:
-    h = _engine().enqueue_alltoall(
-        _to_numpy(tensor), list(splits) if splits is not None else None,
-        name=name,
-    )
+    if _single():
+        arr = np.array(_to_numpy(tensor))
+        rows = arr.shape[0] if arr.ndim else 1
+        if splits is not None:
+            # Same validation the engine applies (enqueue_alltoall):
+            # buggy splits must not pass locally and fail under hvdrun.
+            sp = [int(x) for x in splits]
+            if sum(sp) != rows:
+                raise ValueError(
+                    f"splits sum {sum(sp)} != first dim {rows}")
+        else:
+            sp = [rows]
+        h = _local_handle((arr, sp))
+    else:
+        h = _engine().enqueue_alltoall(
+            _to_numpy(tensor), list(splits) if splits is not None else None,
+            name=name,
+        )
     _handles[h] = ("alltoall", tensor, None)
     return h
 
 
 def poll(handle: int) -> bool:
+    if handle in _local_results:
+        return True
+    if handle < 0:
+        # A consumed/unknown local handle: engine mode returns False
+        # here, so single-process mode must too.
+        return False
     return _engine().poll(handle)
 
 
@@ -134,7 +186,10 @@ def synchronize(handle: int):
     """(ref: mpi_ops.py synchronize — returns the op's result; in-place
     ops copy into the original tensor.)"""
     kind, tensor, _ = _handles.pop(handle, (None, None, None))
-    out = _engine().synchronize(handle)
+    if handle in _local_results:
+        out = _local_results.pop(handle)
+    else:
+        out = _engine().synchronize(handle)
     if kind == "alltoall":
         arr, recv_splits = out
         import torch
