@@ -27,6 +27,7 @@ Logical axis vocabulary (mapped in parallel/sharding.py):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
@@ -70,6 +71,10 @@ class TransformerConfig:
     # the axis is absent or trivial.
     attn_impl: str = "dense"
     sp_axis: str = "sp"
+    # With attn_impl="ulysses": run the per-head-group attention through
+    # the Pallas flash kernel instead of XLA dense (composes sequence
+    # parallelism with the fused kernel).
+    sp_use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -172,8 +177,22 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
     from ..parallel.ulysses import ulysses_attention
     from ..utils.compat import shard_map
 
-    impl = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
-    spec = P(None, cfg.sp_axis)
+    if cfg.attn_impl == "ring":
+        impl = ring_attention
+    else:
+        impl = functools.partial(ulysses_attention,
+                                 use_flash=cfg.sp_use_flash)
+    manual = {cfg.sp_axis}
+    dp = tp = None
+    if cfg.attn_impl != "ring" and cfg.sp_use_flash:
+        # The flash pallas_call is opaque to GSPMD: batch/head axes must
+        # be manualized too, or every dp/tp rank replicates the full
+        # attention (same reason as the attn_impl="flash" branch above).
+        dp = "dp" if "dp" in am.axis_names and am.shape["dp"] > 1 else None
+        tp = "tp" if "tp" in am.axis_names and am.shape["tp"] > 1 else None
+        manual |= {ax for ax in (dp, tp) if ax}
+    spec = P(dp, cfg.sp_axis, tp)       # (B, S, H, D)
+    mask_spec = P(dp, cfg.sp_axis)      # (B, S)
 
     if mask is None:
         fn = shard_map(
@@ -181,7 +200,7 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
             mesh=am,
             in_specs=(spec, spec, spec),
             out_specs=spec,
-            axis_names={cfg.sp_axis},
+            axis_names=manual,
         )
         return fn(q, k, v)
     # Padding mask rides sequence-sharded like K/V; each kernel handles
@@ -190,9 +209,9 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
         lambda q, k, v, m: impl(q, k, v, cfg.sp_axis, causal=cfg.causal,
                                 mask=m),
         mesh=am,
-        in_specs=(spec, spec, spec, spec),
+        in_specs=(spec, spec, spec, mask_spec),
         out_specs=spec,
-        axis_names={cfg.sp_axis},
+        axis_names=manual,
     )
     return fn(q, k, v, mask)
 

@@ -27,12 +27,18 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = True,
     mask: Optional[jax.Array] = None,
+    use_flash: bool = False,
 ) -> jax.Array:
     """q/k/v: local blocks (B, S/n, H, D); H must divide by the axis
     size. Returns (B, S/n, H, D). `mask` is this rank's key-validity
     block (B, S/n); the head-sharded dense attention needs the full
     sequence's mask, so it is all-gathered along the sp axis (tiny:
-    one bit per token)."""
+    one bit per token).
+
+    `use_flash` swaps the per-head-group dense attention for the Pallas
+    flash kernel (ops/flash_attention.py) — after the head exchange the
+    full sequence is local, exactly the kernel's layout, so the fused
+    path composes with sequence parallelism for free."""
     n = jax.lax.axis_size(axis_name)
     H = q.shape[2]
     if H % n != 0:
@@ -51,5 +57,10 @@ def ulysses_attention(
     full_mask = None
     if mask is not None:
         full_mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
-    out = dense_attention(qh, kh, vh, causal=causal, mask=full_mask)
+    if use_flash:
+        from ..ops.flash_attention import flash_attention
+
+        out = flash_attention(qh, kh, vh, full_mask, causal=causal)
+    else:
+        out = dense_attention(qh, kh, vh, causal=causal, mask=full_mask)
     return heads_to_seq(out)

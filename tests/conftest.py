@@ -38,3 +38,18 @@ def hvd_mesh():
     hvd.init()
     yield hvd
     hvd.shutdown()
+
+
+@pytest.fixture()
+def hvd_single():
+    """Fresh SIZE-1 mesh-mode world (single device), torn down after —
+    for tests of single-process semantics that must not inherit a
+    leaked full-mesh world from an earlier in-process test."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(devices=jax.devices()[:1])
+    yield hvd
+    hvd.shutdown()

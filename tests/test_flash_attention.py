@@ -151,3 +151,72 @@ def test_flash_under_gspmd_mesh_is_sharded_and_correct():
             variables, ids, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_with_flash_matches_dense(causal):
+    """sp_use_flash: Ulysses' per-head-group attention runs through the
+    Pallas kernel inside shard_map and still matches dense."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import create_mesh
+    from horovod_tpu.parallel.ulysses import ulysses_attention
+    from horovod_tpu.utils.compat import shard_map
+
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 64, 4, 32
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+               for _ in range(3))
+    mask = np.ones((B, S), np.float32)
+    mask[0, 40:] = 0.0
+    mesh = create_mesh({"dp": 2, "sp": 4})
+    want = dense_attention(q, k, v, causal=causal, mask=jnp.asarray(mask))
+
+    fn = shard_map(
+        lambda q, k, v, m: ulysses_attention(
+            q, k, v, axis_name="sp", causal=causal, mask=m,
+            use_flash=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp"),) * 4,
+        out_specs=P(None, "sp"),
+    )
+    got = jax.jit(fn)(q, k, v, jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_model_ulysses_flash_on_dp_sp_mesh():
+    """Model-level sp_use_flash on a dp x sp mesh: the dispatch
+    manualizes dp alongside sp (the opaque pallas_call would otherwise
+    replicate per dp rank) and matches the dense forward."""
+    import dataclasses
+
+    from horovod_tpu.models.transformer import (
+        BERT_CONFIGS,
+        TransformerEncoder,
+    )
+    from horovod_tpu.parallel.mesh import create_mesh
+
+    base = dataclasses.replace(
+        BERT_CONFIGS["bert-tiny"], max_len=64, n_layers=1, n_heads=4,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )  # 4 heads: Ulysses needs n_heads divisible by sp
+    ids = np.random.RandomState(0).randint(0, 1000, (4, 64), np.int32)
+    mask = np.ones((4, 64), np.float32)
+    mask[0, 40:] = 0.0
+
+    m_dense = TransformerEncoder(dataclasses.replace(base,
+                                                     attn_impl="dense"))
+    variables = m_dense.init(jax.random.PRNGKey(0), ids, mask=mask)
+    want = m_dense.apply(variables, ids, mask=mask)
+
+    mesh = create_mesh({"dp": 2, "sp": 4})
+    m_uf = TransformerEncoder(dataclasses.replace(
+        base, attn_impl="ulysses", sp_use_flash=True))
+    with jax.sharding.set_mesh(mesh):
+        got = jax.jit(lambda v, i, mk: m_uf.apply(v, i, mask=mk))(
+            variables, ids, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

@@ -212,3 +212,31 @@ def test_keras_state_and_lr_callbacks():
         return True
 
     assert _two(fn) == [True, True]
+
+
+def test_keras_load_model_rewraps_optimizer(tmp_path, hvd_single):
+    """hvd.keras.load_model reconstructs a model saved with the wrapped
+    DistributedOptimizer (ref: horovod/keras/__init__.py:127-158 —
+    custom-object loader for the dynamically created optimizer class)."""
+    import keras
+
+    import horovod_tpu.keras as hvd_keras
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(2),
+    ])
+    opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).rand(8, 2).astype(np.float32)
+    model.fit(x, y, epochs=1, verbose=0)
+
+    path = tmp_path / "m.keras"
+    model.save(path)
+    loaded = hvd_keras.load_model(path)
+    # Same predictions, and the optimizer is the wrapped kind again.
+    np.testing.assert_allclose(loaded.predict(x, verbose=0),
+                               model.predict(x, verbose=0),
+                               rtol=1e-5, atol=1e-6)
+    assert type(loaded.optimizer).__name__.startswith("Distributed")

@@ -256,40 +256,35 @@ def test_torch_state_and_sync_batch_norm():
     assert _two(fn) == [True, True]
 
 
-def test_async_handle_api_single_process():
+def test_async_handle_api_single_process(hvd_single):
     """The async handle API must work without hvdrun at size 1, like the
     reference's size-1 MPI world (ref: torch/mpi_ops.py handles) — the
     DistributedOptimizer's grad hooks use it unconditionally."""
-    import jax
     import torch
 
     import horovod_tpu.torch as hvd
 
-    hvd.init(devices=jax.devices()[:1])  # single-device mesh mode
-    try:
-        assert hvd.size() == 1
-        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
-        h = hvd.allreduce_async(t, name="a")
-        assert hvd.poll(h)
-        out = hvd.synchronize(h)
-        torch.testing.assert_close(out, t)
+    assert hvd.size() == 1
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    h = hvd.allreduce_async(t, name="a")
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    torch.testing.assert_close(out, t)
 
-        t2 = t.clone()
-        hvd.synchronize(hvd.allreduce_async_(t2, name="b"))
-        torch.testing.assert_close(t2, t)
+    t2 = t.clone()
+    hvd.synchronize(hvd.allreduce_async_(t2, name="b"))
+    torch.testing.assert_close(t2, t)
 
-        g = hvd.synchronize(hvd.allgather_async(t, name="c"))
-        torch.testing.assert_close(g, t)
-        b = hvd.synchronize(hvd.broadcast_async(t, root_rank=0, name="d"))
-        torch.testing.assert_close(b, t)
+    g = hvd.synchronize(hvd.allgather_async(t, name="c"))
+    torch.testing.assert_close(g, t)
+    b = hvd.synchronize(hvd.broadcast_async(t, root_rank=0, name="d"))
+    torch.testing.assert_close(b, t)
 
-        # A model step through DistributedOptimizer end to end.
-        m = torch.nn.Linear(3, 2)
-        opt = hvd.DistributedOptimizer(
-            torch.optim.SGD(m.parameters(), lr=0.1),
-            named_parameters=m.named_parameters())
-        loss = m(t).sum()
-        loss.backward()
-        opt.step()
-    finally:
-        hvd.shutdown()
+    # A model step through DistributedOptimizer end to end.
+    m = torch.nn.Linear(3, 2)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1),
+        named_parameters=m.named_parameters())
+    loss = m(t).sum()
+    loss.backward()
+    opt.step()
