@@ -39,6 +39,10 @@ class Backend(ControllerTransport):
     # Hierarchical allreduce toggle (ref: HOROVOD_HIERARCHICAL_ALLREDUCE,
     # operations.cc:416-513; autotune may flip it at sync boundaries).
     hierarchical: bool = False
+    # Hierarchical allgather toggle (ref: HOROVOD_HIERARCHICAL_ALLGATHER,
+    # MPIHierarchicalAllgather) — set by the engine from the collectively
+    # agreed topology validity.
+    hier_allgather: bool = False
 
     def set_topology(self, local_rank: int, local_size: int,
                      cross_rank: int, cross_size: int):

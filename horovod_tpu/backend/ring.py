@@ -85,6 +85,23 @@ def ring_allgather_eligible(backend, nbytes: int) -> bool:
     )
 
 
+def hierarchical_allgather_eligible(backend, nbytes: int,
+                                    ndim: int = 1) -> bool:
+    """(ref: MPIHierarchicalAllgather, mpi_operations.cc:190 — node
+    leaders gather locally, exchange across hosts, redistribute.) The
+    `hier_allgather` flag is set by the engine from the collectively
+    agreed topology validity + HOROVOD_HIERARCHICAL_ALLGATHER, so no
+    rank can pick a different algorithm. 0-d (scalar) gathers use
+    np.stack semantics the two-level path doesn't implement — ndim is
+    negotiated, so the gate is collectively consistent."""
+    return (
+        ndim > 0
+        and getattr(backend, "hier_allgather", False)
+        and ring_allgather_eligible(backend, nbytes)
+        and hierarchy_valid(backend)
+    )
+
+
 def hierarchical_capable(backend) -> bool:
     """Static capability (used for the engine's collective validity
     agreement at init): p2p transport + homogeneous topology. The
@@ -142,9 +159,94 @@ class RingCollectivesMixin(StarCollectivesMixin):
         # collectively consistent.
         row = int(np.prod(arr.shape[1:])) if arr.ndim else 1
         total = sum(first_dims) * row * arr.dtype.itemsize
+        if hierarchical_allgather_eligible(self, total, arr.ndim):
+            return self._hierarchical_allgatherv(arr, first_dims)
         if ring_allgather_eligible(self, total):
             return self._ring_allgatherv(arr, first_dims)
         return super().allgatherv(arr, first_dims)
+
+    def _hierarchical_allgatherv(self, arr: np.ndarray,
+                                 first_dims: List[int]) -> np.ndarray:
+        """Two-level allgather (ref: MPIHierarchicalAllgather,
+        mpi_operations.cc:190 — leader gather into POSIX shm + cross
+        allgather + redistribute): members send to their host leader,
+        leaders ring-allgather whole host blocks across hosts (one
+        crossing per byte on the slow links instead of local_size of
+        them), then fan the full result back out locally."""
+        L = self.local_size
+        base = self.cross_rank * L
+        leader = base
+
+        if self.rank != leader:
+            self.send_to(leader, pack_array(np.ascontiguousarray(arr)))
+            blob = self.recv_from(leader)
+            # 1-byte status prefix: the leader reports its own failure
+            # instead of leaving members blocked in recv forever.
+            if blob[:1] == b"E":
+                raise RuntimeError(
+                    "hierarchical allgather failed on host leader: "
+                    + blob[1:].decode(errors="replace")
+                )
+            return unpack_array(blob[1:]).copy()
+
+        try:
+            # Leader: gather this host's blocks in local-rank order
+            # (global rank order, since packing is contiguous),
+            # validating each against the negotiated dims like the flat
+            # ring does per block.
+            local_blocks = [np.ascontiguousarray(arr)]
+            for i in range(1, L):
+                blk = unpack_array(self.recv_from(base + i))
+                if blk.shape[0] != first_dims[base + i]:
+                    raise ValueError(
+                        f"allgather block from rank {base + i} has first "
+                        f"dim {blk.shape[0]}, negotiated "
+                        f"{first_dims[base + i]}"
+                    )
+                local_blocks.append(blk)
+            if arr.shape[0] != first_dims[self.rank]:
+                raise ValueError(
+                    f"allgather local block has first dim {arr.shape[0]},"
+                    f" negotiated {first_dims[self.rank]}"
+                )
+            host_block = np.concatenate(local_blocks, axis=0)
+
+            # Cross phase: ring allgather of host blocks among leaders.
+            C = self.cross_size
+            leaders = [h * L for h in range(C)]
+            pos = self.cross_rank
+            right, left = leaders[(pos + 1) % C], leaders[(pos - 1) % C]
+            host_blocks: List[Optional[np.ndarray]] = [None] * C
+            host_blocks[pos] = host_block
+            payload = pack_array(host_block)
+            for s in range(C - 1):
+                payload = self._sendrecv(right, payload, left)
+                src = (pos - s - 1) % C
+                host_blocks[src] = unpack_array(payload)
+                want = sum(first_dims[src * L:(src + 1) * L])
+                if host_blocks[src].shape[0] != want:
+                    raise ValueError(
+                        f"allgather host block from host {src} has first "
+                        f"dim {host_blocks[src].shape[0]}, negotiated "
+                        f"{want}"
+                    )
+            out = np.concatenate(host_blocks, axis=0)
+        except Exception as exc:
+            # Unblock local members with an error frame before
+            # propagating — they are parked in recv_from(leader).
+            msg = b"E" + str(exc).encode()
+            for i in range(1, L):
+                try:
+                    self.send_to(base + i, msg)
+                except Exception:  # pragma: no cover - peer gone
+                    pass
+            raise
+
+        # Local fan-out of the assembled result.
+        blob = b"O" + pack_array(out)
+        for i in range(1, L):
+            self.send_to(base + i, blob)
+        return out
 
     def _ring_allgatherv(self, arr: np.ndarray,
                          first_dims: List[int]) -> np.ndarray:

@@ -190,6 +190,10 @@ class Engine:
             self.backend.hierarchical = self._hier_valid and env_cfg.get_bool(
                 env_cfg.HIERARCHICAL_ALLREDUCE, False
             )
+            self.backend.hier_allgather = (
+                self._hier_valid
+                and env_cfg.get_bool(env_cfg.HIERARCHICAL_ALLGATHER, False)
+            )
             # Arms rebuild happens before the first cycle, hence before
             # any sample window can open.
             self.param_manager.set_tune_hierarchical(self._hier_valid)
@@ -282,7 +286,8 @@ class Engine:
                     nbytes = (sum(resp.tensor_sizes) * row
                               * e.tensor.dtype.itemsize)
                     op = self.op_manager.select(ResponseType.ALLGATHER,
-                                                nbytes=nbytes)
+                                                nbytes=nbytes,
+                                                ndim=e.tensor.ndim)
                     with self.timeline.activity(e.tensor_name, op.name):
                         out = op.execute(e.tensor, list(resp.tensor_sizes))
                     self._finish(e, Status.OK(), out)

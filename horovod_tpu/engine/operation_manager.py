@@ -100,8 +100,14 @@ def build_default(backend) -> OperationManager:
     ))
     if backend.size > 1 and hasattr(backend, "_ring_allgatherv"):
         mgr.register(ResponseType.ALLGATHER, OpEntry(
+            "HIERARCHICAL_ALLGATHER",
+            lambda nbytes=0, ndim=1: ring_mod.hierarchical_allgather_eligible(
+                backend, nbytes, ndim),
+            backend._hierarchical_allgatherv,
+        ))
+        mgr.register(ResponseType.ALLGATHER, OpEntry(
             "RING_ALLGATHER",
-            lambda nbytes=0: ring_mod.ring_allgather_eligible(
+            lambda nbytes=0, ndim=1: ring_mod.ring_allgather_eligible(
                 backend, nbytes),
             backend._ring_allgatherv,
         ))

@@ -256,3 +256,127 @@ def test_autotune_serialize_roundtrip_categorical():
     assert other.fusion_threshold == 123456
     assert other.cycle_time_ms == 7.5
     assert other.done is True
+
+
+@pytest.mark.parametrize("dims", [[2, 0, 3, 1], [5, 5, 5, 5]])
+def test_hierarchical_allgatherv(dims, monkeypatch):
+    """Two-level allgather matches the flat result, incl. a zero-row
+    rank (ref: MPIHierarchicalAllgather, mpi_operations.cc:190)."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+
+    def fn(b, r):
+        b.hier_allgather = True
+        arr = np.full((dims[r], 3), float(r), np.float32)
+        return b.allgatherv(arr, list(dims))
+
+    out = _run_backend_ranks(4, _topo_2x2, fn)
+    expect = np.concatenate(
+        [np.full((dims[r], 3), float(r), np.float32) for r in range(4)]
+    )
+    for o in out:
+        np.testing.assert_allclose(o, expect)
+
+
+def test_engine_hierarchical_allgather_end_to_end(monkeypatch, tmp_path):
+    """HOROVOD_HIERARCHICAL_ALLGATHER=1 on a 2x2 world: the engine
+    selects the two-level op (timeline shows HIERARCHICAL_ALLGATHER)."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+
+    path = tmp_path / "tl.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "64")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+
+    group = ThreadedGroup(4)
+    engines = [
+        Engine(rank=r, size=4, backend=group.backend(r),
+               local_rank=r % 2, local_size=2,
+               cross_rank=r // 2, cross_size=2)
+        for r in range(4)
+    ]
+    for e in engines:
+        e.cycle_time_s = 0.001
+        e.start()
+    results = [None] * 4
+    errors = [None] * 4
+
+    def worker(r):
+        try:
+            arr = np.full((r + 1, 50), float(r), np.float32)
+            results[r] = engines[r].synchronize(
+                engines[r].enqueue_allgather(arr, name="g"), timeout=30)
+        except BaseException as ex:  # noqa: BLE001
+            errors[r] = ex
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    stop = [threading.Thread(target=e.shutdown) for e in engines]
+    for t in stop:
+        t.start()
+    for t in stop:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    expect = np.concatenate([
+        np.full((r + 1, 50), float(r), np.float32) for r in range(4)
+    ])
+    for o in results:
+        np.testing.assert_allclose(o, expect)
+    events = json.loads(path.read_text())
+    assert "HIERARCHICAL_ALLGATHER" in {e.get("name") for e in events}
+
+
+def test_hierarchical_allgather_scalar_falls_back(monkeypatch):
+    """0-d (scalar) allgathers use stack semantics the two-level path
+    doesn't implement: the engine must select ring/star instead."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+
+    group = ThreadedGroup(4)
+    engines = [
+        Engine(rank=r, size=4, backend=group.backend(r),
+               local_rank=r % 2, local_size=2,
+               cross_rank=r // 2, cross_size=2)
+        for r in range(4)
+    ]
+    for e in engines:
+        e.cycle_time_s = 0.001
+        e.start()
+    results = [None] * 4
+    errors = [None] * 4
+
+    def worker(r):
+        try:
+            results[r] = engines[r].synchronize(
+                engines[r].enqueue_allgather(
+                    np.float32(r), name="s"), timeout=30)
+        except BaseException as ex:  # noqa: BLE001
+            errors[r] = ex
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    stop = [threading.Thread(target=e.shutdown) for e in engines]
+    for t in stop:
+        t.start()
+    for t in stop:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    for o in results:
+        np.testing.assert_allclose(np.ravel(o),
+                                   np.arange(4, dtype=np.float32))
