@@ -1,0 +1,28 @@
+"""Local (size-1) result store shared by the framework adapters'
+async-handle APIs (torch, tensorflow). Engine handles are non-negative;
+local handles count down from -1 so the two spaces never collide."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class LocalResultStore:
+    def __init__(self):
+        self._next = 0
+        self._results = {}
+
+    def put(self, result) -> int:
+        # Snapshot ndarrays: callers may pass views aliasing framework
+        # tensor storage, and the engine path returns fresh buffers, so
+        # this path must too.
+        if isinstance(result, np.ndarray):
+            result = np.array(result)
+        self._next -= 1
+        self._results[self._next] = result
+        return self._next
+
+    def pop(self, handle: int):
+        return self._results.pop(handle)
+
+    def __contains__(self, handle: int) -> bool:
+        return handle in self._results

@@ -123,6 +123,11 @@ class Engine:
         self._init_error: Optional[BaseException] = None
         self._op_counter: Dict[str, int] = {}
         self._counter_lock = threading.Lock()
+        # Cycles that carried at least one negotiated response — the
+        # observable proxy for "how many engine round-trips did a batch
+        # of requests take" (a fused batch costs ~1; a serialized stream
+        # of N requests costs N). Bindings' fusion tests assert on it.
+        self.response_cycles = 0
         # Persistent fusion buffer, one per dtype, grown to the largest
         # fused payload seen (ref: FusionBufferManager's per-device
         # persistent buffer, fusion_buffer_manager.h:30-56). Only the
@@ -222,6 +227,8 @@ class Engine:
         resp_list, should_shutdown = self.controller.compute_response_list(
             messages, shutdown=want_shutdown
         )
+        if resp_list.responses:
+            self.response_cycles += 1
         # Autotune (ref: operations.cc:592-600): windows are counted in
         # response cycles, identical on all ranks, so the parameter-sync
         # broadcast below lines up as a collective. It runs BEFORE this

@@ -69,6 +69,20 @@ def _engine_allreduce(arr, nm, rop, prescale, postscale):
         arr, name=nm, op=rop, prescale=prescale, postscale=postscale))
 
 
+def _engine_grouped_allreduce(arrs, names, rop, prescale, postscale):
+    """Enqueue EVERY tensor before awaiting ANY result, so the whole
+    group negotiates in the same engine cycle(s) and tensor fusion can
+    pack it into one wire payload (ref: AsyncOpKernel concurrency,
+    tensorflow/mpi_ops.cc:371-416; fusion, controller.cc:686-809)."""
+    eng = _engine()
+    handles = [
+        eng.enqueue_allreduce(a, name=n, op=rop, prescale=prescale,
+                              postscale=postscale)
+        for a, n in zip(arrs, names)
+    ]
+    return [eng.synchronize(h) for h in handles]
+
+
 def _engine_allgather(arr, nm):
     eng = _engine()
     return eng.synchronize(eng.enqueue_allgather(arr, name=nm))
@@ -148,9 +162,9 @@ def allreduce(
 
     if _basics.size() == 1:
         out = compressed
-        if rop == ReduceOp.SUM:
-            out = out * 1  # sum over one rank is identity
-        out = out * prescale_factor * postscale_factor
+        f = prescale_factor * postscale_factor
+        if f != 1.0:
+            out = tf.cast(tf.cast(out, tf.float64) * f, out.dtype)
         return comp.decompress(out, ctx)
 
     nm = name or f"HorovodAllreduce_{_auto_name(tensor)}"
@@ -187,16 +201,95 @@ def _auto_name(tensor) -> str:
 def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
                       compression=None):
-    """(ref: tensorflow/mpi_ops.py grouped_allreduce) — the engine fuses
-    same-cycle requests, so issuing all then gathering preserves the
-    fused wire behavior."""
+    """All-reduce a list of tensors as ONE group: every tensor is
+    enqueued before any result is awaited, so all requests land in the
+    same negotiation cycle and the engine's fusion buffer packs them
+    into one wire payload (ref: tensorflow/mpi_ops.py grouped_allreduce,
+    controller.cc:686-809). Under `tf.function` the group traces as a
+    SINGLE py_function — per-tensor py_functions would be chained by
+    TF2's auto-control-dependencies (stateful ops run in program order),
+    re-serializing the group."""
+    tf = _tf()
     rop = _resolve_op(op, average)
     base = name or "HorovodGrouped"
-    return [
-        allreduce(t, None, f"{base}.{i}", rop, prescale_factor,
-                  postscale_factor, compression)
-        for i, t in enumerate(tensors)
-    ]
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    if any(isinstance(t, tf.IndexedSlices) for t in tensors):
+        # Sparse entries ride the allgather path individually (grouped
+        # entries must be dense, like the reference); the dense rest
+        # still goes through one group.
+        out = [None] * len(tensors)
+        dense_idx, dense = [], []
+        for i, t in enumerate(tensors):
+            if isinstance(t, tf.IndexedSlices):
+                out[i] = allreduce(t, None, f"{base}.{i}", rop,
+                                   prescale_factor, postscale_factor,
+                                   compression)
+            else:
+                dense_idx.append(i)
+                dense.append(t)
+        if dense:
+            for i, r in zip(dense_idx, grouped_allreduce(
+                    dense, None, f"{base}.dense", rop, prescale_factor,
+                    postscale_factor, compression)):
+                out[i] = r
+        return out
+
+    comp = compression or Compression.none
+    dense = [tf.convert_to_tensor(t) for t in tensors]
+    pairs = [comp.compress(t) for t in dense]
+    compressed = [p[0] for p in pairs]
+    ctxs = [p[1] for p in pairs]
+
+    if _basics.size() == 1:
+        f = prescale_factor * postscale_factor
+        if f != 1.0:
+            # Scale through float64 and cast back, like the engine's
+            # _scale_np — int * python float must not upcast/raise.
+            compressed = [tf.cast(tf.cast(t, tf.float64) * f, t.dtype)
+                          for t in compressed]
+        return [comp.decompress(o, c) for o, c in zip(compressed, ctxs)]
+
+    names = [f"{base}.{i}" for i in range(len(compressed))]
+
+    def run_group(arrs):
+        return _engine_grouped_allreduce(
+            arrs, names, rop, prescale_factor, postscale_factor)
+
+    @tf.custom_gradient
+    def op_with_grad(*xs):
+        if tf.executing_eagerly():
+            outs = run_group([x.numpy() for x in xs])
+            ys = [tf.convert_to_tensor(o, dtype=x.dtype)
+                  for o, x in zip(outs, xs)]
+        else:
+            dtypes = [x.dtype for x in xs]
+
+            def py_run(*ts):
+                outs = run_group([t.numpy() for t in ts])
+                return [tf.convert_to_tensor(o, dtype=d)
+                        for o, d in zip(outs, dtypes)]
+
+            ys = tf.py_function(py_run, inp=list(xs), Tout=dtypes,
+                                name="HorovodGroupedAllreduce")
+            if len(xs) == 1:
+                ys = [ys] if not isinstance(ys, (list, tuple)) else list(ys)
+            for y, x in zip(ys, xs):
+                y.set_shape(x.shape)
+
+        def grad(*dys):
+            # Gradient of a grouped allreduce is a grouped allreduce of
+            # the cotangents with the same op (ref: mpi_ops.py:139-152).
+            return grouped_allreduce(list(dys), op=rop, name=f"{base}.grad",
+                                     compression=compression)
+
+        return ys, grad
+
+    outs = op_with_grad(*compressed)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return [comp.decompress(o, c) for o, c in zip(outs, ctxs)]
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -313,6 +406,97 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     return op_with_grad(tensor, splits_t)
 
 
+# ---------------------------------------------------------------------------
+# Async handle API (eager): the same enqueue/synchronize shape as the
+# torch adapter (torch/__init__.py) and the reference's *_async ops.
+# Under tf.function use grouped_allreduce instead — handles are python
+# ints and cannot cross a graph trace.
+
+from ..common.async_handles import LocalResultStore
+
+_handles = {}
+_local_results = LocalResultStore()
+
+
+def _scale_preserving_dtype(arr: np.ndarray, factor: float) -> np.ndarray:
+    """Scale without changing dtype (numpy int * python float would
+    upcast to float64) — same contract as the engine's _scale_np."""
+    if factor == 1.0:
+        return arr
+    return (arr.astype(np.float64) * factor).astype(arr.dtype)
+
+
+def _check_eager(api: str):
+    if not _tf().executing_eagerly():
+        raise RuntimeError(
+            f"{api} is eager-only (handles cannot cross a tf.function "
+            "trace); use grouped_allreduce inside tf.function"
+        )
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """Enqueue an allreduce and return a handle immediately; redeem with
+    synchronize(). (ref: tensorflow/mpi_ops.py _allreduce async kernel)"""
+    _check_eager("allreduce_async")
+    tf = _tf()
+    rop = _resolve_op(op, average)
+    t = tf.convert_to_tensor(tensor)
+    arr = t.numpy()
+    if _basics.size() == 1:
+        h = _local_results.put(
+            _scale_preserving_dtype(arr, prescale_factor * postscale_factor))
+    else:
+        h = _engine().enqueue_allreduce(
+            arr, name=name, op=rop,
+            prescale=prescale_factor, postscale=postscale_factor)
+    _handles[h] = t.dtype
+    return h
+
+
+def allgather_async(tensor, name=None) -> int:
+    _check_eager("allgather_async")
+    tf = _tf()
+    t = tf.convert_to_tensor(tensor)
+    if _basics.size() == 1:
+        h = _local_results.put(t.numpy())
+    else:
+        h = _engine().enqueue_allgather(t.numpy(), name=name)
+    _handles[h] = t.dtype
+    return h
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    _check_eager("broadcast_async")
+    tf = _tf()
+    t = tf.convert_to_tensor(tensor)
+    if _basics.size() == 1:
+        h = _local_results.put(t.numpy())
+    else:
+        h = _engine().enqueue_broadcast(t.numpy(), root_rank, name=name)
+    _handles[h] = t.dtype
+    return h
+
+
+def poll(handle: int) -> bool:
+    if handle in _local_results:
+        return True
+    if handle < 0:
+        return False
+    return _engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the handle's collective completes; returns the
+    result as a tf.Tensor (ref: mpi_ops.py synchronize)."""
+    dtype = _handles.pop(handle, None)
+    if handle in _local_results:
+        out = _local_results.pop(handle)
+    else:
+        out = _engine().synchronize(handle)
+    return _tf().convert_to_tensor(np.asarray(out), dtype=dtype)
+
+
 def join() -> int:
     from ..ops import join as _join
 
@@ -346,23 +530,30 @@ def _make_allreduce_grads_fn(name_scope: str, device_dense, device_sparse,
         prescale, postscale, eff_op = 1.0, 1.0, op
 
     def allreduce_grads(grads):
-        out = []
-        for i, grad in enumerate(grads):
-            if grad is None:
-                out.append(None)
-                continue
-            if sparse_as_dense and isinstance(grad, tf.IndexedSlices):
-                grad = tf.convert_to_tensor(grad)
-            out.append(
-                allreduce(
-                    grad,
-                    op=eff_op,
-                    name=f"{name_scope}.grad.{i}",
-                    prescale_factor=prescale,
-                    postscale_factor=postscale,
-                    compression=compression,
-                )
+        # All non-None gradients go through ONE grouped allreduce so the
+        # whole list negotiates in the same engine cycle and fusion
+        # fires (N serial allreduces would pay ≥1 cycle each);
+        # grouped_allreduce itself routes any remaining IndexedSlices
+        # down the allgather path.
+        out = [None] * len(grads)
+        idx = [i for i, g in enumerate(grads) if g is not None]
+        batch = []
+        for i in idx:
+            g = grads[i]
+            if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            batch.append(g)
+        if batch:
+            reduced = grouped_allreduce(
+                batch,
+                op=eff_op,
+                name=f"{name_scope}.grads",
+                prescale_factor=prescale,
+                postscale_factor=postscale,
+                compression=compression,
             )
+            for i, r in zip(idx, reduced):
+                out[i] = r
         return out
 
     return allreduce_grads

@@ -82,18 +82,13 @@ _handles = {}
 # works without horovodrun at size 1, so the async API must too — there
 # is no engine to enqueue into, the "collective" result is computed on
 # the spot (ref: a size-1 MPI world completes ops locally).
-_local_results: dict = {}
-_local_next = [0]
+from ..common.async_handles import LocalResultStore
+
+_local_results = LocalResultStore()
 
 
 def _local_handle(result) -> int:
-    # Snapshot: numpy views alias the torch tensor's storage; the engine
-    # path returns fresh buffers, so this path must too.
-    if isinstance(result, np.ndarray):
-        result = np.array(result)
-    _local_next[0] -= 1
-    _local_results[_local_next[0]] = result
-    return _local_next[0]
+    return _local_results.put(result)
 
 
 def _single() -> bool:
