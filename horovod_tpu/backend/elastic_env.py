@@ -146,7 +146,47 @@ class WorkerNotificationManager:
                 else hostname
             )
             rdv.put(NOTIFY_SCOPE, spawn_identity(), f"{reach}:{port}".encode())
+            # The driver's HTTP ping is best-effort and one-shot: it is
+            # silently skipped for a worker that has not registered yet
+            # (e.g. still importing frameworks when the topology
+            # changes). The epoch watcher guarantees delivery: any
+            # epoch newer than the one this worker is meshed into
+            # synthesizes the same notification at the next poll.
+            tw = threading.Thread(target=self._epoch_watch, args=(rdv,),
+                                  name="hvd-epoch-watch", daemon=True)
+            tw.start()
             self._initialized = True
+
+    def _epoch_watch(self, rdv: RendezvousClient):
+        interval = env_cfg.get_float("HOROVOD_ELASTIC_EPOCH_POLL", 0.5)
+        notified_epoch: Optional[int] = None
+        while True:
+            time.sleep(interval)
+            try:
+                raw = rdv.get("meta", "epoch")
+            except OSError:
+                continue  # driver tearing down / transient network
+            if raw is None:
+                continue
+            try:
+                epoch = int(raw.decode())
+            except ValueError:
+                continue
+            current = _current_epoch()
+            if current is None:
+                current = 0
+            if epoch > current and epoch != notified_epoch:
+                # ADDED forces a state sync, the safe default when the
+                # watcher can't know what kind of change occurred. Only
+                # latch once a listener actually received it — firing
+                # into a not-yet-registered listener list (worker still
+                # initializing) must retry on the next poll or the
+                # guarantee this thread exists for is lost. Delivery
+                # count comes from the fan-out itself (single lock
+                # acquisition) so an unregister between a snapshot and
+                # the delivery can't fake success.
+                if self._on_hosts_updated(f"{time.time()},2"):
+                    notified_epoch = epoch
 
     def register_listener(self, state):
         with self._lock:
@@ -157,13 +197,16 @@ class WorkerNotificationManager:
             if state in self._listeners:
                 self._listeners.remove(state)
 
-    def _on_hosts_updated(self, body: str):
+    def _on_hosts_updated(self, body: str) -> int:
+        """Fan a notification out to the registered listeners; returns
+        how many received it (0 = nobody was listening yet)."""
         parts = body.split(",")
         ts = float(parts[0]) if parts and parts[0] else time.time()
         res = int(parts[1]) if len(parts) > 1 else 0
         with self._lock:
             for l in self._listeners:
                 l.on_hosts_updated(ts, res)
+            return len(self._listeners)
 
 
 notification_manager = WorkerNotificationManager()

@@ -67,10 +67,29 @@ _WORKER = textwrap.dedent(
 
 
 def _run_elastic(tmp_path, discovery_script, min_np, max_np, worker_env,
-                 timeout=180):
+                 timeout=180, on_worker_meshed=None):
+    """on_worker_meshed: optional callback fired (from a watcher thread)
+    once the first worker has registered its notification endpoint —
+    i.e. it is initialized and entering the training loop (a size-1
+    worker builds no TCP mesh, so the notify registration is the
+    reliable liveness signal). Event-driven replacement for fixed
+    sleeps when a test needs to change topology mid-run."""
     os.environ["HVDRUN_FORCE_LOCAL"] = "1"
     server = RendezvousServer()
     port = server.start()
+
+    if on_worker_meshed is not None:
+        import threading
+
+        def _watch():
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if server.handle_get("workers_notify/hostA:0") is not None:
+                    on_worker_meshed()
+                    return
+                time.sleep(0.05)
+
+        threading.Thread(target=_watch, daemon=True).start()
     driver = ElasticDriver(
         server, HostDiscoveryScript(discovery_script, 1), min_np, max_np,
         poll_interval=0.25,
@@ -115,12 +134,13 @@ def test_elastic_host_added_mid_training(tmp_path):
     )
     script.chmod(0o755)
 
-    import threading
-
-    threading.Timer(4.0, lambda: phase2.touch()).start()
     code, results = _run_elastic(
         tmp_path, str(script), min_np=1, max_np=2,
-        worker_env={"TEST_TOTAL_BATCHES": "60"},
+        worker_env={"TEST_TOTAL_BATCHES": "120"},
+        # Event-driven: hostB appears only once hostA's worker is up and
+        # training, so batches remain for the post-reset size-2 phase no
+        # matter how slow worker startup was.
+        on_worker_meshed=phase2.touch,
     )
     assert code == 0, code
     assert "hostA:0" in results
