@@ -35,11 +35,8 @@ class RendezvousClient:
             return {}
         from ..runner.rendezvous_server import sign_request
 
-        return {
-            "X-Horovod-Digest": sign_request(
-                self.secret_key, method, path, body
-            )
-        }
+        digest, ts = sign_request(self.secret_key, method, path, body)
+        return {"X-Horovod-Digest": digest, "X-Horovod-Timestamp": ts}
 
     def put(self, scope: str, key: str, value: bytes):
         c = self._conn()
@@ -65,8 +62,9 @@ class RendezvousClient:
                 return body
             if r.status == 403:
                 raise PermissionError(
-                    "rendezvous rejected request: bad or missing "
-                    "HOROVOD_SECRET_KEY digest"
+                    "rendezvous rejected request: "
+                    + (r.getheader("X-Horovod-Reject-Reason")
+                       or "bad or missing HOROVOD_SECRET_KEY digest")
                 )
             return None
         finally:

@@ -17,28 +17,65 @@ reference's own gap of an unauthenticated rendezvous.
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from .util import secret as secret_util
 
+# Requests older than this (or from further in the future) are rejected;
+# within the window a digest may be accepted only once, so a captured
+# PUT/DELETE cannot be replayed (e.g. re-posting a stale rank assignment
+# during an elastic re-rendezvous).
+REPLAY_WINDOW_S = 300.0
 
-def sign_request(key: bytes, method: str, path: str, body: bytes) -> str:
-    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
-    return secret_util.compute_digest(key, msg).hex()
+
+def sign_request(key: bytes, method: str, path: str, body: bytes,
+                 ts: Optional[str] = None) -> Tuple[str, str]:
+    """Returns (digest_hex, timestamp) for the request headers."""
+    if ts is None:
+        ts = repr(time.time())
+    msg = b"\n".join((method.encode(), path.encode(), ts.encode(), body))
+    return secret_util.compute_digest(key, msg).hex(), ts
+
+
+def _replay_window() -> float:
+    """HOROVOD_REPLAY_WINDOW (seconds; 0 disables the timestamp check
+    for clusters with known clock skew — replay dedup still applies
+    within a run)."""
+    try:
+        return float(os.environ.get("HOROVOD_REPLAY_WINDOW",
+                                    REPLAY_WINDOW_S))
+    except ValueError:
+        return REPLAY_WINDOW_S
 
 
 def _check_request(key: bytes, method: str, path: str, body: bytes,
-                   digest_hex: Optional[str]) -> bool:
-    if not digest_hex:
-        return False
+                   digest_hex: Optional[str],
+                   ts: Optional[str]) -> Tuple[bool, str]:
+    """(ok, reject_reason) — the reason reaches the client so an
+    operator can tell clock skew apart from a wrong secret key."""
+    if not digest_hex or not ts:
+        return False, "missing digest or timestamp header"
     try:
         digest = bytes.fromhex(digest_hex)
+        tval = float(ts)
     except ValueError:
-        return False
-    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
-    return secret_util.check_digest(key, msg, digest)
+        return False, "malformed digest or timestamp"
+    window = _replay_window()
+    if window > 0 and abs(time.time() - tval) > window:
+        # ASCII only: this string travels in an HTTP header (latin-1).
+        return False, (
+            f"timestamp {abs(time.time() - tval):.0f}s outside the "
+            f"{window:.0f}s replay window - check host clocks (NTP) or "
+            "raise HOROVOD_REPLAY_WINDOW"
+        )
+    msg = b"\n".join((method.encode(), path.encode(), ts.encode(), body))
+    if not secret_util.check_digest(key, msg, digest):
+        return False, "bad digest (HOROVOD_SECRET_KEY mismatch?)"
+    return True, ""
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -54,12 +91,20 @@ class _KVHandler(BaseHTTPRequestHandler):
         server: RendezvousServer = self.server.rendezvous  # type: ignore
         if server.secret_key is None:
             return True
-        ok = _check_request(
+        digest_hex = self.headers.get("X-Horovod-Digest")
+        ok, reason = _check_request(
             server.secret_key, self.command, self.path, body,
-            self.headers.get("X-Horovod-Digest"),
+            digest_hex, self.headers.get("X-Horovod-Timestamp"),
         )
+        # A valid digest is single-use within the replay window: GETs
+        # are read-only and may retry, but a mutating request replayed
+        # verbatim is rejected.
+        if ok and self.command in ("PUT", "DELETE") \
+                and not server._accept_once(digest_hex):
+            ok, reason = False, "replayed request (digest already seen)"
         if not ok:
             self.send_response(403)
+            self.send_header("X-Horovod-Reject-Reason", reason)
             self.send_header("Content-Length", "0")
             self.end_headers()
         return ok
@@ -106,6 +151,7 @@ class RendezvousServer:
                  secret_key: Optional[bytes] = None):
         self.secret_key = secret_key
         self._store: Dict[str, bytes] = {}
+        self._seen_digests: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -127,6 +173,27 @@ class RendezvousServer:
     def port(self) -> int:
         assert self._httpd is not None
         return self._httpd.server_address[1]
+
+    def _accept_once(self, digest_hex: str) -> bool:
+        """True the first time a digest is seen inside the window."""
+        now = time.time()
+        with self._lock:
+            if len(self._seen_digests) > 4096:
+                # Never evict inside the ACTIVE window: with a raised
+                # (or disabled, =0 -> infinite) HOROVOD_REPLAY_WINDOW,
+                # pruning at the default 300s would re-open the replay
+                # hole the dedup exists to close.
+                window = _replay_window()
+                if window <= 0:
+                    window = float("inf")
+                cutoff = now - max(window, REPLAY_WINDOW_S)
+                for d in [d for d, t in self._seen_digests.items()
+                          if t < cutoff]:
+                    del self._seen_digests[d]
+            if digest_hex in self._seen_digests:
+                return False
+            self._seen_digests[digest_hex] = now
+            return True
 
     def handle_get(self, key: str) -> Optional[bytes]:
         if self.get_hook is not None:

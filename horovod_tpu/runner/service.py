@@ -117,7 +117,15 @@ class AuthError(RuntimeError):
 # ---------------------------------------------------------------------------
 class Wire:
     """digest(32) + length(4) + pickled body; digest checked before any
-    unpickle (ref: network.py:50-84)."""
+    unpickle (ref: network.py:50-84).
+
+    The length header is attacker-controlled and read before the digest
+    can be verified, so it is capped: control-plane messages are small
+    (requests, env dicts, short output chunks), and without a cap an
+    unauthenticated peer could force multi-GiB allocations on services
+    that bind 0.0.0.0."""
+
+    MAX_MESSAGE_BYTES = 16 * 1024 * 1024
 
     def __init__(self, key: bytes):
         if not key:
@@ -126,6 +134,15 @@ class Wire:
 
     def write(self, obj: Any, wfile):
         body = pickle.dumps(obj)
+        if len(body) > self.MAX_MESSAGE_BYTES:
+            # Fail at the sender with an actionable message — the
+            # receiver would otherwise reject the frame as a misleading
+            # AuthError on the remote side.
+            raise ValueError(
+                f"message of {len(body)} bytes exceeds the "
+                f"{self.MAX_MESSAGE_BYTES}-byte wire cap; control-plane "
+                "messages must stay small (ship bulk data out of band)"
+            )
         wfile.write(secret_util.compute_digest(self._key, body))
         wfile.write(_LEN.pack(len(body)))
         wfile.write(body)
@@ -134,6 +151,9 @@ class Wire:
     def read(self, rfile) -> Any:
         digest = self._read_exact(rfile, secret_util.DIGEST_LENGTH)
         (n,) = _LEN.unpack(self._read_exact(rfile, 4))
+        if n > self.MAX_MESSAGE_BYTES:
+            raise AuthError(f"frame of {n} bytes exceeds the "
+                            f"{self.MAX_MESSAGE_BYTES}-byte message cap")
         body = self._read_exact(rfile, n)
         if not secret_util.check_digest(self._key, body, digest):
             raise AuthError("digest did not match the message")

@@ -144,6 +144,60 @@ def test_rendezvous_hmac_enforced():
         srv.stop()
 
 
+def test_wire_oversized_frame_rejected_before_read():
+    """The attacker-controlled length header is capped before the body
+    is read, so an unauthenticated peer can't force GiB allocations."""
+    import struct
+
+    key = secret_util.make_secret_key()
+    wire = Wire(key)
+    frame = b"\x00" * secret_util.DIGEST_LENGTH + struct.pack(
+        "<I", Wire.MAX_MESSAGE_BYTES + 1)
+    with pytest.raises(AuthError, match="cap"):
+        wire.read(io.BytesIO(frame))
+
+
+def test_rendezvous_replay_and_stale_rejected():
+    """A captured signed PUT must not be replayable, and timestamps
+    outside the window are rejected outright."""
+    import http.client
+    import time
+
+    from horovod_tpu.runner.rendezvous_server import sign_request
+
+    key = secret_util.make_secret_key()
+    srv = RendezvousServer(secret_key=key)
+    port = srv.start()
+    try:
+        digest, ts = sign_request(key, "PUT", "/s/k", b"v1")
+        headers = {"X-Horovod-Digest": digest, "X-Horovod-Timestamp": ts}
+        def do(method, path, body, hdrs):
+            c = http.client.HTTPConnection("127.0.0.1", port)
+            try:
+                c.request(method, path, body=body, headers=hdrs)
+                r = c.getresponse()
+                r.read()
+                return r.status
+            finally:
+                c.close()
+
+        assert do("PUT", "/s/k", b"v1", headers) == 200
+        # Verbatim replay of the same signed request: rejected.
+        assert do("PUT", "/s/k", b"v1", headers) == 403
+        # Stale timestamp (signed long ago): rejected without a replay.
+        digest, ts = sign_request(key, "PUT", "/s/k2", b"v",
+                                  ts=repr(time.time() - 3600))
+        assert do("PUT", "/s/k2", b"v",
+                  {"X-Horovod-Digest": digest,
+                   "X-Horovod-Timestamp": ts}) == 403
+        # The legitimate value survived; the stale write never landed.
+        signed = RendezvousClient("127.0.0.1", port, secret_key=key)
+        assert signed.get("s", "k") == b"v1"
+        assert signed.get("s", "k2") is None
+    finally:
+        srv.stop()
+
+
 def test_rendezvous_unauthenticated_server_still_open():
     srv = RendezvousServer()
     port = srv.start()
