@@ -122,34 +122,73 @@ class JaxEstimator:
 
             hvd.init()
             if store is not None:
-                # Worker-side shard read from store Parquet — the
-                # dataset never rides the pickled closure, only needed
-                # columns are read, and with enough part files each
-                # rank reads only its own parts.
+                # Worker-side STREAMING shard read from store Parquet —
+                # the dataset never rides the pickled closure, only
+                # needed columns are read row-group-at-a-time, so shards
+                # larger than worker RAM train (the reference streams
+                # through Petastorm readers for the same reason,
+                # ref: spark/common/util.py:697, keras/remote.py:336).
                 cols = est.feature_cols + [est.label_col]
-                by_parts = store.sharding_by_parts(data_path, hvd.size())
-                pdf = store.read_parquet(
-                    data_path, columns=cols,
-                    shard_rank=hvd.rank(), shard_size=hvd.size(),
-                )
-                xs_full = np.stack(
-                    [pdf[c].to_numpy() for c in est.feature_cols], axis=-1
-                ).astype(np.float32)
-                ys_full = pdf[est.label_col].to_numpy()
-                if by_parts:
-                    # Already a disjoint per-rank shard.
-                    xs, ys = xs_full, ys_full
-                else:
-                    xs = xs_full[hvd.rank()::hvd.size()]
-                    ys = ys_full[hvd.rank()::hvd.size()]
+                n_rows_local = store.shard_num_rows(
+                    data_path, hvd.rank(), hvd.size())
+
+                def _to_arrays(pdf):
+                    bx = np.stack(
+                        [pdf[c].to_numpy() for c in est.feature_cols],
+                        axis=-1,
+                    ).astype(np.float32)
+                    return bx, pdf[est.label_col].to_numpy()
+
+                def epoch_batches(epoch):
+                    """Exactly-batch_size batches with a buffer-local
+                    shuffle (the streaming analogue of the reference
+                    readers' shuffling_queue_capacity); memory is
+                    bounded by ~5x batch_size rows."""
+                    rng = np.random.RandomState(epoch)
+                    bufs = []
+                    have = 0
+                    for pdf in store.iter_parquet_batches(
+                            data_path, columns=cols,
+                            shard_rank=hvd.rank(), shard_size=hvd.size(),
+                            batch_rows=max(est.batch_size * 4, 1024)):
+                        bx, by = _to_arrays(pdf)
+                        perm = rng.permutation(len(by))
+                        bufs.append((bx[perm], by[perm]))
+                        have += len(by)
+                        while have >= est.batch_size:
+                            X = np.concatenate([b for b, _ in bufs])
+                            Y = np.concatenate([b for _, b in bufs])
+                            yield (X[:est.batch_size], Y[:est.batch_size])
+                            bufs = [(X[est.batch_size:],
+                                     Y[est.batch_size:])]
+                            have -= est.batch_size
+                    if have:
+                        # Final partial batch, so a shard smaller than
+                        # batch_size still trains (matches the ragged
+                        # last-step semantics of the in-memory path).
+                        yield (np.concatenate([b for b, _ in bufs]),
+                               np.concatenate([b for _, b in bufs]))
+
+                example_x = None
+                if n_rows_local:
+                    example_x = next(epoch_batches(0))[0]
             else:
                 xs = x[hvd.rank()::hvd.size()]
                 ys = y[hvd.rank()::hvd.size()]
+                n_rows_local = len(xs)
+                example_x = xs[: est.batch_size] if len(xs) else None
 
             start_epoch = 0
             saved_opt = None
             params = None
-            if store is not None and store.has_checkpoint(run_id):
+            # Resume is decided on rank 0 ONLY and broadcast: on a
+            # store whose files aren't identically visible everywhere
+            # (LocalStore without a shared mount), per-rank checkpoint
+            # probing would give ranks different start epochs — a
+            # collective-count mismatch (hang) or silent optimizer
+            # divergence.
+            if hvd.rank() == 0 and store is not None \
+                    and store.has_checkpoint(run_id):
                 ckpt = store.load_checkpoint(run_id)
                 # A checkpoint is only a valid resume point for the SAME
                 # dataset: a differing fingerprint means the caller
@@ -160,11 +199,43 @@ class JaxEstimator:
                     params = ckpt["params"]
                     start_epoch = int(ckpt.get("epoch", -1)) + 1
                     saved_opt = ckpt.get("opt_state")
+            if hvd.size() > 1:
+                start_epoch, params, saved_opt = hvd.broadcast_object(
+                    (start_epoch, params, saved_opt), root_rank=0,
+                    name="estimator_resume")
             if params is None:
-                params = est.model.init(
-                    jax.random.PRNGKey(est.seed), xs[: est.batch_size]
-                )
-            params = hvd.broadcast_parameters(params, root_rank=0)
+                # Init on the lowest rank that has any rows, then object-
+                # broadcast: raising on only the empty-shard ranks would
+                # leave the others hanging in the next collective, and
+                # the all-empty verdict must be agreed so every rank
+                # raises together.
+                can_init = example_x is not None
+                root = 0
+                if hvd.size() > 1:
+                    have = hvd.allgather_object(can_init,
+                                                name="estimator_can_init")
+                    if not any(have):
+                        raise ValueError(
+                            "cannot initialize model: every rank's shard "
+                            "is empty and no checkpoint exists"
+                        )
+                    root = have.index(True)
+                elif not can_init:
+                    raise ValueError(
+                        "cannot initialize model: the dataset is empty "
+                        "and no checkpoint exists"
+                    )
+                if hvd.rank() == root:
+                    params = est.model.init(
+                        jax.random.PRNGKey(est.seed), example_x
+                    )
+                if hvd.size() > 1:
+                    params = hvd.broadcast_object(
+                        params, root_rank=root, name="estimator_init")
+            # No broadcast on the else path: checkpoint params already
+            # arrived on every rank via the resume broadcast_object
+            # above — a second full-size broadcast would double resume
+            # startup traffic for no effect.
             tx = hvd.DistributedOptimizer(est.optimizer)
             opt_state = saved_opt if saved_opt is not None else tx.init(params)
 
@@ -172,21 +243,31 @@ class JaxEstimator:
                 lambda p, bx, by: est.loss(est.model.apply(p, bx), by)
             ))
             # Per-epoch step count must be identical on every rank —
-            # each step's grad allreduce is a collective, and by-parts
-            # shards can be ragged. Agree on the minimum shard length.
-            n_local = len(xs)
+            # each step's grad allreduce is a collective, and shards can
+            # be ragged. Agree on the minimum shard length (exact, from
+            # Parquet metadata on the store path).
+            n_agreed = n_rows_local
             if hvd.size() > 1:
-                n_local = min(hvd.allgather_object(n_local))
+                n_agreed = min(hvd.allgather_object(n_rows_local))
             # Agreed-empty shard → zero steps everywhere (no rank may
             # break out of the loop alone; each step is a collective).
-            steps = 0 if n_local == 0 else max(n_local // est.batch_size, 1)
-            for epoch in range(start_epoch, est.epochs):
+            steps = 0 if n_agreed == 0 else max(n_agreed // est.batch_size, 1)
+
+            def batches_for(epoch):
+                if store is not None:
+                    return epoch_batches(epoch)
                 perm = np.random.RandomState(epoch).permutation(len(xs))
-                for i in range(steps):
-                    idx = perm[i * est.batch_size:(i + 1) * est.batch_size]
-                    if len(idx) == 0:
-                        break
-                    _, grads = grad_fn(params, xs[idx], ys[idx])
+                return (
+                    (xs[perm[i * est.batch_size:(i + 1) * est.batch_size]],
+                     ys[perm[i * est.batch_size:(i + 1) * est.batch_size]])
+                    for i in range(max(steps, 1))
+                )
+
+            for epoch in range(start_epoch, est.epochs):
+                it = batches_for(epoch)
+                for _ in range(steps):
+                    bx, by = next(it)
+                    _, grads = grad_fn(params, bx, by)
                     upd, opt_state = tx.update(grads, opt_state, params)
                     params = optax.apply_updates(params, upd)
                 if store is not None and hvd.rank() == 0:

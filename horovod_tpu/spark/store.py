@@ -73,6 +73,22 @@ class Store:
         disjoint per-rank shards (conservative default: no)."""
         return False
 
+    def iter_parquet_batches(self, path: str,
+                             columns: Optional[List[str]] = None,
+                             shard_rank: Optional[int] = None,
+                             shard_size: Optional[int] = None,
+                             batch_rows: int = 1024):
+        """Stream one worker's shard as bounded-size pandas chunks
+        without ever materializing the shard (the TPU-native equivalent
+        of the reference's Petastorm batch readers,
+        ref: spark/common/util.py:697, keras/remote.py:336)."""
+        raise NotImplementedError
+
+    def shard_num_rows(self, path: str, shard_rank: Optional[int] = None,
+                       shard_size: Optional[int] = None) -> int:
+        """Exact row count of one worker's shard, from metadata only."""
+        raise NotImplementedError
+
     def dataset_fingerprint(self, df) -> Optional[str]:
         """Cheap content identity for materialization reuse; None means
         'unknown — always re-materialize'."""
@@ -211,6 +227,61 @@ class LocalStore(Store):
         """True when read_parquet(shard_rank=..., shard_size=...) will
         return disjoint per-rank shards (enough part files)."""
         return shard_size > 1 and len(self._part_files(path)) >= shard_size
+
+    def iter_parquet_batches(self, path: str,
+                             columns: Optional[List[str]] = None,
+                             shard_rank: Optional[int] = None,
+                             shard_size: Optional[int] = None,
+                             batch_rows: int = 1024):
+        """Stream one worker's shard as pandas chunks of <= batch_rows
+        rows, reading row-group-at-a-time so shards larger than RAM can
+        train. With enough part files each rank streams only its own
+        files; otherwise rows are strided rank::size by GLOBAL row
+        index, so per-rank totals match `shard_num_rows` exactly (the
+        estimator's collective step-count agreement depends on that)."""
+        import pyarrow.parquet as pq
+
+        parts = self._part_files(path)
+        sharded = (shard_rank is not None and shard_size is not None
+                   and shard_size > 1)
+        by_parts = sharded and len(parts) >= shard_size
+        files = parts[shard_rank::shard_size] if by_parts else parts
+        offset = 0
+        for f in files:
+            pf = pq.ParquetFile(f)
+            try:
+                for rb in pf.iter_batches(batch_size=batch_rows,
+                                          columns=columns):
+                    pdf = rb.to_pandas()
+                    if sharded and not by_parts:
+                        first = (-(offset - shard_rank)) % shard_size
+                        pdf = pdf.iloc[first::shard_size]
+                    offset += len(rb)
+                    if len(pdf):
+                        yield pdf
+            finally:
+                pf.close()
+
+    def shard_num_rows(self, path: str, shard_rank: Optional[int] = None,
+                       shard_size: Optional[int] = None) -> int:
+        """Exact per-shard row count from Parquet metadata (no data
+        read), matching iter_parquet_batches' sharding."""
+        import pyarrow.parquet as pq
+
+        parts = self._part_files(path)
+        sharded = (shard_rank is not None and shard_size is not None
+                   and shard_size > 1)
+        by_parts = sharded and len(parts) >= shard_size
+
+        def rows(f):
+            return pq.ParquetFile(f).metadata.num_rows
+
+        if by_parts:
+            return sum(rows(f) for f in parts[shard_rank::shard_size])
+        total = sum(rows(f) for f in parts)
+        if not sharded:
+            return total
+        return len(range(shard_rank, total, shard_size))
 
     def _part_files(self, path: str) -> List[str]:
         if os.path.isfile(path):
