@@ -1,0 +1,84 @@
+"""Mesh-path (GSPMD) timeline: device-lane splice + collective lane.
+
+(ref: horovod/common/ops/gpu_operations.h:110-118 — the reference
+splices device-side event timings into its timeline; here the source is
+the XLA profiler and the splice is tested against a synthetic profiler
+dump because the CPU backend publishes no device plane.)
+"""
+import gzip
+import json
+import os
+
+import jax
+
+from horovod_tpu.engine.mesh_timeline import MeshTimeline
+
+
+def _write_fake_profile(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_splice_extracts_device_lanes_and_collectives(tmp_path):
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "/device:TPU:0"}},
+        # host-side python event: must NOT be spliced
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 5,
+         "name": "$api.py device_get"},
+        # device compute
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 0, "dur": 50,
+         "name": "fusion.42"},
+        # device collectives -> also duplicated onto the ICI lane
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 50, "dur": 10,
+         "name": "all-reduce-start.1"},
+        {"ph": "X", "pid": 3, "tid": 2, "ts": 70, "dur": 4,
+         "name": "collective-permute.3"},
+    ]
+    _write_fake_profile(tmp_path, events)
+    out = tmp_path / "mesh.json"
+    tl = MeshTimeline(str(out))
+    tl._splice(str(tmp_path))
+
+    got = json.load(open(out))["traceEvents"]
+    names = [(e.get("pid"), e.get("name")) for e in got
+             if e.get("ph") == "X"]
+    assert (3, "fusion.42") in names
+    assert (3, "all-reduce-start.1") in names
+    # collective lane copies, host event excluded
+    assert (999, "all-reduce-start.1") in names
+    assert (999, "collective-permute.3") in names
+    assert not any(n == "$api.py device_get" for _, n in names)
+    lanes = {e["args"]["name"] for e in got
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert "ICI collectives" in lanes
+
+
+def test_capture_smoke_writes_file(tmp_path):
+    """capture() round-trips through the real jax.profiler (host-only
+    planes on CPU) and always leaves a readable trace file."""
+    out = tmp_path / "mesh.json"
+    tl = MeshTimeline(str(out))
+    with tl.capture():
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jax.numpy.ones(8)))
+    if out.exists():  # profiler produced a trace (version-dependent)
+        data = json.load(open(out))
+        assert "traceEvents" in data
+
+
+def test_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("HOROVOD_TIMELINE", raising=False)
+    tl = MeshTimeline()
+    assert not tl.enabled
+    with tl.capture():
+        pass
+
+
+def test_output_path_derived_from_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TIMELINE", "/tmp/x/trace.json")
+    tl = MeshTimeline()
+    assert tl.output_path == "/tmp/x/trace.mesh.json"
