@@ -300,7 +300,11 @@ class Controller:
         first = reqs[0]
 
         def error(msg: str) -> Response:
-            return Response(ResponseType.ERROR, [name], error_message=msg)
+            # Always name the failing op so a user with hundreds of
+            # tensors in flight can find the culprit
+            # (ref: controller.cc error strings are likewise prefixed).
+            return Response(ResponseType.ERROR, [name],
+                            error_message=f"[{name}] {msg}")
 
         for r in reqs[1:]:
             if r.request_type != first.request_type:

@@ -122,6 +122,33 @@ def DistributedOptimizer(
             grads, tvars = zip(*list(grads_and_vars))
             return self.apply(list(grads), list(tvars))
 
+        # Config round-trip: get_config is the inner optimizer's config
+        # (the wrapper adds no hyperparameters), and from_config
+        # rebuilds the inner optimizer then re-wraps it, so
+        # keras.models.clone_model / serialize→deserialize paths that
+        # call type(opt).from_config(opt.get_config()) yield a working
+        # distributed optimizer without custom_objects
+        # (ref: horovod/keras/__init__.py:137-152 — the reference keeps
+        # a registry of wrapped classes for the same purpose; file-based
+        # load still goes through load_model(), which maps the
+        # Distributed<X> class name back to a wrapper).
+        def get_config(self):
+            return cls.get_config(self)
+
+        @classmethod
+        def from_config(cls_, config, custom_objects=None):
+            try:
+                base = cls.from_config(config, custom_objects)
+            except TypeError:  # base from_config without custom_objects
+                base = cls.from_config(config)
+            return DistributedOptimizer(
+                base, name=name, device_dense=device_dense,
+                device_sparse=device_sparse, compression=compression,
+                sparse_as_dense=sparse_as_dense,
+                gradient_predivide_factor=gradient_predivide_factor,
+                op=op, backward_passes_per_step=backward_passes_per_step,
+            )
+
     _DistributedOptimizer.__name__ = f"Distributed{cls.__name__}"
     return _DistributedOptimizer()
 

@@ -420,10 +420,12 @@ _local_results = LocalResultStore()
 
 def _scale_preserving_dtype(arr: np.ndarray, factor: float) -> np.ndarray:
     """Scale without changing dtype (numpy int * python float would
-    upcast to float64) — same contract as the engine's _scale_np."""
+    upcast to float64) — the engine's _scale_np, reused."""
     if factor == 1.0:
         return arr
-    return (arr.astype(np.float64) * factor).astype(arr.dtype)
+    from ..engine.engine import _scale_np
+
+    return _scale_np(arr, factor)
 
 
 def _check_eager(api: str):
@@ -492,6 +494,13 @@ def synchronize(handle: int):
     dtype = _handles.pop(handle, None)
     if handle in _local_results:
         out = _local_results.pop(handle)
+    elif handle < 0:
+        # Negative handles never reach the engine; falling through
+        # would surface as an opaque engine KeyError.
+        raise ValueError(
+            f"handle {handle} was already synchronized (results are "
+            "consumed on first synchronize)"
+        )
     else:
         out = _engine().synchronize(handle)
     return _tf().convert_to_tensor(np.asarray(out), dtype=dtype)

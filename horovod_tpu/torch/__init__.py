@@ -183,6 +183,13 @@ def synchronize(handle: int):
     kind, tensor, _ = _handles.pop(handle, (None, None, None))
     if handle in _local_results:
         out = _local_results.pop(handle)
+    elif handle < 0:
+        # Negative handles never reach the engine; falling through
+        # would surface as an opaque engine KeyError.
+        raise ValueError(
+            f"handle {handle} was already synchronized (results are "
+            "consumed on first synchronize)"
+        )
     else:
         out = _engine().synchronize(handle)
     if kind == "alltoall":

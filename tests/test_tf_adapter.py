@@ -301,6 +301,29 @@ def test_keras_state_and_lr_callbacks():
     assert _two(fn) == [True, True]
 
 
+def test_keras_optimizer_config_roundtrip(hvd_single):
+    """get_config/from_config on the dynamic wrapper re-wraps without
+    custom_objects, so clone/serialize paths that call
+    type(opt).from_config(opt.get_config()) keep working
+    (ref: horovod/keras/__init__.py:137-152)."""
+    import keras
+
+    import horovod_tpu.keras as hvd_keras
+
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(0.05, momentum=0.9))
+    cfg = opt.get_config()
+    # The wrapper adds no hyperparameters of its own.
+    assert float(np.asarray(cfg["learning_rate"])) == pytest.approx(0.05)
+    clone = type(opt).from_config(cfg)
+    assert getattr(clone, "_hvd_wrapped", False)
+    assert type(clone).__name__ == "DistributedSGD"
+    assert float(np.asarray(clone.get_config()["learning_rate"])) \
+        == pytest.approx(0.05)
+    assert float(np.asarray(clone.get_config()["momentum"])) \
+        == pytest.approx(0.9)
+
+
 def test_keras_load_model_rewraps_optimizer(tmp_path, hvd_single):
     """hvd.keras.load_model reconstructs a model saved with the wrapped
     DistributedOptimizer (ref: horovod/keras/__init__.py:127-158 —
