@@ -240,7 +240,15 @@ def make_train_step(
 
             return run
 
-        return with_mesh(init_jit), with_mesh(step_jit), ssh
+        wrapped_init = with_mesh(init_jit)
+        wrapped_step = with_mesh(step_jit)
+        # The raw (untraced) step lets callers embed the step in a larger
+        # jit — e.g. a lax.scan over K steps — without nesting pjit
+        # inside jit, which compiles far slower than tracing the body
+        # directly (bench.py's scan loop uses this).
+        wrapped_step.raw = train_step
+        wrapped_step.shardings = (ssh,) + bsh
+        return wrapped_init, wrapped_step, ssh
 
     return build
 
