@@ -21,6 +21,7 @@ import socket
 import struct
 from typing import Dict, List, Optional
 
+from ..common.exceptions import HorovodInternalError
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from .rendezvous import RendezvousClient
@@ -94,7 +95,12 @@ class TcpBackend(RingCollectivesMixin):
             my_host = "127.0.0.1"
         self._rendezvous.put(scope, str(self.rank), f"{my_host}:{my_port}".encode())
 
-        # Connect to all lower ranks; accept from all higher ranks.
+        # Connect to all lower ranks; accept from all higher ranks. The
+        # accept side is bounded: a higher rank that dies during
+        # bootstrap (or never starts) must surface as an error here, not
+        # an indefinite hang (ref: gloo's store_timeout on rendezvous).
+        bootstrap_timeout = env_cfg.get_float(
+            "HOROVOD_MESH_BOOTSTRAP_TIMEOUT", 300.0)
         for peer in range(self.rank):
             addr = self._rendezvous.wait_get(scope, str(peer)).decode()
             host, port = addr.rsplit(":", 1)
@@ -102,10 +108,34 @@ class TcpBackend(RingCollectivesMixin):
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _send_all(s, struct.pack("<i", self.rank))
             self.peers[peer] = s
+        listener.settimeout(bootstrap_timeout)
         for _ in range(self.rank + 1, self.size):
-            s, _ = listener.accept()
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            (peer,) = struct.unpack("<i", _recv_frame(s))
+            try:
+                s, _ = listener.accept()
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # The rank-frame read stays under the bootstrap timeout:
+                # a peer that connects but never identifies (half-dead
+                # host, stray port scan) must not wedge the job either.
+                s.settimeout(bootstrap_timeout)
+                (peer,) = struct.unpack("<i", _recv_frame(s))
+                s.settimeout(None)
+            except (socket.timeout, TimeoutError):
+                missing = sorted(
+                    set(range(self.rank + 1, self.size)) - set(self.peers))
+                # Elastic retries catch HorovodInternalError and re-init;
+                # abandoned sockets must not accumulate across retries.
+                listener.close()
+                for p in self.peers.values():
+                    try:
+                        p.close()
+                    except OSError:
+                        pass
+                self.peers.clear()
+                raise HorovodInternalError(
+                    f"rank {self.rank}: mesh bootstrap timed out after "
+                    f"{bootstrap_timeout:.0f}s waiting for rank(s) "
+                    f"{missing} to connect (HOROVOD_MESH_BOOTSTRAP_TIMEOUT)"
+                )
             self.peers[peer] = s
         listener.close()
         logger.debug("rank %d: TCP mesh connected (%d peers)", self.rank, len(self.peers))

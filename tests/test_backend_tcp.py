@@ -1,0 +1,31 @@
+"""TCP full-mesh bootstrap failure modes.
+
+(ref: horovod/common/gloo/gloo_context.cc rendezvous bootstrap — gloo
+bounds its store waits with a timeout; the accept side here needs the
+same bound.)
+"""
+
+
+def test_mesh_bootstrap_accept_timeout(monkeypatch):
+    """A higher rank that never connects must surface as an error on
+    the accepting rank, not an indefinite hang (caught live: rank 0
+    blocked forever in accept() when a joining worker died during
+    bootstrap)."""
+    import pytest
+
+    from horovod_tpu.backend.tcp import TcpBackend
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    monkeypatch.setenv("HOROVOD_MESH_BOOTSTRAP_TIMEOUT", "1.5")
+    monkeypatch.setenv("HVDRUN_FORCE_LOCAL", "1")
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        from horovod_tpu.backend.rendezvous import RendezvousClient
+
+        rdv = RendezvousClient("127.0.0.1", port)
+        with pytest.raises(HorovodInternalError, match=r"rank\(s\) \[1\]"):
+            TcpBackend(0, 2, rendezvous=rdv, scope="t_accept")
+    finally:
+        server.stop()
