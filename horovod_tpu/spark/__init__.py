@@ -3,4 +3,10 @@ Estimator API). pyspark is optional: `run(..., spark_context=...)`
 accepts any object with the small RDD surface used, and JaxEstimator
 fits pandas DataFrames locally."""
 from .estimator import JaxEstimator, JaxModel
+from .framework_estimators import (
+    KerasEstimator,
+    KerasModel,
+    TorchEstimator,
+    TorchModel,
+)
 from .runner import run, run_elastic

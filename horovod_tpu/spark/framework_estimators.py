@@ -1,0 +1,403 @@
+"""Torch and Keras Spark estimators over the shared Store data path.
+
+(ref: horovod/spark/torch/estimator.py:84-300 TorchEstimator/TorchModel,
+horovod/spark/keras/estimator.py:106-544 KerasEstimator/KerasModel.)
+
+Both reuse `JaxEstimator`'s pipeline shape: the DataFrame is
+materialized once to store Parquet keyed by a content fingerprint,
+every worker STREAMS its own shard row-group-at-a-time
+(`Store.iter_parquet_batches`), per-epoch checkpoints go to the store
+from rank 0 only, and resume is decided on rank 0 and broadcast. The
+framework-specific parts — distributed optimizer wrapping, weight
+broadcast, the train step — go through the `horovod_tpu.torch` /
+`horovod_tpu.keras` bindings, exactly how a user of those bindings
+would write the loop by hand.
+
+Models ride the pickled worker closure: torch modules pickle natively;
+Keras models are serialized to `.keras` bytes (architecture + weights,
+compile=False) because Keras 3 models do not survive plain pickle.
+"""
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+import uuid
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .store import Store
+
+
+# ---------------------------------------------------------------------------
+# Shared worker-side data plumbing
+
+
+def _prepare_data(store: Store, df) -> str:
+    path = store.get_train_data_path()
+    if not (store.is_parquet_dataset(path)
+            and store.matches_fingerprint(df, path)):
+        store.save_data_frame(df, path)
+    return path
+
+
+def _collect(df, feature_cols, label_col):
+    pdf = df.toPandas() if hasattr(df, "toPandas") else df
+    x = np.stack([pdf[c].to_numpy() for c in feature_cols],
+                 axis=-1).astype(np.float32)
+    return x, pdf[label_col].to_numpy()
+
+
+def _shard_batches(store, data_path, feature_cols, label_col, batch_size,
+                   epoch, rank, size):
+    """Stream exactly-batch_size (plus one final ragged) batches of one
+    worker's shard with a buffer-local shuffle; memory bounded by ~5x
+    batch_size rows (see JaxEstimator.fit for the same construction)."""
+    cols = list(feature_cols) + [label_col]
+    rng = np.random.RandomState(epoch)
+    bufs: List = []
+    have = 0
+    for pdf in store.iter_parquet_batches(
+            data_path, columns=cols, shard_rank=rank, shard_size=size,
+            batch_rows=max(batch_size * 4, 1024)):
+        bx = np.stack([pdf[c].to_numpy() for c in feature_cols],
+                      axis=-1).astype(np.float32)
+        by = pdf[label_col].to_numpy()
+        perm = rng.permutation(len(by))
+        bufs.append((bx[perm], by[perm]))
+        have += len(by)
+        while have >= batch_size:
+            X = np.concatenate([b for b, _ in bufs])
+            Y = np.concatenate([b for _, b in bufs])
+            yield X[:batch_size], Y[:batch_size]
+            bufs = [(X[batch_size:], Y[batch_size:])]
+            have -= batch_size
+    if have:
+        yield (np.concatenate([b for b, _ in bufs]),
+               np.concatenate([b for _, b in bufs]))
+
+
+def _memory_batches(x, y, batch_size, epoch, steps):
+    perm = np.random.RandomState(epoch).permutation(len(y))
+    for i in range(max(steps, 1)):
+        idx = perm[i * batch_size:(i + 1) * batch_size]
+        yield x[idx], y[idx]
+
+
+class _DataPlan:
+    """Worker-side view of the training data: streaming from the store
+    when one is configured, in-closure arrays otherwise."""
+
+    def __init__(self, est, df):
+        self.store = est.store
+        if self.store is not None:
+            self.data_path = _prepare_data(self.store, df)
+            self.data_fp = self.store.dataset_fingerprint(df)
+            self.x = self.y = None
+        else:
+            self.x, self.y = _collect(df, est.feature_cols, est.label_col)
+            self.data_path = self.data_fp = None
+        self.feature_cols = est.feature_cols
+        self.label_col = est.label_col
+        self.batch_size = est.batch_size
+
+    # everything below runs inside the worker --------------------------
+    def local_rows(self, rank, size) -> int:
+        if self.store is not None:
+            return self.store.shard_num_rows(self.data_path, rank, size)
+        return len(range(rank, len(self.y), size))
+
+    def batches(self, epoch, rank, size):
+        if self.store is not None:
+            return _shard_batches(
+                self.store, self.data_path, self.feature_cols,
+                self.label_col, self.batch_size, epoch, rank, size)
+        xs, ys = self.x[rank::size], self.y[rank::size]
+        steps = max(len(ys) // self.batch_size, 1)
+        return _memory_batches(xs, ys, self.batch_size, epoch, steps)
+
+
+def _agreed_steps(hvd_mod, n_rows_local: int, batch_size: int) -> int:
+    n = n_rows_local
+    if hvd_mod.size() > 1:
+        n = min(hvd_mod.allgather_object(n))
+    return 0 if n == 0 else max(n // batch_size, 1)
+
+
+def _decide_resume(hvd_mod, store, run_id, data_fp):
+    """Rank 0 probes the store; the verdict is broadcast (per-rank
+    probing desyncs epochs on non-shared mounts — see JaxEstimator)."""
+    payload = (0, None)
+    if hvd_mod.rank() == 0 and store is not None \
+            and store.has_checkpoint(run_id):
+        ckpt = store.load_checkpoint(run_id)
+        if data_fp is None or ckpt.get("data_fp") == data_fp:
+            payload = (int(ckpt.get("epoch", -1)) + 1, ckpt)
+    if hvd_mod.size() > 1:
+        payload = hvd_mod.broadcast_object(payload, root_rank=0,
+                                           name="fw_estimator_resume")
+    return payload
+
+
+# ---------------------------------------------------------------------------
+class TorchModel:
+    """Fitted-model transformer (ref: torch/estimator.py:304 TorchModel)."""
+
+    def __init__(self, model, feature_cols, label_col, output_col):
+        self.model = model
+        self.feature_cols = feature_cols
+        self.label_col = label_col
+        self.output_col = output_col
+
+    def transform(self, df):
+        import torch
+
+        pdf = df.toPandas() if hasattr(df, "toPandas") else df
+        x = np.stack([pdf[c].to_numpy() for c in self.feature_cols],
+                     axis=-1).astype(np.float32)
+        self.model.eval()
+        with torch.no_grad():
+            out = self.model(torch.from_numpy(x)).numpy()
+        res = pdf.copy()
+        res[self.output_col] = list(out)
+        return res
+
+
+class TorchEstimator:
+    """Fit a torch.nn.Module on a DataFrame across Spark tasks
+    (ref: horovod/spark/torch/estimator.py:84-231).
+
+    `optimizer` is a torch optimizer INSTANCE (as in the reference);
+    each worker rebuilds `type(optimizer)(model.parameters(),
+    **optimizer.defaults)` against its own module copy and wraps it in
+    `horovod_tpu.torch.DistributedOptimizer`."""
+
+    def __init__(self, model, optimizer, loss, feature_cols: Sequence[str],
+                 label_col: str, output_col: str = "prediction",
+                 num_proc: Optional[int] = None, epochs: int = 1,
+                 batch_size: int = 32, store: Optional[Store] = None,
+                 run_id: Optional[str] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.output_col = output_col
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.store = store
+        self.run_id = run_id or f"torch-estimator-{uuid.uuid4().hex[:8]}"
+
+    def fit(self, df) -> TorchModel:
+        # Closure captures PLAIN locals (not `self`): the worker payload
+        # should carry the module, loss, and scalars — nothing else.
+        plan = _DataPlan(self, df)
+        module = self.model
+        loss_fn = self.loss
+        opt_cls = type(self.optimizer)
+        opt_defaults = dict(self.optimizer.defaults)
+        epochs, batch_size = self.epochs, self.batch_size
+        store, run_id = self.store, self.run_id
+
+        def train():
+            import torch
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            model = module
+            rank, size = hvd.rank(), hvd.size()
+
+            start_epoch, ckpt = _decide_resume(
+                hvd, store, run_id, plan.data_fp)
+            if ckpt is not None:
+                model.load_state_dict({
+                    k: torch.from_numpy(np.asarray(v))
+                    for k, v in ckpt["state_dict"].items()
+                })
+            opt = opt_cls(model.parameters(), **opt_defaults)
+            if ckpt is not None and ckpt.get("opt_state") is not None:
+                opt.load_state_dict(ckpt["opt_state"])
+            hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            hvd.broadcast_optimizer_state(opt, root_rank=0)
+            opt = hvd.DistributedOptimizer(
+                opt, named_parameters=model.named_parameters())
+
+            steps = _agreed_steps(hvd, plan.local_rows(rank, size),
+                                  batch_size)
+            for epoch in range(start_epoch, epochs):
+                model.train()
+                it = plan.batches(epoch, rank, size)
+                for _ in range(steps):
+                    bx, by = next(it)
+                    opt.zero_grad()
+                    out = model(torch.from_numpy(bx))
+                    target = torch.from_numpy(np.asarray(by))
+                    if target.is_floating_point():
+                        # pandas float columns default to float64;
+                        # torch losses want the model's float32.
+                        target = target.float()
+                    loss = loss_fn(out, target)
+                    loss.backward()
+                    opt.step()
+                if store is not None and rank == 0:
+                    store.save_checkpoint(run_id, {
+                        "state_dict": {
+                            k: v.detach().cpu().numpy()
+                            for k, v in model.state_dict().items()
+                        },
+                        "opt_state": opt.state_dict(),
+                        "epoch": epoch,
+                        "data_fp": plan.data_fp,
+                    }, epoch=epoch)
+            return {k: v.detach().cpu().numpy()
+                    for k, v in model.state_dict().items()}
+
+        state_dict = _run_workers(train, self.num_proc, df)[0]
+        import torch
+
+        self.model.load_state_dict({
+            k: torch.from_numpy(np.asarray(v))
+            for k, v in state_dict.items()
+        })
+        return TorchModel(self.model, self.feature_cols, self.label_col,
+                          self.output_col)
+
+
+# ---------------------------------------------------------------------------
+def _serialize_keras_model(model) -> bytes:
+    """Keras-3 models don't pickle; `.keras` bytes do
+    (ref: horovod/spark/keras/util.py serialize_model — same idea with
+    h5 there)."""
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.keras")
+        model.save(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+
+def _deserialize_keras_model(blob: bytes):
+    import keras
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.keras")
+        with open(path, "wb") as f:
+            f.write(blob)
+        return keras.models.load_model(path, compile=False)
+
+
+class KerasModel:
+    """Fitted-model transformer (ref: keras/estimator.py:544 KerasModel)."""
+
+    def __init__(self, model, feature_cols, label_col, output_col):
+        self.model = model
+        self.feature_cols = feature_cols
+        self.label_col = label_col
+        self.output_col = output_col
+
+    def transform(self, df):
+        pdf = df.toPandas() if hasattr(df, "toPandas") else df
+        x = np.stack([pdf[c].to_numpy() for c in self.feature_cols],
+                     axis=-1).astype(np.float32)
+        out = np.asarray(self.model.predict(x, verbose=0))
+        res = pdf.copy()
+        res[self.output_col] = list(out)
+        return res
+
+
+class KerasEstimator:
+    """Fit a Keras model on a DataFrame across Spark tasks
+    (ref: horovod/spark/keras/estimator.py:106-543).
+
+    `optimizer` is a keras optimizer instance (serialized via
+    keras.optimizers.serialize and rebuilt per worker); `loss` is a
+    Keras loss identifier or callable. Each worker compiles the model
+    with `horovod_tpu.keras.DistributedOptimizer` and runs
+    train_on_batch over its streamed shard."""
+
+    def __init__(self, model, optimizer, loss, feature_cols: Sequence[str],
+                 label_col: str, output_col: str = "prediction",
+                 num_proc: Optional[int] = None, epochs: int = 1,
+                 batch_size: int = 32, store: Optional[Store] = None,
+                 run_id: Optional[str] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.output_col = output_col
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.store = store
+        self.run_id = run_id or f"keras-estimator-{uuid.uuid4().hex[:8]}"
+
+    def fit(self, df) -> KerasModel:
+        import keras
+
+        # Closure captures PLAIN locals only: Keras 3 model/optimizer
+        # instances do not survive pickle, which is the whole reason
+        # model_blob/opt_cfg exist — capturing `self` would smuggle the
+        # live objects into the worker payload anyway.
+        plan = _DataPlan(self, df)
+        model_blob = _serialize_keras_model(self.model)
+        opt_cfg = keras.optimizers.serialize(self.optimizer)
+        loss = self.loss
+        epochs, batch_size = self.epochs, self.batch_size
+        store, run_id = self.store, self.run_id
+
+        def train():
+            import keras
+
+            import horovod_tpu.keras as hvd
+
+            hvd.init()
+            rank, size = hvd.rank(), hvd.size()
+            model = _deserialize_keras_model(model_blob)
+
+            start_epoch, ckpt = _decide_resume(
+                hvd, store, run_id, plan.data_fp)
+            if ckpt is not None:
+                model.set_weights([np.asarray(w)
+                                   for w in ckpt["weights"]])
+            opt = hvd.DistributedOptimizer(
+                keras.optimizers.deserialize(opt_cfg))
+            model.compile(optimizer=opt, loss=loss)
+            hvd.broadcast_global_variables(model, root_rank=0)
+
+            steps = _agreed_steps(hvd, plan.local_rows(rank, size),
+                                  batch_size)
+            for epoch in range(start_epoch, epochs):
+                it = plan.batches(epoch, rank, size)
+                for _ in range(steps):
+                    bx, by = next(it)
+                    model.train_on_batch(bx, np.asarray(by))
+                if store is not None and rank == 0:
+                    store.save_checkpoint(run_id, {
+                        "weights": [np.asarray(w)
+                                    for w in model.get_weights()],
+                        "epoch": epoch,
+                        "data_fp": plan.data_fp,
+                    }, epoch=epoch)
+            return [np.asarray(w) for w in model.get_weights()]
+
+        weights = _run_workers(train, self.num_proc, df)[0]
+        self.model.set_weights([np.asarray(w) for w in weights])
+        return KerasModel(self.model, self.feature_cols, self.label_col,
+                          self.output_col)
+
+
+# ---------------------------------------------------------------------------
+def _run_workers(train: Callable, num_proc: Optional[int], df):
+    num_proc = num_proc or 1
+    if hasattr(df, "rdd"):
+        from .runner import run as spark_run
+
+        return spark_run(train, num_proc=num_proc)
+    if num_proc > 1:
+        from ..runner import run as local_run
+
+        return local_run(train, np=num_proc)
+    return [train()]

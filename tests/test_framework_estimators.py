@@ -1,0 +1,142 @@
+"""Torch/Keras Spark estimators over the shared Store data path.
+
+(ref: test/test_spark.py torch-estimator and keras-estimator suites —
+fit on a DataFrame, transform, checkpoint/resume.)
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.spark import (
+    KerasEstimator,
+    TorchEstimator,
+)
+from horovod_tpu.spark.store import LocalStore
+
+torch = pytest.importorskip("torch")
+
+
+def _toy_df(n=256, slope=3.0, seed=0):
+    x = np.random.RandomState(seed).rand(n).astype(np.float32)
+    return pd.DataFrame({"x": x, "y": slope * x + 1.0})
+
+
+def _torch_estimator(store=None, run_id=None, epochs=3, num_proc=None):
+    model = torch.nn.Linear(1, 1)
+    return TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.5),
+        loss=lambda out, y: torch.nn.functional.mse_loss(
+            out.squeeze(-1), y),
+        feature_cols=["x"], label_col="y",
+        epochs=epochs, batch_size=32, store=store, run_id=run_id,
+        num_proc=num_proc,
+    )
+
+
+def test_torch_estimator_fits_and_transforms(tmp_path, hvd_single):
+    store = LocalStore(str(tmp_path))
+    est = _torch_estimator(store=store, run_id="t1", epochs=12)
+    df = _toy_df()
+    model = est.fit(df)
+    pred = model.transform(df)
+    err = np.abs(np.stack(pred["prediction"].to_numpy()).ravel()
+                 - df["y"].to_numpy()).mean()
+    assert err < 0.25, err
+    # per-epoch checkpoints landed, tagged with the data fingerprint
+    ck = store.load_checkpoint("t1")
+    assert ck["epoch"] == 11
+    assert ck["data_fp"] == store.dataset_fingerprint(df)
+
+
+def test_torch_estimator_resumes(tmp_path, hvd_single):
+    store = LocalStore(str(tmp_path))
+    df = _toy_df()
+    _torch_estimator(store=store, run_id="t2", epochs=2).fit(df)
+    assert store.load_checkpoint("t2")["epoch"] == 1
+    # Re-fit with more epochs resumes at epoch 2 (not 0).
+    _torch_estimator(store=store, run_id="t2", epochs=4).fit(df)
+    assert store.load_checkpoint("t2")["epoch"] == 3
+
+
+def test_torch_estimator_two_procs(tmp_path):
+    """End-to-end across 2 real worker processes (engine path)."""
+    store = LocalStore(str(tmp_path))
+    est = _torch_estimator(store=store, run_id="t3", epochs=8, num_proc=2)
+    df = _toy_df()
+    model = est.fit(df)
+    pred = model.transform(df)
+    err = np.abs(np.stack(pred["prediction"].to_numpy()).ravel()
+                 - df["y"].to_numpy()).mean()
+    assert err < 0.4, err
+
+
+def test_keras_estimator_fits_and_resumes(tmp_path, hvd_single):
+    keras = pytest.importorskip("keras")
+
+    store = LocalStore(str(tmp_path))
+    df = _toy_df()
+
+    def make_est(epochs, run_id="k1"):
+        model = keras.Sequential([
+            keras.layers.Input(shape=(1,)),
+            keras.layers.Dense(1),
+        ])
+        return KerasEstimator(
+            model=model,
+            optimizer=keras.optimizers.SGD(0.5),
+            loss="mse",
+            feature_cols=["x"], label_col="y",
+            epochs=epochs, batch_size=32, store=store, run_id=run_id,
+        )
+
+    model = make_est(epochs=10).fit(df)
+    pred = model.transform(df)
+    err = np.abs(np.stack(pred["prediction"].to_numpy()).ravel()
+                 - df["y"].to_numpy()).mean()
+    assert err < 0.3, err
+    assert store.load_checkpoint("k1")["epoch"] == 9
+    # resume
+    make_est(epochs=12).fit(df)
+    assert store.load_checkpoint("k1")["epoch"] == 11
+
+
+def test_keras_estimator_two_procs(tmp_path):
+    """The worker closure must survive pickling WITHOUT the live Keras
+    model riding along (Keras 3 models don't pickle — only the .keras
+    blob and optimizer config may cross the process boundary)."""
+    keras = pytest.importorskip("keras")
+
+    store = LocalStore(str(tmp_path))
+    model = keras.Sequential([
+        keras.layers.Input(shape=(1,)),
+        keras.layers.Dense(1),
+    ])
+    est = KerasEstimator(
+        model=model,
+        optimizer=keras.optimizers.SGD(0.5),
+        loss="mse",
+        feature_cols=["x"], label_col="y",
+        epochs=6, batch_size=32, store=store, run_id="k2", num_proc=2,
+    )
+    df = _toy_df()
+    fitted = est.fit(df)
+    pred = fitted.transform(df)
+    err = np.abs(np.stack(pred["prediction"].to_numpy()).ravel()
+                 - df["y"].to_numpy()).mean()
+    assert err < 0.5, err
+    assert store.load_checkpoint("k2")["epoch"] == 5
+
+
+def test_torch_estimator_float64_labels(tmp_path, hvd_single):
+    """pandas float columns default to float64; the worker must cast
+    targets to the model's float32 instead of crashing in the loss."""
+    store = LocalStore(str(tmp_path))
+    x = np.random.RandomState(0).rand(128).astype(np.float32)
+    df = pd.DataFrame({"x": x, "y": (3.0 * x + 1.0).astype(np.float64)})
+    est = _torch_estimator(store=store, run_id="t4", epochs=6)
+    model = est.fit(df)
+    pred = model.transform(df)
+    err = np.abs(np.stack(pred["prediction"].to_numpy()).ravel()
+                 - df["y"].to_numpy()).mean()
+    assert err < 0.5, err
