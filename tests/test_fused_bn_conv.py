@@ -1,0 +1,73 @@
+"""Fused BN-apply + ReLU + 1x1-conv + stats kernel vs the unfused
+composition (interpret mode on CPU; the real win is measured on TPU —
+see docs/kernels.md)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.fused_bn_conv import (
+    _reference_bn_relu_matmul,
+    bn_relu_conv1x1,
+    fused_bn_relu_matmul,
+)
+
+
+def _inputs(m=1024, cin=256, cout=128, seed=0, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(m, cin), dtype)
+    mu = jnp.asarray(rng.randn(cin), jnp.float32) * 0.1
+    var = jnp.asarray(rng.rand(cin) + 0.5, jnp.float32)
+    gamma = jnp.asarray(rng.rand(cin) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(cin) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.randn(cin, cout) / np.sqrt(cin), dtype)
+    return x, mu, var, gamma, beta, w
+
+
+def test_fused_matches_reference():
+    args = _inputs()
+    y, s1, s2 = fused_bn_relu_matmul(*args, interpret=True)
+    yr, s1r, s2r = _reference_bn_relu_matmul(*args, 1e-5)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(s1, s1r, rtol=2e-2, atol=2.0)
+    np.testing.assert_allclose(s2, s2r, rtol=3e-2, atol=3.0)
+
+
+def test_fused_multiblock_stats_accumulate():
+    """M spans several grid blocks: the epilogue must accumulate stats
+    across the revisited output block, not overwrite them."""
+    args = _inputs(m=2048, cin=128, cout=256)
+    y, s1, s2 = fused_bn_relu_matmul(*args, interpret=True, block_m=512)
+    _, s1r, s2r = _reference_bn_relu_matmul(*args, 1e-5)
+    np.testing.assert_allclose(s1, s1r, rtol=2e-2, atol=4.0)
+    np.testing.assert_allclose(s2, s2r, rtol=3e-2, atol=6.0)
+
+
+def test_custom_vjp_matches_reference_grads():
+    args = _inputs(m=512, cin=128, cout=128, dtype=jnp.float32)
+
+    def loss_fused(x, gamma, beta, w):
+        y, s1, s2 = bn_relu_conv1x1(x, args[1], args[2], gamma, beta, w)
+        return (jnp.sum(y.astype(jnp.float32) ** 2) * 1e-3
+                + jnp.sum(s1) * 1e-3 + jnp.sum(s2) * 1e-4)
+
+    def loss_ref(x, gamma, beta, w):
+        y, s1, s2 = _reference_bn_relu_matmul(
+            x, args[1], args[2], gamma, beta, w, 1e-5)
+        return (jnp.sum(y.astype(jnp.float32) ** 2) * 1e-3
+                + jnp.sum(s1) * 1e-3 + jnp.sum(s2) * 1e-4)
+
+    x, _, _, gamma, beta, w = args
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, gamma, beta, w)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, gamma, beta, w)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_block_divisibility_error():
+    args = _inputs(m=1000)  # not divisible by 512
+    with pytest.raises(ValueError, match="divisible"):
+        fused_bn_relu_matmul(*args, interpret=True)
