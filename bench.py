@@ -89,7 +89,8 @@ def _force_cpu(n_devices: int):
     _jeb.clear_backends()
 
 
-def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None):
+def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
+           donate=True):
     import jax
     import numpy as np
     import optax
@@ -125,6 +126,7 @@ def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None):
 
     build = make_train_step(
         model, tx, loss_fn, mesh=mesh, has_batch_stats=has_bn,
+        donate=donate,
     )
     init_fn, step_fn, _ = build(jax.random.PRNGKey(0), inputs, labels)
     state = init_fn(jax.random.PRNGKey(0))
@@ -237,16 +239,27 @@ def _scaling_probe(n_devices: int, batch: int, image_size: int,
     steps ResNet-50 on this single CPU core takes several minutes,
     which would dwarf the signal. Per-call dispatch overhead is
     identical for both device counts, so the ratio stays a valid
-    overhead trend (see module docstring)."""
+    overhead trend (see module docstring).
+
+    Every rep restarts from the SAME initial state (donation off): CPU
+    per-step cost depends on the parameter trajectory (denormal-heavy
+    regions run far slower), so timing a continuing trajectory makes
+    reps incomparable — with a fixed start, every rep on every device
+    count times the identical computation."""
     _force_cpu(n_devices)
-    state, step_fn, images, labels, _, mesh = _build(
-        "resnet50", n_devices, batch // n_devices, image_size
+    state0, step_fn, images, labels, _, mesh = _build(
+        "resnet50", n_devices, batch // n_devices, image_size,
+        donate=False,
     )
-    # Warm once (compile + first run), then take cheap samples.
-    state, loss = step_fn(state, images, labels)
+    # Warm with one full discarded rep (compile + first-touch paging),
+    # then take comparable samples.
+    state = state0
+    for _ in range(iters):
+        state, loss = step_fn(state, images, labels)
     _hard_sync(loss)
     samples = []
     for _ in range(reps):
+        state = state0
         t0 = time.perf_counter()
         for _ in range(iters):
             state, loss = step_fn(state, images, labels)
@@ -255,7 +268,7 @@ def _scaling_probe(n_devices: int, batch: int, image_size: int,
     print(json.dumps({"seconds": samples}))
 
 
-def _measure_scaling(batch=32, image_size=64, iters=16, reps=3):
+def _measure_scaling(batch=32, image_size=64, iters=8, reps=3):
     """t(1 dev)/t(8 dev) for the same global batch: one subprocess per
     device count (fresh backend), `reps` timed samples inside each (one
     compile per count). Returns (median-ratio, spread) or None; spread
@@ -384,7 +397,8 @@ def main():
         pass
     elif n_chips > 1:
         scaling = _real_weak_scaling(n_chips, args.model, bs,
-                                     args.image_size, args.num_iters // 2)
+                                     args.image_size,
+                                     max(args.num_iters // 2, 1))
     else:
         res = _measure_scaling(reps=args.scaling_reps)
         if res is not None:
