@@ -273,3 +273,58 @@ def gloo_built() -> bool:
 
 def ccl_built() -> bool:
     return False
+
+
+def cuda_built() -> bool:
+    # TPU framework: device compute goes through XLA, never CUDA
+    # (ref: horovod/torch/mpi_ops.py cuda_built).
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    # The TCP controller + engine fill Gloo's role (see gloo_built).
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def gpu_available(ext_base_name: str = "") -> bool:
+    """TPU chips are not GPUs; GPU-specific paths are never taken
+    (ref: horovod/common/util.py gpu_available)."""
+    return False
+
+
+def check_extension(ext_name: str, *args, **kwargs) -> None:
+    """All framework adapters are pure-Python over the shared engine —
+    there is no compiled per-framework extension that could be missing
+    (ref: horovod/common/util.py check_extension raises when the
+    framework .so wasn't built)."""
+    return None
+
+
+def num_rank_is_power_2(num_rank: int) -> bool:
+    """(ref: horovod/common/util.py num_rank_is_power_2 — Adasum's
+    ladder needs a power-of-2 world.)"""
+    return num_rank != 0 and (num_rank & (num_rank - 1)) == 0
+
+
+def check_num_rank_power_of_2(num_rank: int) -> None:
+    if not num_rank_is_power_2(num_rank):
+        raise ValueError(
+            "Adasum requires a power-of-2 number of ranks; got "
+            f"{num_rank}"
+        )

@@ -31,6 +31,17 @@ from ..common.basics import (  # noqa: F401  (re-exported API surface)
     mpi_built,
     gloo_built,
     nccl_built,
+    ccl_built,
+    check_extension,
+    check_num_rank_power_of_2,
+    cuda_built,
+    ddl_built,
+    gloo_enabled,
+    gpu_available,
+    mpi_enabled,
+    mpi_threads_supported,
+    num_rank_is_power_2,
+    rocm_built,
     rank,
     shutdown,
     size,
