@@ -110,6 +110,7 @@ class TcpBackend(RingCollectivesMixin):
             self.peers[peer] = s
         listener.settimeout(bootstrap_timeout)
         for _ in range(self.rank + 1, self.size):
+            s = None
             try:
                 s, _ = listener.accept()
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -120,6 +121,14 @@ class TcpBackend(RingCollectivesMixin):
                 (peer,) = struct.unpack("<i", _recv_frame(s))
                 s.settimeout(None)
             except (socket.timeout, TimeoutError):
+                # An accepted-but-unidentified socket is not in
+                # self.peers yet; close it here or it leaks an fd on
+                # every elastic retry.
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
                 missing = sorted(
                     set(range(self.rank + 1, self.size)) - set(self.peers))
                 # Elastic retries catch HorovodInternalError and re-init;

@@ -121,4 +121,8 @@ def _load_profiler_events(profile_dir: str) -> Optional[List[dict]]:
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt") as f:
         data = json.load(f)
-    return data.get("traceEvents", data if isinstance(data, list) else [])
+    # A Chrome trace may be a top-level array rather than an object;
+    # data.get on a list raises before any default applies.
+    if isinstance(data, list):
+        return data
+    return data.get("traceEvents", [])

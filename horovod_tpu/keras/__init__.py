@@ -70,12 +70,27 @@ def DistributedOptimizer(
     from ..tensorflow import _make_allreduce_grads_fn
 
     cls = type(optimizer)
+    k = int(backward_passes_per_step)
+
+    if op == ReduceOp.ADASUM and size() > 1:
+        if gradient_predivide_factor != 1.0:
+            # (ref: horovod/torch/optimizer.py:431-435 — predivide is
+            # Average-only; silently ignoring it would change the
+            # effective update.)
+            raise ValueError(
+                "gradient_predivide_factor is not supported with "
+                "op=Adasum"
+            )
+        return _make_adasum_optimizer(
+            optimizer, name, device_dense, device_sparse,
+            compression or Compression.none, sparse_as_dense, k,
+        )
+
     allreduce_grads = _make_allreduce_grads_fn(
         name or f"Distributed{cls.__name__}", device_dense, device_sparse,
         compression or Compression.none, sparse_as_dense, op,
         gradient_predivide_factor,
     )
-    k = int(backward_passes_per_step)
 
     class _DistributedOptimizer(cls):
         _hvd_wrapped = True
@@ -151,6 +166,110 @@ def DistributedOptimizer(
 
     _DistributedOptimizer.__name__ = f"Distributed{cls.__name__}"
     return _DistributedOptimizer()
+
+
+def _make_adasum_optimizer(optimizer, name, device_dense, device_sparse,
+                           compression, sparse_as_dense, k):
+    """Delta-model Adasum wrapper (ref: horovod/tensorflow/__init__.py:
+    334-428 _DistributedAdasumOptimizer).
+
+    `op=Adasum` does NOT Adasum-allreduce gradients. Per variable:
+
+        start = var            (captured on the first apply)
+        local step             (the wrapped optimizer's own update)
+        every k-th apply:
+            delta  = var - start
+            delta  = adasum(delta)   (compressed, grouped VHDD combine)
+            start += delta
+            var    = start
+
+    Between communication steps the local optimizer keeps stepping on
+    `var` (the reference's `_is_comm_step` schedule, :356,383-386) —
+    unlike the gradient wrapper, which accumulates grads and applies
+    once per boundary.
+    """
+    from ..tensorflow import _make_allreduce_grads_fn
+
+    cls = type(optimizer)
+    allreduce_deltas = _make_allreduce_grads_fn(
+        name or f"DistributedDelta{cls.__name__}", device_dense,
+        device_sparse, compression, sparse_as_dense, ReduceOp.ADASUM, 1.0,
+    )
+
+    class _DistributedAdasumOptimizer(cls):
+        _hvd_wrapped = True
+
+        def __init__(self):
+            object.__setattr__(self, "__dict__", optimizer.__dict__)
+            object.__setattr__(self, "_hvd_start", None)
+            object.__setattr__(self, "_hvd_count", 0)
+
+        def apply(self, grads, trainable_variables=None):
+            import tensorflow as tf
+
+            grads = list(grads)
+            tvars = trainable_variables
+            if tvars is None:
+                tvars = getattr(self, "_trainable_variables", None)
+            if tvars is None:
+                raise ValueError(
+                    "Adasum DistributedOptimizer needs the trainable "
+                    "variables: pass them to apply()/apply_gradients() "
+                    "or build the optimizer first"
+                )
+            tvars = list(tvars)
+            if k > 1 and not tf.executing_eagerly():
+                # The k-th-step combine is decided by Python-side state;
+                # baked into a trace it would silently skip ALL
+                # communication (the v1 wrapper guards the same way).
+                raise NotImplementedError(
+                    "op=Adasum with backward_passes_per_step > 1 "
+                    "requires eager execution (compile with "
+                    "run_eagerly=True), or use "
+                    "backward_passes_per_step=1"
+                )
+            # First step: start <- var (ref: __init__.py:361-364).
+            if self._hvd_start is None:
+                self._hvd_start = [
+                    tf.Variable(tf.convert_to_tensor(v), trainable=False)
+                    for v in tvars
+                ]
+            result = cls.apply(self, grads, trainable_variables)
+            self._hvd_count += 1
+            if self._hvd_count % k:
+                return result
+            deltas = [
+                tf.convert_to_tensor(v) - s
+                for v, s in zip(tvars, self._hvd_start)
+            ]
+            combined = allreduce_deltas(deltas)
+            for v, s, d in zip(tvars, self._hvd_start, combined):
+                s.assign_add(d)
+                v.assign(s)
+            return result
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            grads, tvars = zip(*list(grads_and_vars))
+            return self.apply(list(grads), list(tvars))
+
+        def get_config(self):
+            return cls.get_config(self)
+
+        @classmethod
+        def from_config(cls_, config, custom_objects=None):
+            try:
+                base = cls.from_config(config, custom_objects)
+            except TypeError:
+                base = cls.from_config(config)
+            return DistributedOptimizer(
+                base, name=name, device_dense=device_dense,
+                device_sparse=device_sparse, compression=compression,
+                sparse_as_dense=sparse_as_dense, op=ReduceOp.ADASUM,
+                backward_passes_per_step=k,
+            )
+
+    _DistributedAdasumOptimizer.__name__ = f"DistributedDelta{cls.__name__}"
+    return _DistributedAdasumOptimizer()
 
 
 def broadcast_global_variables(model_or_variables, root_rank: int = 0):

@@ -178,14 +178,22 @@ class RendezvousServer:
         """True the first time a digest is seen inside the window."""
         now = time.time()
         with self._lock:
-            if len(self._seen_digests) > 4096:
+            window = _replay_window()
+            if window <= 0:
+                # Window disabled: time-based eviction would never fire
+                # (cutoff -inf), so bound the dedup dict by count
+                # instead, evicting oldest-first. Tradeoff: an attacker
+                # who can push >64Ki PUTs between a capture and its
+                # replay defeats dedup — but timestamps are unverifiable
+                # under a disabled window anyway, and unbounded growth
+                # is a guaranteed DoS on long-lived servers.
+                while len(self._seen_digests) >= 65536:
+                    del self._seen_digests[next(iter(self._seen_digests))]
+            elif len(self._seen_digests) > 4096:
                 # Never evict inside the ACTIVE window: with a raised
-                # (or disabled, =0 -> infinite) HOROVOD_REPLAY_WINDOW,
-                # pruning at the default 300s would re-open the replay
-                # hole the dedup exists to close.
-                window = _replay_window()
-                if window <= 0:
-                    window = float("inf")
+                # HOROVOD_REPLAY_WINDOW, pruning at the default 300s
+                # would re-open the replay hole the dedup exists to
+                # close.
                 cutoff = now - max(window, REPLAY_WINDOW_S)
                 for d in [d for d, t in self._seen_digests.items()
                           if t < cutoff]:

@@ -169,9 +169,12 @@ class TorchEstimator:
     (ref: horovod/spark/torch/estimator.py:84-231).
 
     `optimizer` is a torch optimizer INSTANCE (as in the reference);
-    each worker rebuilds `type(optimizer)(model.parameters(),
-    **optimizer.defaults)` against its own module copy and wraps it in
-    `horovod_tpu.torch.DistributedOptimizer`."""
+    each worker rebuilds it against its own module copy — preserving
+    per-param-group hyperparameters (distinct lrs, weight-decay groups)
+    by mapping each group's params to their positions in
+    `model.parameters()` — and wraps it in
+    `horovod_tpu.torch.DistributedOptimizer`. The optimizer must have
+    been constructed over parameters of the `model` passed in."""
 
     def __init__(self, model, optimizer, loss, feature_cols: Sequence[str],
                  label_col: str, output_col: str = "prediction",
@@ -197,7 +200,27 @@ class TorchEstimator:
         module = self.model
         loss_fn = self.loss
         opt_cls = type(self.optimizer)
-        opt_defaults = dict(self.optimizer.defaults)
+        # Per-param-group hyperparameters survive the worker rebuild:
+        # each group is recorded as (hyperparams, positions into
+        # model.parameters()) so distinct lrs / weight-decay groups are
+        # reconstructed exactly (the reference serializes the optimizer
+        # whole, ref: horovod/spark/torch/estimator.py:84-231).
+        param_pos = {id(p): i for i, p in
+                     enumerate(self.model.parameters())}
+        opt_groups = []
+        for g in self.optimizer.param_groups:
+            try:
+                idx = [param_pos[id(p)] for p in g["params"]]
+            except KeyError:
+                raise ValueError(
+                    "TorchEstimator requires the optimizer to be "
+                    "constructed over parameters of the model passed "
+                    "in; found a param group referencing unknown "
+                    "parameters"
+                ) from None
+            opt_groups.append(
+                ({k: v for k, v in g.items() if k != "params"}, idx)
+            )
         epochs, batch_size = self.epochs, self.batch_size
         store, run_id = self.store, self.run_id
 
@@ -217,7 +240,11 @@ class TorchEstimator:
                     k: torch.from_numpy(np.asarray(v))
                     for k, v in ckpt["state_dict"].items()
                 })
-            opt = opt_cls(model.parameters(), **opt_defaults)
+            plist = list(model.parameters())
+            opt = opt_cls([
+                dict(hp, params=[plist[i] for i in idx])
+                for hp, idx in opt_groups
+            ])
             if ckpt is not None and ckpt.get("opt_state") is not None:
                 opt.load_state_dict(ckpt["opt_state"])
             hvd.broadcast_parameters(model.state_dict(), root_rank=0)

@@ -634,6 +634,7 @@ def DistributedOptimizer(
         return _make_v1_optimizer(
             optimizer, name, device_dense, device_sparse, compression,
             sparse_as_dense, op, gradient_predivide_factor,
+            backward_passes_per_step,
         )
     from ..keras import DistributedOptimizer as _keras_wrap
 
@@ -649,8 +650,16 @@ def DistributedOptimizer(
 
 def _make_v1_optimizer(optimizer, name, device_dense, device_sparse,
                        compression, sparse_as_dense, op,
-                       gradient_predivide_factor):
+                       gradient_predivide_factor,
+                       backward_passes_per_step: int = 1):
     tf = _tf()
+
+    if op == ReduceOp.ADASUM and size() > 1:
+        return _make_v1_adasum_optimizer(
+            optimizer, name, device_dense, device_sparse,
+            compression or Compression.none, sparse_as_dense,
+            int(backward_passes_per_step),
+        )
 
     allreduce_grads = _make_allreduce_grads_fn(
         name or f"Distributed{type(optimizer).__name__}", device_dense,
@@ -676,6 +685,70 @@ def _make_v1_optimizer(optimizer, name, device_dense, device_sparse,
 
     _DistributedOptimizer.__name__ = f"Distributed{type(optimizer).__name__}"
     return _DistributedOptimizer()
+
+
+def _make_v1_adasum_optimizer(optimizer, name, device_dense, device_sparse,
+                              compression, sparse_as_dense, k):
+    """Delta-model Adasum for tf.compat.v1 optimizers
+    (ref: horovod/tensorflow/__init__.py:334-428
+    _DistributedAdasumOptimizer): gradients are left local; the wrapped
+    optimizer applies its own step, and every k-th apply the weight
+    deltas since the last communication are Adasum-combined and written
+    back. Eager-mode only — the reference expresses the same schedule
+    in graph mode via `_is_comm_step` tf.cond plumbing (:356,383-386),
+    which has no meaningful equivalent under this engine's py_function
+    bridge."""
+    tf = _tf()
+
+    allreduce_deltas = _make_allreduce_grads_fn(
+        name or f"DistributedDelta{type(optimizer).__name__}", device_dense,
+        device_sparse, compression, sparse_as_dense, ReduceOp.ADASUM, 1.0,
+    )
+
+    class _V1AdasumOptimizer(type(optimizer)):
+        def __init__(self):
+            self._opt = optimizer
+            self.__dict__.update(optimizer.__dict__)
+            self._hvd_start = None
+            self._hvd_count = 0
+
+        # compute_gradients is inherited untouched: the combine happens
+        # on weight deltas, not gradients.
+
+        def apply_gradients(self, grads_and_vars, global_step=None,
+                            name=None):
+            if not tf.executing_eagerly():
+                raise NotImplementedError(
+                    "op=Adasum on the v1 optimizer surface requires "
+                    "eager execution; use the Keras optimizer wrapper "
+                    "for traced training"
+                )
+            gvs = list(grads_and_vars)
+            tvars = [v for _, v in gvs]
+            if self._hvd_start is None:
+                self._hvd_start = [
+                    tf.Variable(tf.convert_to_tensor(v), trainable=False)
+                    for v in tvars
+                ]
+            result = type(optimizer).apply_gradients(
+                self, gvs, global_step=global_step, name=name
+            )
+            self._hvd_count += 1
+            if self._hvd_count % k == 0:
+                deltas = [
+                    tf.convert_to_tensor(v) - s
+                    for v, s in zip(tvars, self._hvd_start)
+                ]
+                combined = allreduce_deltas(deltas)
+                for v, s, d in zip(tvars, self._hvd_start, combined):
+                    s.assign_add(d)
+                    v.assign(s)
+            return result
+
+    _V1AdasumOptimizer.__name__ = (
+        f"DistributedDelta{type(optimizer).__name__}"
+    )
+    return _V1AdasumOptimizer()
 
 
 def broadcast_global_variables(root_rank: int = 0):

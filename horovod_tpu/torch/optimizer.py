@@ -141,18 +141,142 @@ class _DistributedMixin:
         return self._hvd_opt_cls.zero_grad(self, *a, **kw)
 
 
+class _AdasumDeltaMixin(_DistributedMixin):
+    """Delta-model Adasum optimizer (ref: horovod/torch/optimizer.py:210-321
+    _DistributedAdasumOptimizer).
+
+    `DistributedOptimizer(op=Adasum)` is NOT a gradient allreduce in the
+    reference: each rank applies its *local* optimizer step, and the
+    resulting weight **deltas** are Adasum-combined:
+
+        start = current.copy()
+        step()                      # current = start - alpha*f(g_local)
+        delta = current - start     # the local model movement
+        delta = adasum(delta)       # scale-insensitive VHDD combine
+        current = start + delta
+
+    The hook-fired variant below mirrors the reference's per-parameter
+    pipelining: when a parameter's gradient is ready (on the boundary
+    pass), the local step runs for just that parameter, the delta is
+    launched asynchronously, and step() joins + applies start+delta.
+    With a linear optimizer (plain SGD) this coincides with gradient
+    Adasum because VHDD is degree-1 homogeneous; with momentum/Adam the
+    trajectories genuinely differ — which is why the reference
+    dispatches to a separate class rather than reusing the grad path.
+    """
+
+    def _hvd_init(self, optimizer, named_parameters, compression,
+                  backward_passes_per_step, op, prescale_factor,
+                  postscale_factor):
+        import torch
+
+        # Explicit base call: the dynamic Distributed<X> class copies
+        # these methods into its own dict, so zero-arg super() would
+        # not resolve against this mixin.
+        _DistributedMixin._hvd_init(
+            self, optimizer, named_parameters, compression,
+            backward_passes_per_step, op, prescale_factor,
+            postscale_factor)
+        # Placeholder starts; populated right before each local step
+        # (ref: optimizer.py:255-258).
+        self._starting = {
+            p: torch.zeros_like(p, requires_grad=False)
+            for p in self._names
+        }
+
+    def _allreduce_grad_async(self, p):
+        """Local step on just `p`, then launch the delta Adasum
+        (ref: optimizer.py:278-321 _allreduce_grad_async)."""
+        import horovod_tpu.torch as hvd_torch
+
+        start = self._starting[p]
+        stashed = []
+        for group in self.param_groups:
+            stashed.append(group["params"])
+            group["params"] = [p] if any(p is v for v in group["params"]) \
+                else []
+        try:
+            start.data.copy_(p.data)
+            self._hvd_opt_cls.step(self)
+            # p now holds the local delta (reuses p's memory, like the
+            # reference's p.data.sub_(start)).
+            p.data.sub_(start.data)
+            tensor, ctx = self._compression.compress(p.data)
+            handle = hvd_torch.allreduce_async(
+                tensor, name=f"delta.{self._names[p]}",
+                op=ReduceOp.ADASUM,
+            )
+        finally:
+            for st, group in zip(stashed, self.param_groups):
+                group["params"] = st
+        return handle, ctx
+
+    def synchronize(self):
+        # The join happens in step(); nothing to do here
+        # (ref: optimizer.py:341-342).
+        pass
+
+    @contextmanager
+    def skip_synchronize(self):
+        raise AssertionError(
+            "Skipping synchronization is not supported when using "
+            "Adasum optimizer."
+        )
+        yield  # pragma: no cover
+
+    def step(self, closure=None):
+        import horovod_tpu.torch as hvd_torch
+
+        loss = closure() if closure is not None else None
+        self._passes += 1
+        if self._passes % self.backward_passes_per_step != 0:
+            return loss
+        missing = [
+            p for p in self._names
+            if p.requires_grad and p.grad is not None
+            and p not in self._handles
+        ]
+        for p in missing:
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            out = hvd_torch.synchronize(handle)
+            delta = self._compression.decompress(out, ctx).reshape(p.shape)
+            start = self._starting[p]
+            # start += combined delta; current = start
+            # (ref: optimizer.py:364-368).
+            start.data.add_(delta)
+            p.data.copy_(start.data)
+        self._handles.clear()
+        return loss
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = ReduceOp.AVERAGE,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0):
-    """(ref: horovod/torch/optimizer.py:337-414)"""
+    """(ref: horovod/torch/optimizer.py:337-414; Adasum dispatch at
+    :437-445 — op=Adasum with >1 rank returns the delta-model
+    optimizer, NOT a gradient allreduce)."""
     base_cls = type(optimizer)
-    members = {
-        k: v for k, v in vars(_DistributedMixin).items()
-        if not k.startswith("__")
-    }
+    mixin = _DistributedMixin
+    if op == ReduceOp.ADASUM and _basics.size() > 1:
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            # The delta path launches the combine without scale factors;
+            # silently dropping them would change the effective update
+            # (ref: optimizer.py:431-435 predivide is Average-only).
+            raise ValueError(
+                "prescale_factor/postscale_factor are not supported "
+                "with op=Adasum"
+            )
+        mixin = _AdasumDeltaMixin
+    members = {}
+    for klass in reversed(mixin.__mro__):
+        members.update(
+            (k, v) for k, v in vars(klass).items()
+            if not k.startswith("__") and klass is not object
+        )
     cls = type(f"Distributed{base_cls.__name__}", (base_cls,), members)
 
     inst = cls.__new__(cls)

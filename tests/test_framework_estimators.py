@@ -71,6 +71,51 @@ def test_torch_estimator_two_procs(tmp_path):
     assert err < 0.4, err
 
 
+def test_torch_estimator_preserves_param_groups(tmp_path, hvd_single):
+    """Per-param-group hyperparameters survive the worker rebuild: a
+    group with lr=0 must not move while the lr>0 group trains (the
+    reference serializes the optimizer whole, preserving groups)."""
+    store = LocalStore(str(tmp_path))
+    model = torch.nn.Sequential(
+        torch.nn.Linear(1, 4), torch.nn.Linear(4, 1)
+    )
+    frozen0 = model[0].weight.detach().clone()
+    trained0 = model[1].weight.detach().clone()
+    opt = torch.optim.SGD([
+        {"params": model[0].parameters(), "lr": 0.0},
+        {"params": model[1].parameters(), "lr": 0.3},
+    ])
+    est = TorchEstimator(
+        model=model, optimizer=opt,
+        loss=lambda out, y: torch.nn.functional.mse_loss(
+            out.squeeze(-1), y),
+        feature_cols=["x"], label_col="y",
+        epochs=3, batch_size=32, store=store, run_id="pg1",
+    )
+    fitted = est.fit(_toy_df())
+    sd = fitted.model.state_dict()
+    assert torch.allclose(sd["0.weight"], frozen0), (
+        "lr=0 group moved — param-group hyperparams were dropped"
+    )
+    assert not torch.allclose(sd["1.weight"], trained0), (
+        "lr=0.3 group did not train"
+    )
+
+
+def test_torch_estimator_rejects_foreign_optimizer_params(hvd_single):
+    model = torch.nn.Linear(1, 1)
+    other = torch.nn.Linear(1, 1)
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(other.parameters(), lr=0.1),
+        loss=lambda out, y: torch.nn.functional.mse_loss(
+            out.squeeze(-1), y),
+        feature_cols=["x"], label_col="y", epochs=1,
+    )
+    with pytest.raises(ValueError, match="constructed over parameters"):
+        est.fit(_toy_df())
+
+
 def test_keras_estimator_fits_and_resumes(tmp_path, hvd_single):
     keras = pytest.importorskip("keras")
 

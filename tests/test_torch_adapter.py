@@ -256,6 +256,175 @@ def test_torch_state_and_sync_batch_norm():
     assert _two(fn) == [True, True]
 
 
+def test_adasum_delta_optimizer_matches_sequential_oracle():
+    """DistributedOptimizer(op=Adasum) must be the delta-model optimizer:
+    apply the LOCAL step, then Adasum-combine the weight deltas — not an
+    Adasum allreduce of gradients (ref: torch/optimizer.py:210-321,
+    dispatch :437-445). Oracle: local-step-then-VHDD on the same
+    weights, via adasum_numpy."""
+    def fn():
+        import copy
+
+        import numpy as np
+        import torch
+
+        import horovod_tpu.torch as hvd
+        from horovod_tpu.ops.adasum import adasum_numpy
+
+        hvd.init()
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 2)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        start = copy.deepcopy(model)      # pre-step weights
+        ref = copy.deepcopy(model)        # local-step oracle model
+
+        opt = hvd.DistributedOptimizer(
+            torch.optim.Adam(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(), op=hvd.Adasum,
+        )
+        # Delta optimizer contract: synchronize() is a no-op and
+        # skip_synchronize() is an error (ref: optimizer.py:341-346).
+        opt.synchronize()
+        try:
+            with opt.skip_synchronize():
+                pass
+            raised = False
+        except AssertionError:
+            raised = True
+        assert raised, "skip_synchronize must be an error under Adasum"
+
+        torch.manual_seed(hvd.rank() + 1)  # rank-dependent data
+        X = torch.randn(8, 4)
+        Y = torch.randn(8, 2)
+
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(model(X), Y).backward()
+        opt.step()
+
+        # Oracle: plain local Adam step with the identical data, then
+        # Adasum-combine the per-rank deltas (via allgather).
+        ref_opt = torch.optim.Adam(ref.parameters(), lr=0.05)
+        torch.nn.functional.mse_loss(ref(X), Y).backward()
+        ref_opt.step()
+        for (name, p), rp, sp in zip(
+            model.named_parameters(), ref.parameters(), start.parameters()
+        ):
+            local_delta = (rp.data - sp.data).reshape(1, -1)
+            g = hvd.allgather(local_delta)  # (world, n)
+            combined = adasum_numpy(
+                [g[i].numpy() for i in range(hvd.size())]
+            )[0]
+            expected = sp.data.numpy().reshape(-1) + combined
+            np.testing.assert_allclose(
+                p.data.numpy().reshape(-1), expected, rtol=1e-5,
+                atol=1e-6, err_msg=name,
+            )
+        return [p.detach().numpy().tolist() for p in model.parameters()]
+
+    out = _two(fn)
+    assert out[0] == out[1]  # Adasum leaves every rank with identical weights
+
+
+def test_adasum_delta_trajectory_differs_from_grad_adasum():
+    """Delta-Adasum and gradient-Adasum are different algorithms when
+    the local optimizer is nonlinear (Adam): adasum(f(g)) != f(adasum(g))
+    (ref dispatch: torch/optimizer.py:437-445). With plain SGD they
+    coincide (VHDD is degree-1 homogeneous), so Adam is the probe."""
+    def fn():
+        import copy
+
+        import torch
+
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        torch.manual_seed(3)
+        model_a = torch.nn.Linear(4, 2)
+        hvd.broadcast_parameters(model_a.state_dict(), root_rank=0)
+        model_b = copy.deepcopy(model_a)
+
+        opt_a = hvd.DistributedOptimizer(
+            torch.optim.Adam(model_a.parameters(), lr=0.05),
+            named_parameters=model_a.named_parameters(), op=hvd.Adasum,
+        )
+        opt_b = torch.optim.Adam(model_b.parameters(), lr=0.05)
+
+        torch.manual_seed(10 * (hvd.rank() + 1))
+        X = torch.randn(16, 4)
+        Y = torch.randn(16, 2)
+        for _ in range(5):
+            opt_a.zero_grad()
+            torch.nn.functional.mse_loss(model_a(X), Y).backward()
+            opt_a.step()
+
+            # Gradient-Adasum: combine grads, then local step.
+            opt_b.zero_grad()
+            torch.nn.functional.mse_loss(model_b(X), Y).backward()
+            for p in model_b.parameters():
+                p.grad.data.copy_(
+                    hvd.allreduce(p.grad, op=hvd.Adasum)
+                )
+            opt_b.step()
+
+        diff = sum(
+            float((pa.data - pb.data).abs().sum())
+            for pa, pb in zip(model_a.parameters(), model_b.parameters())
+        )
+        assert diff > 1e-4, (
+            f"delta-Adasum trajectory unexpectedly equals grad-Adasum "
+            f"(diff={diff})"
+        )
+        # Both must still be rank-consistent.
+        for m in (model_a, model_b):
+            for p in m.parameters():
+                g = hvd.allgather(p.data.reshape(1, -1))
+                assert torch.allclose(g[0], g[1], atol=1e-6)
+        return True
+
+    assert _two(fn) == [True, True]
+
+
+def test_adasum_delta_with_compression_and_accumulation():
+    """fp16 compression compresses the DELTA before the Adasum combine
+    (ref: optimizer.py:314), and backward_passes_per_step accumulates
+    grads locally between boundaries."""
+    def fn():
+        import torch
+
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        torch.manual_seed(1)
+        model = torch.nn.Linear(3, 1)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.Adam(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters(), op=hvd.Adasum,
+            compression=hvd.Compression.fp16,
+            backward_passes_per_step=2,
+        )
+        torch.manual_seed(hvd.rank())
+        X = torch.randn(8, 3)
+        Y = torch.randn(8, 1)
+        w0 = [p.detach().clone() for p in model.parameters()]
+        for i in range(4):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(X), Y).backward()
+            opt.step()
+        moved = sum(
+            float((p.data - w).abs().sum())
+            for p, w in zip(model.parameters(), w0)
+        )
+        assert moved > 1e-4
+        for p in model.parameters():
+            assert torch.isfinite(p.data).all()
+            g = hvd.allgather(p.data.reshape(1, -1))
+            assert torch.allclose(g[0], g[1], atol=1e-3)
+        return True
+
+    assert _two(fn) == [True, True]
+
+
 def test_async_handle_api_single_process(hvd_single):
     """The async handle API must work without hvdrun at size 1, like the
     reference's size-1 MPI world (ref: torch/mpi_ops.py handles) — the
