@@ -90,7 +90,7 @@ def _force_cpu(n_devices: int):
 
 
 def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
-           donate=True):
+           donate=True, model_kw=None, seq_len=None):
     import jax
     import numpy as np
     import optax
@@ -106,7 +106,7 @@ def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
     if mesh is None:
         mesh = create_mesh({"dp": n_chips})
     spec = get_model(model_name)
-    model = spec.make_model()
+    model = spec.make_model(**(model_kw or {}))
     rng = np.random.RandomState(42)
     global_batch = batch_per_chip * n_chips
     if spec.kind == "image":
@@ -118,7 +118,8 @@ def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
         tx = optax.sgd(0.01, momentum=0.9)
         has_bn = True
     else:  # lm / encoder: next-token loss over synthetic ids
-        inputs = spec.make_batch(global_batch)[0]
+        bkw = {} if seq_len is None else {"seq_len": seq_len}
+        inputs = spec.make_batch(global_batch, **bkw)[0]
         labels = inputs
         loss_fn = lm_loss
         tx = optax.adamw(1e-4)
@@ -229,6 +230,42 @@ def _measure_mfu(model, batch, peak, image_size=224, chunk=8, chunks=2):
     return dt, global_batch, mfu
 
 
+def _measure_gpt2(peak, seq=2048, batch=4, chunk=4, chunks=2):
+    """Long-sequence GPT-2 MFU headline: flash (Pallas) vs XLA dense at
+    the SAME shape, so the kernel's contribution is a printed delta
+    (ref methodology: docs/benchmarks.rst:16-43 — measure the flagship
+    at its working sequence length, not a toy one).
+
+    Model FLOPs for BOTH numbers come from the DENSE compiled step's
+    cost analysis: the two implementations compute the same math, and
+    counting the flash kernel's internal bwd recompute would inflate
+    its own MFU (standard MFU methodology charges model FLOPs only).
+    """
+    times = {}
+    flops = None
+    state = None
+    for impl in ("dense", "flash"):
+        state, step_fn, inputs, labels, _, mesh = _build(
+            "gpt2-small", 1, batch,
+            model_kw={"attn_impl": impl, "max_len": seq}, seq_len=seq,
+        )
+        scan_fn = _make_scan_step(step_fn, mesh, chunk)
+        dt, state = _time_scan(state, scan_fn, inputs, labels, chunk,
+                               chunks)
+        if impl == "dense":
+            flops = _step_flops(step_fn, state, inputs, labels)
+        times[impl] = dt
+    if not flops:
+        return None
+    return {
+        "gpt2_mfu": round((flops / times["flash"]) / peak, 4),
+        "gpt2_mfu_dense": round((flops / times["dense"]) / peak, 4),
+        "gpt2_model": "gpt2-small",
+        "gpt2_seq": seq,
+        "gpt2_flash_speedup": round(times["dense"] / times["flash"], 3),
+    }
+
+
 def _scaling_probe(n_devices: int, batch: int, image_size: int,
                    iters: int, reps: int = 1):
     """Child-process entry: time `reps` independent samples of `iters`
@@ -327,6 +364,10 @@ def main():
     p.add_argument("--no-scaling", action="store_true")
     p.add_argument("--no-transformer", action="store_true",
                    help="skip the BERT-base MFU measurement")
+    p.add_argument("--no-gpt2", action="store_true",
+                   help="skip the long-sequence GPT-2 flash/dense MFU")
+    p.add_argument("--gpt2-seq", type=int, default=2048)
+    p.add_argument("--gpt2-batch", type=int, default=4)
     p.add_argument("--scaling-reps", type=int, default=3)
     p.add_argument("--scaling-probe", type=int, default=0,
                    help="internal: run the N-device CPU scaling probe")
@@ -392,6 +433,14 @@ def main():
         except Exception:
             tr_mfu = None
 
+    gpt2 = None
+    if not (args.no_gpt2 or args.cpu):
+        try:
+            gpt2 = _measure_gpt2(peak, seq=args.gpt2_seq,
+                                 batch=args.gpt2_batch)
+        except Exception:
+            gpt2 = None
+
     scaling = spread = None
     if args.no_scaling or args.cpu:
         pass
@@ -417,6 +466,8 @@ def main():
     if tr_mfu is not None:
         result["transformer_mfu"] = round(tr_mfu, 4)
         result["transformer_model"] = "bert-base"
+    if gpt2 is not None:
+        result.update(gpt2)
     if scaling is not None:
         result["scaling_efficiency"] = round(scaling, 3)
         result["scaling_mode"] = ("weak_real" if n_chips > 1

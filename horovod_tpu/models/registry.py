@@ -41,7 +41,7 @@ def _image_batch(hw: int, channels: int = 3):
 
 
 def _token_batch(seq_len: int, vocab: int):
-    def make(batch_size: int, seed: int = 0):
+    def make(batch_size: int, seed: int = 0, seq_len: int = seq_len):
         rng = np.random.RandomState(seed)
         return (rng.randint(0, vocab, size=(batch_size, seq_len),
                             dtype=np.int32),)

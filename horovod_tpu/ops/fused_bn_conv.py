@@ -71,7 +71,12 @@ def fused_bn_relu_matmul(
 
     def kernel(x_ref, mu_ref, var_ref, gamma_ref, beta_ref, w_ref,
                y_ref, s1_ref, s2_ref):
-        i = pl.program_id(0)
+        # Grid is (j, i) with the accumulation dim i INNERMOST: Pallas
+        # TPU only preserves a revisited output block (s1/s2 depend on
+        # j alone) across *consecutive* grid steps, so the reduction
+        # dim must be minor — with i outermost the stats would be
+        # silently wrong on real TPU whenever Cout > block_n.
+        i = pl.program_id(1)
         xf = x_ref[...].astype(jnp.float32)
         rs = jax.lax.rsqrt(var_ref[...] + eps)
         a = jnp.maximum(
@@ -93,22 +98,22 @@ def fused_bn_relu_matmul(
             s1_ref[...] += part1
             s2_ref[...] += part2
 
-    grid = (n_i, Cout // block_n)
+    grid = (Cout // block_n, n_i)
     y, s1, s2 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_m, Cin), lambda i, j: (i, 0)),
-            pl.BlockSpec((Cin,), lambda i, j: (0,)),
-            pl.BlockSpec((Cin,), lambda i, j: (0,)),
-            pl.BlockSpec((Cin,), lambda i, j: (0,)),
-            pl.BlockSpec((Cin,), lambda i, j: (0,)),
-            pl.BlockSpec((Cin, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((block_m, Cin), lambda j, i: (i, 0)),
+            pl.BlockSpec((Cin,), lambda j, i: (0,)),
+            pl.BlockSpec((Cin,), lambda j, i: (0,)),
+            pl.BlockSpec((Cin,), lambda j, i: (0,)),
+            pl.BlockSpec((Cin,), lambda j, i: (0,)),
+            pl.BlockSpec((Cin, block_n), lambda j, i: (0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_n), lambda i, j: (0, j)),
-            pl.BlockSpec((1, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((block_m, block_n), lambda j, i: (i, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((M, Cout), x.dtype),

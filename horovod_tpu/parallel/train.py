@@ -51,10 +51,17 @@ jax.tree_util.register_pytree_node(
 
 
 def softmax_xent(logits, labels) -> jax.Array:
-    """Mean cross-entropy; logits fp32 (softmax numerics on TPU)."""
+    """Mean cross-entropy; logits fp32 (softmax numerics on TPU).
+
+    Label log-probs are picked with take_along_axis rather than a
+    one-hot inner product: at LM vocab sizes the dense one-hot is a
+    (B, S, V) float32 materialization (1.6 GB for GPT-2 at B*S=8k) of
+    pure HBM traffic that the gather avoids."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
-    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+    ll = jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1
+    )
+    return -jnp.mean(ll)
 
 
 def lm_loss(logits, ids) -> jax.Array:

@@ -510,6 +510,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssh-port", type=int, default=None)
     p.add_argument("--ssh-identity-file", default=None)
     p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print which frameworks and backends this "
+                   "build supports, then exit "
+                   "(ref: horovodrun --check-build)")
     p.add_argument("--disable-output-prefix", action="store_true",
                    help="don't prefix worker output with [rank]<>")
     # Elastic (ref: launch.py elastic flags)
@@ -561,9 +565,64 @@ def _apply_config_file(parser: argparse.ArgumentParser, args):
             setattr(args, dest, val)
 
 
+def check_build() -> str:
+    """Render the framework/backend availability report
+    (ref: horovod/runner/launch.py:106-141 check_build — the reference
+    prints which extensions and collective backends were compiled in;
+    here frameworks are importability probes and backends come from
+    common.basics introspection)."""
+    import importlib.util
+
+    from .. import __version__
+    from ..common import basics
+
+    def chk(v) -> str:
+        return "X" if v else " "
+
+    def has(mod: str) -> bool:
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            return False
+
+    def native_built() -> bool:
+        try:
+            from ..cc import native
+
+            return native.available()
+        except Exception:
+            return False
+
+    return (
+        f"Horovod-TPU v{__version__}:\n"
+        "\n"
+        "Available Frameworks:\n"
+        f"    [{chk(has('jax'))}] JAX\n"
+        f"    [{chk(has('tensorflow'))}] TensorFlow\n"
+        f"    [{chk(has('torch'))}] PyTorch\n"
+        f"    [{chk(has('mxnet'))}] MXNet\n"
+        f"    [{chk(has('keras'))}] Keras\n"
+        "\n"
+        "Available Controllers:\n"
+        f"    [{chk(basics.tcp_built())}] TCP (Gloo equivalent)\n"
+        f"    [{chk(basics.mpi_built())}] MPI\n"
+        "\n"
+        "Available Tensor Operations:\n"
+        f"    [{chk(basics.xla_built())}] XLA collectives (ICI/DCN)\n"
+        f"    [{chk(basics.tcp_built())}] TCP star/ring/hier-ring\n"
+        f"    [{chk(native_built())}] Native C++ reduction kernels\n"
+        f"    [{chk(basics.nccl_built())}] NCCL\n"
+        f"    [{chk(basics.ddl_built())}] DDL\n"
+        f"    [{chk(basics.ccl_built())}] CCL\n"
+    )
+
+
 def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
+    if args.check_build:
+        print(check_build())
+        return 0
     if args.config_file:
         _apply_config_file(parser, args)
     command = list(args.command)

@@ -117,6 +117,40 @@ def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
     return op
 
 
+_SINGLETON_WARN_THRESHOLD = 8
+_trace_singleton_counts: dict = {}
+
+
+def _warn_singleton_collectives_in_trace():
+    """N singleton collectives inside ONE tf.function each become their
+    own stateful py_function, which TF2's auto-control-dependencies
+    chain in program order — N serialized engine cycles. Only the
+    grouped path escapes (see grouped_allreduce). Warn once per trace
+    when a function crosses the threshold, pointing users there
+    (docs/tensorflow.md: "The singleton-collective trap")."""
+    tf = _tf()
+    if tf.executing_eagerly():
+        return
+    try:
+        g = id(tf.compat.v1.get_default_graph())
+    except Exception:
+        return
+    n = _trace_singleton_counts.get(g, 0) + 1
+    _trace_singleton_counts[g] = n
+    if n == _SINGLETON_WARN_THRESHOLD:
+        import warnings
+
+        warnings.warn(
+            f"{n}+ singleton horovod collectives traced inside one "
+            "tf.function: each becomes a stateful py_function that "
+            "TF2 auto-control-deps serialize (one engine cycle per "
+            "tensor). Use hvd.grouped_allreduce / "
+            "DistributedGradientTape / DistributedOptimizer, which "
+            "negotiate the whole list in a single cycle.",
+            stacklevel=3,
+        )
+
+
 def _eager_or_py_function(numpy_fn, tensor, out_dtype, out_shape, name):
     """Run `numpy_fn` on the tensor's value: directly when eager,
     through tf.py_function when tracing (the reference's AsyncOpKernel
@@ -124,6 +158,7 @@ def _eager_or_py_function(numpy_fn, tensor, out_dtype, out_shape, name):
     tf = _tf()
     if tf.executing_eagerly():
         return tf.convert_to_tensor(numpy_fn(tensor.numpy()), dtype=out_dtype)
+    _warn_singleton_collectives_in_trace()
     out = tf.py_function(
         lambda t: tf.convert_to_tensor(numpy_fn(t.numpy()), dtype=out_dtype),
         inp=[tensor],

@@ -29,6 +29,28 @@ assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu"
 import pytest
 
 
+def pytest_sessionstart(session):
+    """Offline-descope tripwire: this environment cannot install
+    pyspark (no network), so tests/test_spark.py validates against a
+    barrier-semantics mock and README documents the descope. The moment
+    this repo lands somewhere pyspark IS importable, that caveat must
+    turn into a red test — not a silently stale claim. (mxnet needs no
+    tripwire: its tests importorskip and auto-unskip against the real
+    package.) Set HOROVOD_REAL_SPARK_VALIDATED=1 once real-Spark runs
+    are wired to acknowledge."""
+    import importlib.util
+
+    if (importlib.util.find_spec("pyspark") is not None
+            and not os.environ.get("HOROVOD_REAL_SPARK_VALIDATED")):
+        raise pytest.UsageError(
+            "pyspark is importable, but tests/test_spark.py and "
+            "tests/test_framework_estimators.py still validate against "
+            "the mock barrier layer only. Run the estimators/runner "
+            "against real Spark and set HOROVOD_REAL_SPARK_VALIDATED=1 "
+            "(see README 'offline descopes')."
+        )
+
+
 @pytest.fixture
 def hvd_mesh():
     """Fresh mesh-mode init for a test, torn down after."""

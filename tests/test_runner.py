@@ -247,3 +247,26 @@ def test_ssh_command_keeps_secret_off_cmdline():
     argv2 = build_ssh_command("hostA", ["python", "train.py"],
                               {"HOROVOD_RANK": "3"})
     assert "read -r" not in " ".join(argv2)
+
+
+def test_check_build_golden():
+    """hvdrun --check-build prints the availability report and exits 0
+    (ref: horovod/runner/launch.py:106-149,225 — horovodrun -cb)."""
+    from horovod_tpu.runner.launch import check_build, run_commandline
+
+    out = check_build()
+    # Structure: three sections, reference-style checkbox rows.
+    for section in ("Available Frameworks:", "Available Controllers:",
+                    "Available Tensor Operations:"):
+        assert section in out, out
+    # This build always ships the JAX/XLA path and the TCP controller.
+    assert "[X] JAX" in out
+    assert "[X] TCP (Gloo equivalent)" in out
+    assert "[X] XLA collectives (ICI/DCN)" in out
+    # Backends that do not exist by design are reported absent.
+    assert "[ ] NCCL" in out
+    assert "[ ] DDL" in out
+    assert "[ ] CCL" in out
+    assert "[ ] MPI" in out
+    # CLI: --check-build works without -np or a command.
+    assert run_commandline(["--check-build"]) == 0

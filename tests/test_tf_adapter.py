@@ -479,3 +479,49 @@ def test_keras_load_model_rewraps_optimizer(tmp_path, hvd_single):
                                model.predict(x, verbose=0),
                                rtol=1e-5, atol=1e-6)
     assert type(loaded.optimizer).__name__.startswith("Distributed")
+
+
+def test_singleton_collectives_in_trace_warn():
+    """>=8 singleton collectives traced inside ONE tf.function warn and
+    point at grouped_allreduce (each becomes its own stateful
+    py_function serialized by auto-control-deps — see
+    docs/tensorflow.md); the grouped path must NOT warn."""
+    def fn():
+        import warnings
+
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+
+        ts = [tf.ones([2]) * i for i in range(8)]
+
+        @tf.function
+        def many(xs):
+            return [hvd.allreduce(x, name=f"w{i}")
+                    for i, x in enumerate(xs)]
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            many.get_concrete_function(ts)
+        msgs = [str(w.message) for w in rec]
+        assert any("grouped_allreduce" in m for m in msgs), msgs
+
+        @tf.function
+        def grouped(xs):
+            return hvd.grouped_allreduce(xs, name="g")
+
+        with warnings.catch_warnings(record=True) as rec2:
+            warnings.simplefilter("always")
+            grouped.get_concrete_function(ts)
+        msgs2 = [str(w.message) for w in rec2
+                 if "grouped_allreduce" in str(w.message)]
+        assert not msgs2, msgs2
+        # Both ranks must still drain the traced singletons they built
+        # (the concrete functions were traced, not run — nothing to
+        # drain; a final barrier keeps shutdown clean).
+        hvd.barrier()
+        return True
+
+    assert _two(fn) == [True, True]
