@@ -230,7 +230,7 @@ def _measure_mfu(model, batch, peak, image_size=224, chunk=8, chunks=2):
     return dt, global_batch, mfu
 
 
-def _measure_gpt2(peak, seq=2048, batch=4, chunk=4, chunks=2):
+def _measure_gpt2(peak, seq=2048, batch=4, chunk=12, chunks=1):
     """Long-sequence GPT-2 MFU headline: flash (Pallas) vs XLA dense at
     the SAME shape, so the kernel's contribution is a printed delta
     (ref methodology: docs/benchmarks.rst:16-43 — measure the flagship
@@ -243,7 +243,6 @@ def _measure_gpt2(peak, seq=2048, batch=4, chunk=4, chunks=2):
     """
     times = {}
     flops = None
-    state = None
     for impl in ("dense", "flash"):
         state, step_fn, inputs, labels, _, mesh = _build(
             "gpt2-small", 1, batch,
@@ -255,6 +254,10 @@ def _measure_gpt2(peak, seq=2048, batch=4, chunk=4, chunks=2):
         if impl == "dense":
             flops = _step_flops(step_fn, state, inputs, labels)
         times[impl] = dt
+        # Release this impl's train state before building the next one:
+        # two full param+AdamW states resident at once can OOM shapes
+        # each impl fits individually.
+        del state, step_fn, scan_fn, inputs, labels
     if not flops:
         return None
     return {

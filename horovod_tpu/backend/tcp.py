@@ -89,7 +89,14 @@ class TcpBackend(RingCollectivesMixin):
         listener.bind(("0.0.0.0", 0))
         listener.listen(self.size)
         my_port = listener.getsockname()[1]
-        my_host = os.environ.get(env_cfg.HOSTNAME) or "127.0.0.1"
+        # HOROVOD_MESH_ADDR separates the ADVERTISED address from the
+        # slot identity: Spark-task slots carry logical hostnames
+        # ("sparktaskN") that no resolver knows, so the executor-side
+        # spawner pins the real address here (HOROVOD_HOSTNAME must
+        # stay logical — spawn_identity and the elastic registry key
+        # on it).
+        my_host = (os.environ.get("HOROVOD_MESH_ADDR")
+                   or os.environ.get(env_cfg.HOSTNAME) or "127.0.0.1")
         if os.environ.get("HVDRUN_FORCE_LOCAL") or my_host in (
             "localhost", "") or my_host.startswith("process-"):
             my_host = "127.0.0.1"

@@ -1,0 +1,245 @@
+"""Mid-job elastic rescale for Spark (ref: horovod/spark/runner.py:303
+run_elastic + horovod/spark/driver/driver_service.py +
+host_discovery.SparkDriverHostDiscovery).
+
+The reference runs an elastic driver whose "hosts" are live Spark tasks:
+each task registers with a driver service, the elastic driver execs
+workers through the tasks, and Spark's task respawn supplies recovery.
+The TPU port keeps that split but speaks the rendezvous KV instead of a
+bespoke RPC:
+
+  driver process                         spark task (executor)
+  --------------                         ---------------------
+  ElasticDriver                          _elastic_task_loop():
+    SparkTaskDiscovery <- heartbeats  <-   heartbeat spark_task_alive/<host>
+    create_worker() -> spawn cmd     ->    poll spark_cmd/<host>/<seq>
+    SparkProcHandle.poll/wait <- status <- spawn/kill local subprocess,
+                                           report spark_proc/<id>
+
+Every object a task touches goes through the HTTP rendezvous client, so
+the protocol is identical whether the task is a thread (the offline mock
+barrier layer) or a real remote executor.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, Optional
+
+from ..runner.elastic.discovery import HostDiscovery
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+HEARTBEAT_INTERVAL = 0.3
+HEARTBEAT_STALE = 3.0
+
+_WORKER_MAIN = """\
+import os, pickle, sys
+with open(sys.argv[1], "rb") as f:
+    fn = pickle.loads(f.read())
+result = fn()
+from horovod_tpu.backend.rendezvous import RendezvousClient
+from horovod_tpu.utils import env as env_cfg
+rank = int(os.environ["HOROVOD_RANK"])
+c = RendezvousClient(env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR),
+                     env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0))
+c.put("spark_results", str(rank), pickle.dumps(result))
+"""
+
+
+# ---------------------------------------------------------------------------
+# Driver side
+
+
+class SparkTaskDiscovery(HostDiscovery):
+    """Hosts = Spark tasks with a fresh heartbeat (ref:
+    host_discovery.SparkDriverHostDiscovery — the task registry IS the
+    discovery source; no script, no NIC probing)."""
+
+    def __init__(self, server, max_np: int):
+        self._server = server
+        self._max_np = max_np
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        now = time.time()
+        hosts: Dict[str, int] = {}
+        for i in range(self._max_np):
+            blob = self._server.handle_get(f"spark_task_alive/sparktask{i}")
+            if blob is None:
+                continue
+            try:
+                ts = float(blob.decode())
+            except ValueError:
+                continue
+            if now - ts <= HEARTBEAT_STALE:
+                hosts[f"sparktask{i}"] = 1
+        return hosts
+
+
+class SparkProcHandle:
+    """Popen-shaped proxy for a worker subprocess living inside a Spark
+    task; state rides the KV (the reference's task-service RPC client,
+    ref: horovod/runner/common/service/task_service.py)."""
+
+    def __init__(self, server, proc_id: str):
+        self._server = server
+        self._id = proc_id
+
+    def poll(self) -> Optional[int]:
+        blob = self._server.handle_get(f"spark_proc/{self._id}")
+        if blob is None or blob == b"running":
+            return None
+        return int(blob.decode())
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(self._id, timeout)
+            time.sleep(0.1)
+
+    def _post_kill(self):
+        self._server.handle_put(f"spark_kill/{self._id}", b"1")
+
+    def terminate(self):
+        self._post_kill()
+
+    def kill(self):
+        self._post_kill()
+
+
+class SparkExecDriver:
+    """Driver-side command fan-out: one monotonically numbered command
+    stream per task host."""
+
+    def __init__(self, server):
+        self._server = server
+        self._seq: Dict[str, int] = {}
+        self._n = 0
+
+    def spawn(self, hostname: str, env: Dict[str, str],
+              run_id: str) -> SparkProcHandle:
+        self._n += 1
+        proc_id = f"{run_id}.{self._n}"
+        seq = self._seq.get(hostname, 0)
+        self._seq[hostname] = seq + 1
+        cmd = pickle.dumps({"proc_id": proc_id, "env": env})
+        self._server.handle_put(f"spark_cmd/{hostname}/{seq}", cmd)
+        # Cursor handoff: a RESPAWNED task (Spark retry, same partition
+        # index) must not replay stale spawn commands — it starts its
+        # poll at the recorded head instead of 0.
+        self._server.handle_put(f"spark_cmd_head/{hostname}",
+                                str(seq + 1).encode())
+        return SparkProcHandle(self._server, proc_id)
+
+    def shutdown(self):
+        self._server.handle_put("spark/shutdown", b"1")
+
+
+# ---------------------------------------------------------------------------
+# Task side (runs inside the Spark executor; KV access over HTTP only)
+
+
+def _elastic_task_loop(index: int, driver_addr: str, driver_port: int):
+    """Register, heartbeat, and execute spawn/kill commands until the
+    driver announces shutdown (ref: horovod/spark/task/task_service.py
+    run-command loop)."""
+    from ..backend.rendezvous import RendezvousClient
+
+    host = f"sparktask{index}"
+    client = RendezvousClient(driver_addr, driver_port, timeout=300.0)
+
+    # Fetch the payload once; workers read it from a task-local file.
+    payload = client.wait_get("spark_payload", "fn")
+    tmpdir = tempfile.mkdtemp(prefix=f"hvd-spark-{index}-")
+    payload_path = os.path.join(tmpdir, "payload.pkl")
+    with open(payload_path, "wb") as f:
+        f.write(payload)
+    main_path = os.path.join(tmpdir, "worker_main.py")
+    with open(main_path, "w") as f:
+        f.write(_WORKER_MAIN)
+
+    # Cursor handoff BEFORE the first heartbeat: commands issued to a
+    # dead predecessor of this partition index are stale and must not
+    # be replayed (ghost workers with old-epoch env). Reading the head
+    # before announcing liveness guarantees any spawn addressed to THIS
+    # incarnation has seq >= head (the driver only targets hosts with
+    # fresh heartbeats).
+    head = client.get("spark_cmd_head", host)
+    seq = int(head.decode()) if head is not None else 0
+
+    # The slot hostname ("sparktaskN") is a logical identity; the TCP
+    # data mesh needs this executor's REAL routable address. The
+    # UDP-connect trick finds the interface that reaches the driver
+    # (gethostbyname(gethostname()) is 127.0.1.1 on stock Debian).
+    import socket as _socket
+
+    try:
+        probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        probe.connect((driver_addr, driver_port or 1))
+        mesh_addr = probe.getsockname()[0]
+        probe.close()
+    except OSError:
+        mesh_addr = "127.0.0.1"
+
+    procs: Dict[str, subprocess.Popen] = {}
+    last_beat = -1.0
+    while True:
+        now = time.time()
+        slow_tick = now - last_beat >= HEARTBEAT_INTERVAL
+        if slow_tick:
+            client.put("spark_task_alive", host, str(now).encode())
+            last_beat = now
+            # Shutdown/kill ride the heartbeat cadence: per-iteration
+            # polling would hammer the single rendezvous server with
+            # thousands of requests/second at large max_np.
+            if client.get("spark", "shutdown") is not None:
+                break
+
+        blob = client.get("spark_cmd", f"{host}/{seq}")
+        if blob is not None:
+            seq += 1
+            cmd = pickle.loads(blob)
+            proc_id, wenv = cmd["proc_id"], cmd["env"]
+            env = dict(os.environ)
+            env.update(wenv)
+            env.setdefault("HOROVOD_MESH_ADDR", mesh_addr)
+            env["PYTHONPATH"] = os.pathsep.join(
+                [p for p in sys.path if p] +
+                [env.get("PYTHONPATH", "")]
+            ).rstrip(os.pathsep)
+            p = subprocess.Popen(
+                [sys.executable, main_path, payload_path], env=env
+            )
+            procs[proc_id] = p
+            client.put("spark_proc", proc_id, b"running")
+
+        for proc_id, p in list(procs.items()):
+            if slow_tick and client.get("spark_kill",
+                                        proc_id) is not None:
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            rc = p.poll()
+            if rc is not None:
+                client.put("spark_proc", proc_id, str(rc).encode())
+                del procs[proc_id]
+
+        time.sleep(0.05)
+
+    for p in procs.values():
+        if p.poll() is None:
+            p.terminate()
+    return index

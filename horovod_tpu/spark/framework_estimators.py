@@ -42,80 +42,169 @@ def _prepare_data(store: Store, df) -> str:
     return path
 
 
-def _collect(df, feature_cols, label_col):
+def _collect(df, cols):
     pdf = df.toPandas() if hasattr(df, "toPandas") else df
-    x = np.stack([pdf[c].to_numpy() for c in feature_cols],
-                 axis=-1).astype(np.float32)
-    return x, pdf[label_col].to_numpy()
+    return {c: pdf[c].to_numpy() for c in cols}
+
+
+_VAL_SEED = 0x5EED
+
+
+def _chunk_val_mask(validation, chunk_index: int, pdf, n: int):
+    """Validation mask for one streamed chunk. A column name selects
+    truthy rows; a float fraction uses a per-chunk deterministic RNG
+    (seeded by the chunk's position in the deterministic stream order,
+    so the split is IDENTICAL every epoch — ref:
+    horovod/spark/common/params.py `validation`)."""
+    if validation is None:
+        return np.zeros(n, dtype=bool)
+    if isinstance(validation, str):
+        return pdf[validation].to_numpy().astype(bool)
+    return (np.random.RandomState(_VAL_SEED + chunk_index).rand(n)
+            < float(validation))
 
 
 def _shard_batches(store, data_path, feature_cols, label_col, batch_size,
-                   epoch, rank, size):
+                   epoch, rank, size, validation=None,
+                   sample_weight_col=None, subset="train"):
     """Stream exactly-batch_size (plus one final ragged) batches of one
     worker's shard with a buffer-local shuffle; memory bounded by ~5x
-    batch_size rows (see JaxEstimator.fit for the same construction)."""
+    batch_size rows (see JaxEstimator.fit for the same construction).
+    Yields (x, y, w) with w None when no sample_weight_col."""
     cols = list(feature_cols) + [label_col]
+    if sample_weight_col:
+        cols.append(sample_weight_col)
+    if isinstance(validation, str):
+        cols.append(validation)
     rng = np.random.RandomState(epoch)
     bufs: List = []
     have = 0
-    for pdf in store.iter_parquet_batches(
+
+    def flush():
+        X = np.concatenate([b[0] for b in bufs])
+        Y = np.concatenate([b[1] for b in bufs])
+        W = (np.concatenate([b[2] for b in bufs])
+             if sample_weight_col else None)
+        return X, Y, W
+
+    for ci, pdf in enumerate(store.iter_parquet_batches(
             data_path, columns=cols, shard_rank=rank, shard_size=size,
-            batch_rows=max(batch_size * 4, 1024)):
+            batch_rows=max(batch_size * 4, 1024))):
+        n = len(pdf)
+        vmask = _chunk_val_mask(validation, ci, pdf, n)
+        keep = vmask if subset == "val" else ~vmask
+        if not keep.any():
+            continue
         bx = np.stack([pdf[c].to_numpy() for c in feature_cols],
-                      axis=-1).astype(np.float32)
-        by = pdf[label_col].to_numpy()
+                      axis=-1).astype(np.float32)[keep]
+        by = pdf[label_col].to_numpy()[keep]
+        bw = (pdf[sample_weight_col].to_numpy().astype(np.float32)[keep]
+              if sample_weight_col else None)
         perm = rng.permutation(len(by))
-        bufs.append((bx[perm], by[perm]))
+        bufs.append((bx[perm], by[perm],
+                     bw[perm] if bw is not None else None))
         have += len(by)
         while have >= batch_size:
-            X = np.concatenate([b for b, _ in bufs])
-            Y = np.concatenate([b for _, b in bufs])
-            yield X[:batch_size], Y[:batch_size]
-            bufs = [(X[batch_size:], Y[batch_size:])]
+            X, Y, W = flush()
+            yield (X[:batch_size], Y[:batch_size],
+                   W[:batch_size] if W is not None else None)
+            bufs = [(X[batch_size:], Y[batch_size:],
+                     W[batch_size:] if W is not None else None)]
             have -= batch_size
     if have:
-        yield (np.concatenate([b for b, _ in bufs]),
-               np.concatenate([b for _, b in bufs]))
+        yield flush()
 
 
-def _memory_batches(x, y, batch_size, epoch, steps):
+def _memory_batches(x, y, w, batch_size, epoch, steps):
     perm = np.random.RandomState(epoch).permutation(len(y))
     for i in range(max(steps, 1)):
         idx = perm[i * batch_size:(i + 1) * batch_size]
-        yield x[idx], y[idx]
+        yield x[idx], y[idx], (w[idx] if w is not None else None)
 
 
 class _DataPlan:
     """Worker-side view of the training data: streaming from the store
-    when one is configured, in-closure arrays otherwise."""
+    when one is configured, in-closure arrays otherwise. Handles the
+    train/validation split (float fraction or indicator column) and the
+    optional sample-weight column (ref:
+    horovod/spark/common/params.py:30-106 validation /
+    sample_weight_col)."""
 
     def __init__(self, est, df):
         self.store = est.store
-        if self.store is not None:
-            self.data_path = _prepare_data(self.store, df)
-            self.data_fp = self.store.dataset_fingerprint(df)
-            self.x = self.y = None
-        else:
-            self.x, self.y = _collect(df, est.feature_cols, est.label_col)
-            self.data_path = self.data_fp = None
         self.feature_cols = est.feature_cols
         self.label_col = est.label_col
         self.batch_size = est.batch_size
+        self.validation = getattr(est, "validation", None)
+        self.sample_weight_col = getattr(est, "sample_weight_col", None)
+        if (self.validation is not None
+                and not isinstance(self.validation, str)):
+            f = float(self.validation)
+            if not 0.0 < f < 1.0:
+                raise ValueError(
+                    f"validation fraction must be in (0, 1), got {f}")
+        if self.store is not None:
+            self.data_path = _prepare_data(self.store, df)
+            self.data_fp = self.store.dataset_fingerprint(df)
+            self.cols = None
+        else:
+            cols = list(self.feature_cols) + [self.label_col]
+            if self.sample_weight_col:
+                cols.append(self.sample_weight_col)
+            if isinstance(self.validation, str):
+                cols.append(self.validation)
+            self.cols = _collect(df, cols)
+            self.data_path = self.data_fp = None
 
     # everything below runs inside the worker --------------------------
-    def local_rows(self, rank, size) -> int:
-        if self.store is not None:
-            return self.store.shard_num_rows(self.data_path, rank, size)
-        return len(range(rank, len(self.y), size))
+    def _memory_arrays(self, rank, size, subset):
+        y_all = self.cols[self.label_col]
+        n = len(y_all)
+        if self.validation is None:
+            vmask = np.zeros(n, dtype=bool)
+        elif isinstance(self.validation, str):
+            vmask = self.cols[self.validation].astype(bool)
+        else:
+            vmask = (np.random.RandomState(_VAL_SEED).rand(n)
+                     < float(self.validation))
+        keep = vmask if subset == "val" else ~vmask
+        x = np.stack([self.cols[c] for c in self.feature_cols],
+                     axis=-1).astype(np.float32)[keep]
+        y = y_all[keep]
+        w = (self.cols[self.sample_weight_col].astype(np.float32)[keep]
+             if self.sample_weight_col else None)
+        sl = slice(rank, None, size)
+        return x[sl], y[sl], (w[sl] if w is not None else None)
 
-    def batches(self, epoch, rank, size):
+    def local_rows(self, rank, size, subset="train") -> int:
+        if self.store is None:
+            return len(self._memory_arrays(rank, size, subset)[1])
+        if self.validation is None and subset == "train":
+            return self.store.shard_num_rows(self.data_path, rank, size)
+        # Subset counts need a mask pass; read only the cheap columns.
+        cols = [self.validation] if isinstance(self.validation, str) \
+            else [self.label_col]
+        count = 0
+        for ci, pdf in enumerate(self.store.iter_parquet_batches(
+                self.data_path, columns=cols, shard_rank=rank,
+                shard_size=size, batch_rows=max(self.batch_size * 4,
+                                                1024))):
+            vmask = _chunk_val_mask(self.validation, ci, pdf, len(pdf))
+            count += int(vmask.sum() if subset == "val"
+                         else (~vmask).sum())
+        return count
+
+    def batches(self, epoch, rank, size, subset="train"):
+        """Yields (x, y, w); w is None without a sample_weight_col."""
         if self.store is not None:
             return _shard_batches(
                 self.store, self.data_path, self.feature_cols,
-                self.label_col, self.batch_size, epoch, rank, size)
-        xs, ys = self.x[rank::size], self.y[rank::size]
-        steps = max(len(ys) // self.batch_size, 1)
-        return _memory_batches(xs, ys, self.batch_size, epoch, steps)
+                self.label_col, self.batch_size, epoch, rank, size,
+                validation=self.validation,
+                sample_weight_col=self.sample_weight_col, subset=subset)
+        x, y, w = self._memory_arrays(rank, size, subset)
+        steps = max(len(y) // self.batch_size, 1)
+        return _memory_batches(x, y, w, self.batch_size, epoch, steps)
 
 
 def _agreed_steps(hvd_mod, n_rows_local: int, batch_size: int) -> int:
@@ -180,7 +269,8 @@ class TorchEstimator:
                  label_col: str, output_col: str = "prediction",
                  num_proc: Optional[int] = None, epochs: int = 1,
                  batch_size: int = 32, store: Optional[Store] = None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None, validation=None,
+                 sample_weight_col: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -192,6 +282,14 @@ class TorchEstimator:
         self.batch_size = batch_size
         self.store = store
         self.run_id = run_id or f"torch-estimator-{uuid.uuid4().hex[:8]}"
+        # validation: float fraction in (0,1) for a deterministic split,
+        # or a column name whose truthy rows are the validation set
+        # (ref: horovod/spark/common/params.py:30-106). When
+        # sample_weight_col is set, `loss` must return PER-SAMPLE losses
+        # (shape [batch]); the estimator applies the weights and takes
+        # the mean, matching Keras sample_weight semantics.
+        self.validation = validation
+        self.sample_weight_col = sample_weight_col
 
     def fit(self, df) -> TorchModel:
         # Closure captures PLAIN locals (not `self`): the worker payload
@@ -252,23 +350,66 @@ class TorchEstimator:
             opt = hvd.DistributedOptimizer(
                 opt, named_parameters=model.named_parameters())
 
+            def to_target(by):
+                target = torch.from_numpy(np.asarray(by))
+                if target.is_floating_point():
+                    # pandas float columns default to float64;
+                    # torch losses want the model's float32.
+                    target = target.float()
+                return target
+
+            def batch_loss(bx, by, bw):
+                loss = loss_fn(model(torch.from_numpy(bx)), to_target(by))
+                if bw is not None:
+                    if loss.dim() == 0:
+                        raise ValueError(
+                            "sample_weight_col requires `loss` to return "
+                            "per-sample losses (shape [batch]); got a "
+                            "scalar"
+                        )
+                    loss = (loss * torch.from_numpy(bw)).mean()
+                elif loss.dim() > 0:
+                    loss = loss.mean()
+                return loss
+
+            def rank_mean(v: float) -> float:
+                # Per-epoch metric averaged across ranks (the
+                # MetricAverageCallback semantics).
+                return float(hvd.allreduce(
+                    torch.tensor([v], dtype=torch.float64),
+                    name="est_metric"))
+
             steps = _agreed_steps(hvd, plan.local_rows(rank, size),
                                   batch_size)
+            val_steps = _agreed_steps(
+                hvd, plan.local_rows(rank, size, "val"), batch_size
+            ) if plan.validation is not None else 0
+            history = {"loss": []}
+            if val_steps:
+                history["val_loss"] = []
             for epoch in range(start_epoch, epochs):
                 model.train()
                 it = plan.batches(epoch, rank, size)
+                ep_loss = 0.0
                 for _ in range(steps):
-                    bx, by = next(it)
+                    bx, by, bw = next(it)
                     opt.zero_grad()
-                    out = model(torch.from_numpy(bx))
-                    target = torch.from_numpy(np.asarray(by))
-                    if target.is_floating_point():
-                        # pandas float columns default to float64;
-                        # torch losses want the model's float32.
-                        target = target.float()
-                    loss = loss_fn(out, target)
+                    loss = batch_loss(bx, by, bw)
                     loss.backward()
                     opt.step()
+                    ep_loss += float(loss.detach())
+                history["loss"].append(
+                    rank_mean(ep_loss / max(steps, 1)))
+                if val_steps:
+                    model.eval()
+                    vit = plan.batches(epoch, rank, size, subset="val")
+                    v_loss = 0.0
+                    with torch.no_grad():
+                        for _ in range(val_steps):
+                            vx, vy, vw = next(vit)
+                            v_loss += float(batch_loss(vx, vy, vw))
+                    history["val_loss"].append(
+                        rank_mean(v_loss / val_steps))
                 if store is not None and rank == 0:
                     store.save_checkpoint(run_id, {
                         "state_dict": {
@@ -279,18 +420,20 @@ class TorchEstimator:
                         "epoch": epoch,
                         "data_fp": plan.data_fp,
                     }, epoch=epoch)
-            return {k: v.detach().cpu().numpy()
-                    for k, v in model.state_dict().items()}
+            return ({k: v.detach().cpu().numpy()
+                     for k, v in model.state_dict().items()}, history)
 
-        state_dict = _run_workers(train, self.num_proc, df)[0]
+        state_dict, history = _run_workers(train, self.num_proc, df)[0]
         import torch
 
         self.model.load_state_dict({
             k: torch.from_numpy(np.asarray(v))
             for k, v in state_dict.items()
         })
-        return TorchModel(self.model, self.feature_cols, self.label_col,
-                          self.output_col)
+        fitted = TorchModel(self.model, self.feature_cols, self.label_col,
+                            self.output_col)
+        fitted.history = history
+        return fitted
 
 
 # ---------------------------------------------------------------------------
@@ -348,7 +491,8 @@ class KerasEstimator:
                  label_col: str, output_col: str = "prediction",
                  num_proc: Optional[int] = None, epochs: int = 1,
                  batch_size: int = 32, store: Optional[Store] = None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None, validation=None,
+                 sample_weight_col: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -360,6 +504,11 @@ class KerasEstimator:
         self.batch_size = batch_size
         self.store = store
         self.run_id = run_id or f"keras-estimator-{uuid.uuid4().hex[:8]}"
+        # Same semantics as TorchEstimator; weights flow through Keras's
+        # native train_on_batch(sample_weight=...) path
+        # (ref: horovod/spark/common/params.py:30-106).
+        self.validation = validation
+        self.sample_weight_col = sample_weight_col
 
     def fit(self, df) -> KerasModel:
         import keras
@@ -394,13 +543,44 @@ class KerasEstimator:
             model.compile(optimizer=opt, loss=loss)
             hvd.broadcast_global_variables(model, root_rank=0)
 
+            def rank_mean(v: float) -> float:
+                import tensorflow as tf
+
+                return float(hvd.allreduce(
+                    tf.constant([v], dtype=tf.float64),
+                    name="est_metric").numpy()[0])
+
+            def scalar_loss(res) -> float:
+                # train/test_on_batch returns a scalar or [loss, *metrics]
+                return float(np.asarray(res).reshape(-1)[0])
+
             steps = _agreed_steps(hvd, plan.local_rows(rank, size),
                                   batch_size)
+            val_steps = _agreed_steps(
+                hvd, plan.local_rows(rank, size, "val"), batch_size
+            ) if plan.validation is not None else 0
+            history = {"loss": []}
+            if val_steps:
+                history["val_loss"] = []
             for epoch in range(start_epoch, epochs):
                 it = plan.batches(epoch, rank, size)
+                ep_loss = 0.0
                 for _ in range(steps):
-                    bx, by = next(it)
-                    model.train_on_batch(bx, np.asarray(by))
+                    bx, by, bw = next(it)
+                    res = model.train_on_batch(
+                        bx, np.asarray(by), sample_weight=bw)
+                    ep_loss += scalar_loss(res)
+                history["loss"].append(
+                    rank_mean(ep_loss / max(steps, 1)))
+                if val_steps:
+                    vit = plan.batches(epoch, rank, size, subset="val")
+                    v_loss = 0.0
+                    for _ in range(val_steps):
+                        vx, vy, vw = next(vit)
+                        v_loss += scalar_loss(model.test_on_batch(
+                            vx, np.asarray(vy), sample_weight=vw))
+                    history["val_loss"].append(
+                        rank_mean(v_loss / val_steps))
                 if store is not None and rank == 0:
                     store.save_checkpoint(run_id, {
                         "weights": [np.asarray(w)
@@ -408,12 +588,15 @@ class KerasEstimator:
                         "epoch": epoch,
                         "data_fp": plan.data_fp,
                     }, epoch=epoch)
-            return [np.asarray(w) for w in model.get_weights()]
+            return ([np.asarray(w) for w in model.get_weights()],
+                    history)
 
-        weights = _run_workers(train, self.num_proc, df)[0]
+        weights, history = _run_workers(train, self.num_proc, df)[0]
         self.model.set_weights([np.asarray(w) for w in weights])
-        return KerasModel(self.model, self.feature_cols, self.label_col,
-                          self.output_col)
+        fitted = KerasModel(self.model, self.feature_cols, self.label_col,
+                            self.output_col)
+        fitted.history = history
+        return fitted
 
 
 # ---------------------------------------------------------------------------

@@ -18,10 +18,14 @@ from __future__ import annotations
 import os
 import pickle
 import socket
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ..runner.hosts import HostInfo, get_host_assignments
 from ..runner.rendezvous_server import RendezvousServer
+from ..utils.logging import get_logger
+
+logger = get_logger()
 from ..utils import env as env_cfg
 
 
@@ -82,14 +86,22 @@ def _assign_ranks(server: RendezvousServer, num_proc: int):
         )
 
 
-def _run_spark_job(sc, num_proc: int, mapper):
+def _run_spark_job(sc, num_proc: int, mapper, barrier: bool = True):
     """Execute mapper over num_proc partitions, barrier-mode when the
-    cluster supports it (ref: spark/runner.py barrier usage)."""
+    cluster supports it (ref: spark/runner.py barrier usage).
+
+    The ELASTIC path passes barrier=False: a barrier stage gang-
+    schedules (no task starts until all max_np fit, defeating the
+    min_np window) and aborts every task on a single death (defeating
+    shrink-and-continue). The reference's run_elastic likewise runs a
+    plain stage."""
     rdd = sc.parallelize(range(num_proc), num_proc)
-    try:
-        return rdd.barrier().mapPartitionsWithIndex(mapper).collect()
-    except AttributeError:  # pre-2.4 or mock without barrier
-        return rdd.mapPartitionsWithIndex(mapper).collect()
+    if barrier:
+        try:
+            return rdd.barrier().mapPartitionsWithIndex(mapper).collect()
+        except AttributeError:  # pre-2.4 or mock without barrier
+            pass
+    return rdd.mapPartitionsWithIndex(mapper).collect()
 
 
 def run(
@@ -175,34 +187,155 @@ def run(
         server.stop()
 
 
-def run_elastic(fn, args=(), kwargs=None, num_proc=None,
-                min_np=None, max_np=None, **extra):
-    """Elastic variant (ref: spark/runner.py:303). Spark's task-retry
-    model supplies the respawn; state handling uses hvd.elastic in the
-    task fn. Currently delegates to run() with Spark-level retries —
-    there is no mid-job rescale, so a min_np/max_np window is not
-    honored and we say so rather than silently dropping it."""
-    import inspect
-    import warnings
+def run_elastic(
+    fn: Callable[[], Any],
+    args=(),
+    kwargs=None,
+    num_proc: Optional[int] = None,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    extra_env: Optional[Dict[str, str]] = None,
+    reset_limit: Optional[int] = None,
+    verbose: int = 1,
+    spark_context=None,
+    start_timeout: float = 600.0,
+) -> List[Any]:
+    """Elastic training over Spark tasks with a live min_np..max_np
+    window (ref: horovod/spark/runner.py:303-404).
 
-    if (min_np is not None and min_np != num_proc) or (
-        max_np is not None and max_np != num_proc
-    ):
-        warnings.warn(
-            "horovod_tpu.spark.run_elastic runs at a fixed num_proc via "
-            "Spark task retries; min_np/max_np rescaling is not "
-            "supported and will be ignored",
-            stacklevel=2,
-        )
-    # Forward everything run() itself accepts (spark_context, env, ...);
-    # warn only about genuinely unsupported arguments.
-    accepted = set(inspect.signature(run).parameters)
-    passthrough = {k: v for k, v in extra.items() if k in accepted}
-    unknown = sorted(set(extra) - accepted)
-    if unknown:
-        warnings.warn(
-            f"run_elastic ignoring unsupported arguments: {unknown}",
-            stacklevel=2,
-        )
-    return run(fn, args=args, kwargs=kwargs, num_proc=num_proc,
-               **passthrough)
+    `max_np` Spark tasks are launched (a plain, NON-barrier stage:
+    tasks start as the cluster can schedule them, so the job begins as
+    soon as `min_np` are live); each runs a task-service loop
+    (`spark/elastic.py`) that heartbeats and executes worker
+    spawn/kill commands from the in-driver ElasticDriver. A task dying
+    mid-job shrinks the world (down to `min_np`); a task (re)appearing
+    grows it — with `hvd.elastic.run` + State inside `fn` carrying
+    training through each reset, exactly like host-discovery elastic
+    under `hvdrun`. Results are per-rank values from the FINAL topology,
+    rank order.
+
+    `num_proc` is only the default for an unset min_np/max_np (the
+    reference reads dynamic-allocation bounds the same way,
+    ref: spark/runner.py:355-360); the window is what governs."""
+    import functools
+    import threading
+
+    try:
+        import cloudpickle as pickler
+    except ImportError:
+        pickler = pickle
+
+    from ..runner.elastic.driver import ElasticDriver
+    from ..runner.launch import slot_env
+    from .elastic import SparkExecDriver, SparkTaskDiscovery, \
+        _elastic_task_loop
+
+    sc = spark_context
+    if sc is None:
+        try:
+            from pyspark import SparkContext
+
+            sc = SparkContext._active_spark_context
+        except ImportError as e:
+            raise ImportError(
+                "horovod_tpu.spark.run_elastic needs pyspark (or pass "
+                "spark_context=)"
+            ) from e
+        if sc is None:
+            raise ValueError("no active SparkContext")
+    if num_proc is None:
+        num_proc = sc.defaultParallelism
+    min_np = min_np if min_np is not None else num_proc
+    max_np = max_np if max_np is not None else num_proc
+
+    payload = pickler.dumps(functools.partial(fn, *args, **(kwargs or {})))
+    server = RendezvousServer()
+    port = server.start()
+    addr = _driver_addr()
+    server.handle_put("spark_payload/fn", payload)
+
+    env = dict(extra_env or {})
+    if "JAX_PLATFORMS" not in env:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+    exec_driver = SparkExecDriver(server)
+    run_id = uuid.uuid4().hex[:8]
+
+    def create_worker(slot, extra):
+        wenv = slot_env(slot, addr, port, dict(env), elastic=True)
+        wenv.update(extra)
+        wenv["HOROVOD_CYCLE_TIME"] = os.environ.get(
+            "HOROVOD_CYCLE_TIME", "1")
+        # SparkProcHandle is Popen-shaped (poll/wait/terminate/kill),
+        # which is all ElasticDriver requires of a worker proc.
+        return exec_driver.spawn(slot.hostname, wenv, run_id)
+
+    driver = ElasticDriver(
+        server, SparkTaskDiscovery(server, max_np), min_np, max_np,
+        reset_limit=reset_limit,
+    )
+
+    # Launch max_np Spark tasks running the service loop, in a thread
+    # (collect() blocks until shutdown).
+    def mapper(index, iterator):
+        yield _elastic_task_loop(index, addr, port)
+
+    spark_err: List[BaseException] = []
+
+    def spark_job():
+        try:
+            _run_spark_job(sc, max_np, mapper, barrier=False)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            spark_err.append(e)
+
+    spark_thread = threading.Thread(target=spark_job, daemon=True)
+    spark_thread.start()
+
+    def wait_checking_spark(timeout: float):
+        """driver.wait, but a Spark-side failure surfaces IMMEDIATELY
+        instead of being masked behind the full elastic timeout."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            code = driver.wait(timeout=5.0)
+            if code is not None:
+                return code
+            if spark_err and not driver.finished:
+                raise spark_err[0]
+            if _time.monotonic() > deadline:
+                return None
+
+    try:
+        if verbose >= 1:
+            logger.info(
+                "spark elastic: launching %d task services "
+                "(window %d..%d)", max_np, min_np, max_np)
+        driver.wait_for_available_slots(min_np, timeout=start_timeout)
+        driver.start(create_worker)
+        code = wait_checking_spark(timeout=start_timeout * 4)
+        if code is None:
+            raise RuntimeError("elastic spark job timed out")
+        if code != 0:
+            raise RuntimeError(
+                f"elastic spark job failed with exit code {code}"
+            )
+        results = []
+        r = 0
+        while True:
+            blob = server.handle_get(f"spark_results/{r}")
+            if blob is None:
+                break
+            results.append(pickle.loads(blob))
+            r += 1
+        if not results:
+            raise RuntimeError("no ranks produced results")
+        if spark_err:
+            raise spark_err[0]
+        return results
+    finally:
+        driver.stop()
+        exec_driver.shutdown()
+        spark_thread.join(timeout=30)
+        server.stop()

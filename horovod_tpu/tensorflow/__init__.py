@@ -118,7 +118,6 @@ def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
 
 
 _SINGLETON_WARN_THRESHOLD = 8
-_trace_singleton_counts: dict = {}
 
 
 def _warn_singleton_collectives_in_trace():
@@ -127,16 +126,21 @@ def _warn_singleton_collectives_in_trace():
     chain in program order — N serialized engine cycles. Only the
     grouped path escapes (see grouped_allreduce). Warn once per trace
     when a function crosses the threshold, pointing users there
-    (docs/tensorflow.md: "The singleton-collective trap")."""
+    (docs/frameworks.md: "The singleton-collective trap"). The counter
+    lives ON the FuncGraph (not a module dict keyed by id()): it dies
+    with the graph, and a recycled id can't inherit a stale count."""
     tf = _tf()
     if tf.executing_eagerly():
         return
     try:
-        g = id(tf.compat.v1.get_default_graph())
+        g = tf.compat.v1.get_default_graph()
     except Exception:
         return
-    n = _trace_singleton_counts.get(g, 0) + 1
-    _trace_singleton_counts[g] = n
+    n = getattr(g, "_hvd_singleton_collectives", 0) + 1
+    try:
+        g._hvd_singleton_collectives = n
+    except AttributeError:
+        return
     if n == _SINGLETON_WARN_THRESHOLD:
         import warnings
 

@@ -71,6 +71,111 @@ def test_torch_estimator_two_procs(tmp_path):
     assert err < 0.4, err
 
 
+def test_torch_estimator_validation_and_sample_weight(tmp_path):
+    """validation (float split) + sample_weight_col across 2 real
+    workers: fit returns a history dict with train+val loss series,
+    both averaged across ranks, and weights skew training toward the
+    heavily-weighted rows (ref: horovod/spark/common/params.py:30-106)."""
+    store = LocalStore(str(tmp_path))
+    n = 256
+    x = np.random.RandomState(0).rand(n).astype(np.float32)
+    # Two clusters with different targets; weight one cluster 2000x.
+    # (Keras sample_weight semantics: loss = mean(per_sample * w), so
+    # the weights scale the effective lr — keep w*lr stable.)
+    y = np.where(x < 0.5, 1.0, 3.0).astype(np.float32)
+    w = np.where(x < 0.5, 20.0, 0.01).astype(np.float32)
+    df = pd.DataFrame({"x": x, "y": y, "wt": w})
+
+    model = torch.nn.Linear(1, 1, bias=False)
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.02),
+        # Per-sample losses, as the sample_weight_col contract requires.
+        loss=lambda out, t: (out.squeeze(-1) - t) ** 2,
+        feature_cols=["x"], label_col="y",
+        epochs=15, batch_size=32, store=store, run_id="vw1",
+        num_proc=2, validation=0.25, sample_weight_col="wt",
+    )
+    fitted = est.fit(df)
+    h = fitted.history
+    assert set(h) == {"loss", "val_loss"}
+    assert len(h["loss"]) == 15 and len(h["val_loss"]) == 15
+    assert h["loss"][-1] < h["loss"][0], h["loss"]
+    assert all(np.isfinite(v) for v in h["val_loss"])
+    # With cluster A weighted 100x vs 0.01x, the single weight must land
+    # near A's mean target region, not the unweighted blend.
+    wgt = float(fitted.model.weight.detach().ravel()[0])
+    pred_a = wgt * 0.25   # a typical cluster-A input
+    assert abs(pred_a - 1.0) < 1.0, (wgt, pred_a)
+
+
+def test_torch_estimator_validation_column(hvd_single):
+    """validation as an indicator COLUMN: val rows are exactly the
+    truthy ones and never train (train on y=2x, validate on y=0 rows —
+    val loss must stay far from train loss)."""
+    n = 128
+    rng = np.random.RandomState(1)
+    x = rng.rand(n).astype(np.float32)
+    is_val = (np.arange(n) % 4 == 0)
+    y = np.where(is_val, 0.0, 2.0 * x).astype(np.float32)
+    df = pd.DataFrame({"x": x, "y": y, "isval": is_val.astype(np.int64)})
+
+    model = torch.nn.Linear(1, 1, bias=False)
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.5),
+        loss=lambda out, t: torch.nn.functional.mse_loss(
+            out.squeeze(-1), t),
+        feature_cols=["x"], label_col="y",
+        epochs=15, batch_size=32, validation="isval",
+    )
+    fitted = est.fit(df)
+    h = fitted.history
+    assert h["loss"][-1] < 0.05, h["loss"]       # fits y=2x well
+    assert h["val_loss"][-1] > 0.2, h["val_loss"]  # val rows are y=0
+
+
+def test_torch_estimator_weight_requires_per_sample_loss(hvd_single):
+    df = _toy_df(64)
+    df["wt"] = 1.0
+    model = torch.nn.Linear(1, 1)
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+        loss=lambda out, t: torch.nn.functional.mse_loss(
+            out.squeeze(-1), t),  # scalar loss: invalid with weights
+        feature_cols=["x"], label_col="y", epochs=1,
+        sample_weight_col="wt",
+    )
+    with pytest.raises(ValueError, match="per-sample"):
+        est.fit(df)
+
+
+def test_keras_estimator_validation_and_weights(tmp_path, hvd_single):
+    keras = pytest.importorskip("keras")
+
+    store = LocalStore(str(tmp_path))
+    df = _toy_df(192)
+    df["wt"] = np.ones(len(df), np.float32)
+
+    model = keras.Sequential(
+        [keras.Input((1,)), keras.layers.Dense(1, use_bias=False)]
+    )
+    est = KerasEstimator(
+        model=model, optimizer=keras.optimizers.SGD(0.3),
+        loss="mse", feature_cols=["x"], label_col="y",
+        epochs=8, batch_size=32, store=store, run_id="kv1",
+        validation=0.2, sample_weight_col="wt",
+    )
+    fitted = est.fit(df)
+    h = fitted.history
+    assert set(h) == {"loss", "val_loss"}
+    assert len(h["loss"]) == 8
+    assert h["loss"][-1] < h["loss"][0], h["loss"]
+    # Unit weights must not break convergence toward y = 3x + 1.
+    assert h["val_loss"][-1] < h["val_loss"][0], h["val_loss"]
+
+
 def test_torch_estimator_preserves_param_groups(tmp_path, hvd_single):
     """Per-param-group hyperparameters survive the worker rebuild: a
     group with lr=0 must not move while the lr>0 group trains (the

@@ -121,3 +121,140 @@ def test_jax_estimator_local_pandas():
     err = float(np.mean((np.asarray(list(out["prediction"])) -
                          df["y"].to_numpy()) ** 2))
     assert err < 0.05, err
+
+
+# ---------------------------------------------------------------------------
+# Mid-job elastic rescale (ref: horovod/spark/runner.py:303 run_elastic)
+
+_ELASTIC_TRAIN_SRC = r"""
+def _elastic_train():
+    import os
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic.state import ObjectState
+
+    hvd.init()
+    state = ObjectState(batch=0, history=[], w=np.zeros(2, np.float32))
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < 25:
+            kill_at = os.environ.get("TEST_KILL_AT")
+            sent = os.environ.get("TEST_KILL_SENTINEL")
+            if (kill_at and hvd.size() == 2 and hvd.rank() == 1
+                    and state.batch >= int(kill_at)
+                    and not os.path.exists(sent)):
+                open(sent, "w").close()
+                os._exit(1)
+            g = hvd.allreduce(np.ones(2, np.float32), name="g")
+            state.w = state.w + np.asarray(g)  # deterministic "training"
+            state.history.append((hvd.rank(), hvd.size()))
+            state.batch += 1
+            state.commit()
+            gate = os.environ.get("TEST_GATE_FILE")
+            if gate and state.batch >= 3 and not os.path.exists(gate):
+                open(gate, "w").close()
+            time.sleep(0.05)
+        return list(state.history), state.w.tolist()
+
+    return train(state)
+"""
+exec(_ELASTIC_TRAIN_SRC)
+
+
+class GatedFakeRDD(FakeRDD):
+    """Partition 0 starts immediately; partition i>0 waits for a gate
+    file — the mock's stand-in for Spark dynamic allocation bringing a
+    task up mid-job."""
+
+    def __init__(self, n, gate_file):
+        super().__init__(n)
+        self._gate = gate_file
+
+    def collect(self):
+        import time as _t
+
+        results = [None] * self.n
+        errors = [None] * self.n
+
+        def worker(i):
+            if i > 0:
+                deadline = _t.monotonic() + 60
+                while not os.path.exists(self._gate):
+                    if _t.monotonic() > deadline:
+                        errors[i] = TimeoutError("gate never opened")
+                        return
+                    _t.sleep(0.1)
+            try:
+                results[i] = list(self._f(i, iter([i])))
+            except BaseException as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(self.n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for e in errors:
+            if e is not None:
+                raise e
+        return [r for part in results if part for r in part]
+
+
+def test_spark_run_elastic_shrinks_on_task_death(monkeypatch, tmp_path):
+    """np=2 job; the rank-1 worker dies mid-fit. The elastic driver must
+    blacklist its slot, reset at np=1, and hvd.elastic state must carry:
+    the survivor finishes all 25 batches with its accumulated state
+    intact (ref: horovod/spark/runner.py:303 — rescale via respawn)."""
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "1")
+    monkeypatch.setenv("HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "0.25")
+    from horovod_tpu.spark import run_elastic
+
+    sentinel = tmp_path / "killed_once"
+    out = run_elastic(
+        _elastic_train, num_proc=2, min_np=1, max_np=2,
+        spark_context=FakeSparkContext(),
+        extra_env={
+            "TEST_KILL_AT": "4",
+            "TEST_KILL_SENTINEL": str(sentinel),
+        },
+    )
+    assert sentinel.exists()  # the death really happened
+    hist, w = out[0]
+    sizes = [s for _, s in hist]
+    assert 2 in sizes and sizes[-1] == 1, sizes  # shrank mid-job
+    assert len(hist) >= 25, len(hist)  # state carried through the reset
+    # Every batch added allreduce(ones) (AVERAGE -> ones) to w exactly
+    # once per committed batch: restores must not double-count.
+    assert w == [float(len(hist))] * 2, (w, len(hist))
+
+
+def test_spark_run_elastic_grows_when_task_appears(monkeypatch, tmp_path):
+    """min_np=1: the job starts with one live task while the second is
+    delayed; when it appears the driver must rescale UP mid-job and
+    finish at np=2 with both ranks returning results."""
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "1")
+    monkeypatch.setenv("HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "0.25")
+    from horovod_tpu.spark import run_elastic
+
+    gate = tmp_path / "gate"
+
+    class Ctx(FakeSparkContext):
+        def parallelize(self, data, n):
+            return GatedFakeRDD(n, str(gate))
+
+    out = run_elastic(
+        _elastic_train, num_proc=2, min_np=1, max_np=2,
+        spark_context=Ctx(),
+        extra_env={"TEST_GATE_FILE": str(gate)},
+    )
+    assert len(out) == 2, len(out)  # final topology np=2, both posted
+    hist, _ = out[0]
+    sizes = [s for _, s in hist]
+    assert 1 in sizes and 2 in sizes, sizes  # grew mid-job
+    assert sizes[-1] == 2, sizes
+    assert len(hist) >= 25
