@@ -148,6 +148,11 @@ def _prep(q, k, v, mask, block_q: int):
     MUST use identical block/pad arithmetic for the saved lse residual
     to line up with the backward's blocks."""
     B, S, H, D = q.shape
+    if block_q is None:
+        # Measured on v5e (B4 H12 D64, fwd+bwd, in-jit loops): 128 wins
+        # at S<=2048; 512 is ~22% faster at S=4096 (fewer grid steps,
+        # better k/v reuse, and causal skipping grows coarser anyway).
+        block_q = DEFAULT_BLOCK_Q if S <= 2048 else 512
     bq = min(block_q, S)
     bk = min(DEFAULT_BLOCK_K, S)
     pad_q = (-S) % bq
@@ -405,13 +410,16 @@ def _flash_bwd(q, k, v, mask, out, lse, g, causal: bool, block_q: int,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention(q, k, v, mask=None, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_q: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Fused attention. q/k/v: (B, S, H, D); mask: optional (B, S) key
     validity (1 = attend). Returns (B, S, H, D) in q.dtype.
 
-    `interpret=None` auto-selects: compiled Pallas on TPU, interpreter
-    elsewhere (so CPU tests and the 8-device virtual mesh still run)."""
+    `block_q=None` auto-selects by sequence length (128 for S<=2048,
+    512 beyond — measured fwd+bwd crossover on v5e); both vjp passes
+    resolve it identically in `_prep`. `interpret=None` auto-selects:
+    compiled Pallas on TPU, interpreter elsewhere (so CPU tests and the
+    8-device virtual mesh still run)."""
     if not HAVE_PALLAS:
         raise ImportError(
             "flash_attention needs jax.experimental.pallas; use "
