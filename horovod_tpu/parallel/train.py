@@ -53,15 +53,14 @@ jax.tree_util.register_pytree_node(
 def softmax_xent(logits, labels) -> jax.Array:
     """Mean cross-entropy; logits fp32 (softmax numerics on TPU).
 
-    Label log-probs are picked with take_along_axis rather than a
-    one-hot inner product: at LM vocab sizes the dense one-hot is a
-    (B, S, V) float32 materialization (1.6 GB for GPT-2 at B*S=8k) of
-    pure HBM traffic that the gather avoids."""
+    The one-hot inner product is deliberate: XLA fuses one_hot into
+    the reduction (a compare-select epilogue — the (B,S,V) one-hot is
+    never materialized), while take_along_axis lowers to a TPU gather
+    that measures 12-20% SLOWER on the loss at both BERT and GPT-2
+    bench shapes (v5e, fwd+bwd in-jit loops, r4)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(
-        logp, labels[..., None].astype(jnp.int32), axis=-1
-    )
-    return -jnp.mean(ll)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
 
 
 def lm_loss(logits, ids) -> jax.Array:
