@@ -1,0 +1,50 @@
+"""Mid-job elastic training on Spark (ref: horovod/spark/runner.py:303).
+
+`run_elastic` launches max_np Spark tasks as a task-service fleet; the
+in-driver elastic driver spawns/kills workers through them, so the job
+starts as soon as min_np tasks are live, shrinks when a task dies, and
+grows when one (re)appears — with `hvd.elastic` state carrying training
+through every reset. See docs/spark.md.
+
+Run inside a PySpark session:
+
+    python examples/spark_elastic.py
+"""
+import numpy as np
+
+
+def train():
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic.state import JaxState
+
+    hvd.init()
+    state = JaxState(params=np.zeros(4, np.float32), batch=0)
+
+    X = np.arange(32.0, dtype=np.float32).reshape(8, 4) / 32.0
+    Y = X @ np.array([1.0, 2.0, -1.0, 0.5], np.float32)
+
+    @hvd.elastic.run
+    def loop(state):
+        while state.batch < 200:
+            # toy gradient step; real jobs jit this (see
+            # tests/test_elastic_integration.py GSPMD worker)
+            g = 2 * X.T @ (X @ state.params - Y) / len(Y)
+            g = hvd.allreduce(g, name="g")
+            state.params = state.params - 0.3 * np.asarray(g)
+            state.batch += 1
+            state.commit()
+        return state.params
+
+    params = loop(state)
+    return hvd.rank(), params.tolist()
+
+
+def main():
+    import horovod_tpu.spark as hvd_spark
+
+    results = hvd_spark.run_elastic(train, num_proc=2, min_np=1, max_np=4)
+    print("per-rank results:", results)
+
+
+if __name__ == "__main__":
+    main()
