@@ -492,7 +492,8 @@ class KerasEstimator:
                  num_proc: Optional[int] = None, epochs: int = 1,
                  batch_size: int = 32, store: Optional[Store] = None,
                  run_id: Optional[str] = None, validation=None,
-                 sample_weight_col: Optional[str] = None):
+                 sample_weight_col: Optional[str] = None,
+                 metrics: Optional[Sequence] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -506,9 +507,13 @@ class KerasEstimator:
         self.run_id = run_id or f"keras-estimator-{uuid.uuid4().hex[:8]}"
         # Same semantics as TorchEstimator; weights flow through Keras's
         # native train_on_batch(sample_weight=...) path
-        # (ref: horovod/spark/common/params.py:30-106).
+        # (ref: horovod/spark/common/params.py:30-106). `metrics` are
+        # Keras metric identifiers compiled into the worker model; each
+        # appears in history as its own rank-averaged per-epoch series
+        # (ref: keras/estimator.py `metrics` param).
         self.validation = validation
         self.sample_weight_col = sample_weight_col
+        self.metrics = list(metrics) if metrics else None
 
     def fit(self, df) -> KerasModel:
         import keras
@@ -521,6 +526,7 @@ class KerasEstimator:
         model_blob = _serialize_keras_model(self.model)
         opt_cfg = keras.optimizers.serialize(self.optimizer)
         loss = self.loss
+        metrics = self.metrics
         epochs, batch_size = self.epochs, self.batch_size
         store, run_id = self.store, self.run_id
 
@@ -540,8 +546,21 @@ class KerasEstimator:
                                    for w in ckpt["weights"]])
             opt = hvd.DistributedOptimizer(
                 keras.optimizers.deserialize(opt_cfg))
-            model.compile(optimizer=opt, loss=loss)
+            model.compile(optimizer=opt, loss=loss, metrics=metrics)
             hvd.broadcast_global_variables(model, root_rank=0)
+
+            # Series names: loss first, then the user's metrics in
+            # declaration order (train_on_batch's return layout).
+            # Derived from the estimator's `metrics` list, not
+            # model.metrics_names — Keras 3 reports the container name
+            # "compile_metrics" there, not the metric identifiers.
+            def series_names(prefix=""):
+                names = ["loss"] + [
+                    m if isinstance(m, str)
+                    else getattr(m, "name", str(m))
+                    for m in (metrics or [])
+                ]
+                return [prefix + n for n in names]
 
             def rank_mean(v: float) -> float:
                 import tensorflow as tf
@@ -550,37 +569,39 @@ class KerasEstimator:
                     tf.constant([v], dtype=tf.float64),
                     name="est_metric").numpy()[0])
 
-            def scalar_loss(res) -> float:
+            def as_vector(res) -> np.ndarray:
                 # train/test_on_batch returns a scalar or [loss, *metrics]
-                return float(np.asarray(res).reshape(-1)[0])
+                return np.asarray(res, dtype=np.float64).reshape(-1)
 
             steps = _agreed_steps(hvd, plan.local_rows(rank, size),
                                   batch_size)
             val_steps = _agreed_steps(
                 hvd, plan.local_rows(rank, size, "val"), batch_size
             ) if plan.validation is not None else 0
-            history = {"loss": []}
-            if val_steps:
-                history["val_loss"] = []
+            history: dict = {}
             for epoch in range(start_epoch, epochs):
                 it = plan.batches(epoch, rank, size)
-                ep_loss = 0.0
+                ep = None
                 for _ in range(steps):
                     bx, by, bw = next(it)
-                    res = model.train_on_batch(
-                        bx, np.asarray(by), sample_weight=bw)
-                    ep_loss += scalar_loss(res)
-                history["loss"].append(
-                    rank_mean(ep_loss / max(steps, 1)))
+                    res = as_vector(model.train_on_batch(
+                        bx, np.asarray(by), sample_weight=bw))
+                    ep = res if ep is None else ep + res
+                for name, v in zip(series_names(),
+                                   (ep if ep is not None else [0.0])):
+                    history.setdefault(name, []).append(
+                        rank_mean(float(v) / max(steps, 1)))
                 if val_steps:
                     vit = plan.batches(epoch, rank, size, subset="val")
-                    v_loss = 0.0
+                    vp = None
                     for _ in range(val_steps):
                         vx, vy, vw = next(vit)
-                        v_loss += scalar_loss(model.test_on_batch(
+                        res = as_vector(model.test_on_batch(
                             vx, np.asarray(vy), sample_weight=vw))
-                    history["val_loss"].append(
-                        rank_mean(v_loss / val_steps))
+                        vp = res if vp is None else vp + res
+                    for name, v in zip(series_names("val_"), vp):
+                        history.setdefault(name, []).append(
+                            rank_mean(float(v) / val_steps))
                 if store is not None and rank == 0:
                     store.save_checkpoint(run_id, {
                         "weights": [np.asarray(w)

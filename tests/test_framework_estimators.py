@@ -166,14 +166,18 @@ def test_keras_estimator_validation_and_weights(tmp_path, hvd_single):
         loss="mse", feature_cols=["x"], label_col="y",
         epochs=8, batch_size=32, store=store, run_id="kv1",
         validation=0.2, sample_weight_col="wt",
+        metrics=["mae"],
     )
     fitted = est.fit(df)
     h = fitted.history
-    assert set(h) == {"loss", "val_loss"}
-    assert len(h["loss"]) == 8
+    assert set(h) == {"loss", "val_loss", "mae", "val_mae"}, set(h)
+    assert len(h["loss"]) == 8 and len(h["val_mae"]) == 8
     assert h["loss"][-1] < h["loss"][0], h["loss"]
     # Unit weights must not break convergence toward y = 3x + 1.
     assert h["val_loss"][-1] < h["val_loss"][0], h["val_loss"]
+    # Compiled metric improves alongside the loss and stays finite.
+    assert h["mae"][-1] < h["mae"][0], h["mae"]
+    assert all(np.isfinite(v) for v in h["val_mae"])
 
 
 def test_torch_estimator_preserves_param_groups(tmp_path, hvd_single):
