@@ -525,3 +525,57 @@ def test_singleton_collectives_in_trace_warn():
         return True
 
     assert _two(fn) == [True, True]
+
+
+def test_keras_adasum_fit_traced_k1():
+    """Adasum wrapper inside a TRACED model.fit (no run_eagerly): with
+    backward_passes_per_step=1 the combine has no Python-side schedule
+    to bake, so the graph path must train and keep ranks identical;
+    k>1 without eager must raise instead of silently skipping comms."""
+    def fn():
+        import keras
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu.keras as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        keras.utils.set_random_seed(9)
+
+        model = keras.Sequential(
+            [keras.Input((4,)), keras.layers.Dense(1, use_bias=False)]
+        )
+        opt = hvd.DistributedOptimizer(
+            keras.optimizers.SGD(0.05), op=hvd.Adasum)
+        model.compile(optimizer=opt, loss="mse")  # traced train_step
+        rng = np.random.RandomState(r)
+        X = rng.randn(32, 4).astype(np.float32)
+        Y = (X @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32))
+        cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0)]
+        h = model.fit(X, Y, epochs=4, batch_size=16, verbose=0,
+                      callbacks=cbs)
+        assert h.history["loss"][-1] < h.history["loss"][0]
+        w = model.get_weights()[0].ravel()
+        gathered = hvd.allgather(tf.constant(w[None, :])).numpy()
+        assert np.allclose(gathered[0], gathered[1], atol=1e-5), gathered
+
+        # k>1 under tracing must refuse loudly.
+        m2 = keras.Sequential(
+            [keras.Input((4,)), keras.layers.Dense(1)]
+        )
+        o2 = hvd.DistributedOptimizer(
+            keras.optimizers.SGD(0.05), op=hvd.Adasum,
+            backward_passes_per_step=2)
+        m2.compile(optimizer=o2, loss="mse")
+        try:
+            m2.fit(X, Y, epochs=1, batch_size=16, verbose=0)
+            raised = False
+        except NotImplementedError:
+            raised = True
+        except Exception as e:  # keras may wrap it — require OUR guard
+            raised = "backward_passes_per_step" in str(e)
+        assert raised, "traced k>1 Adasum must not silently skip comms"
+        return True
+
+    assert _two(fn) == [True, True]
