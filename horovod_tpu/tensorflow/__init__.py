@@ -556,6 +556,34 @@ def synchronize(handle: int):
     return _tf().convert_to_tensor(np.asarray(out), dtype=dtype)
 
 
+def _dynamic_int_op(fn, name: str):
+    """An int op whose value is read at EXECUTION time, not trace time
+    (ref: tensorflow/mpi_ops.py rank_op/size_op — the reference's
+    kernels read the controller's current value so a traced function
+    sees post-elastic-reset topology)."""
+    tf = _tf()
+    out = tf.py_function(lambda: np.int32(fn()), inp=[], Tout=tf.int32,
+                         name=name)
+    out.set_shape(())
+    return out
+
+
+def rank_op(name=None):
+    return _dynamic_int_op(_basics.rank, name or "HorovodRank")
+
+
+def local_rank_op(name=None):
+    return _dynamic_int_op(_basics.local_rank, name or "HorovodLocalRank")
+
+
+def size_op(name=None):
+    return _dynamic_int_op(_basics.size, name or "HorovodSize")
+
+
+def local_size_op(name=None):
+    return _dynamic_int_op(_basics.local_size, name or "HorovodLocalSize")
+
+
 def join() -> int:
     from ..ops import join as _join
 

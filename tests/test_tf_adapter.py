@@ -579,3 +579,30 @@ def test_keras_adasum_fit_traced_k1():
         return True
 
     assert _two(fn) == [True, True]
+
+
+def test_dynamic_topology_ops():
+    """rank_op/size_op read the CURRENT topology at execution time, not
+    trace time (ref: tensorflow/mpi_ops.py rank_op/size_op — the
+    reference kernels query the controller per execution so traced
+    functions see post-elastic-reset values)."""
+    def fn():
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+
+        @tf.function
+        def topo():
+            return hvd.rank_op(), hvd.size_op(), hvd.local_rank_op(), \
+                hvd.local_size_op()
+
+        r, s, lr, ls = topo()
+        assert int(s) == 2 and int(r) == hvd.rank()
+        assert int(ls) >= 1 and 0 <= int(lr) < int(ls)
+        # Eager path too.
+        assert int(hvd.size_op()) == 2
+        return True
+
+    assert _two(fn) == [True, True]
