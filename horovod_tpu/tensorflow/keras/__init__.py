@@ -1,0 +1,50 @@
+"""`horovod_tpu.tensorflow.keras` — drop-in surface of
+`horovod.tensorflow.keras` (ref: horovod/tensorflow/keras/__init__.py).
+
+The reference ships two Keras surfaces — `horovod.keras` (standalone
+Keras) and `horovod.tensorflow.keras` (tf.keras) — with identical
+semantics over the same `horovod._keras` implementation. Keras 3 is
+the one Keras, so this package re-exports `horovod_tpu.keras` under
+the reference's tf-flavored import path; scripts written as
+`import horovod.tensorflow.keras as hvd` port by renaming the package
+only.
+"""
+from ..compression import Compression  # noqa: F401
+from ...common.basics import (  # noqa: F401
+    ccl_built,
+    cuda_built,
+    cross_rank,
+    cross_size,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    rocm_built,
+    shutdown,
+    size,
+)
+from ...common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
+from ...keras import (  # noqa: F401
+    DistributedOptimizer,
+    allgather,
+    allgather_object,
+    allreduce,
+    broadcast,
+    broadcast_global_variables,
+    broadcast_object,
+    broadcast_variables,
+    barrier,
+    join,
+    load_model,
+)
+from ...keras import callbacks  # noqa: F401
+from ...keras.elastic import KerasState  # noqa: F401
+from . import elastic  # noqa: F401

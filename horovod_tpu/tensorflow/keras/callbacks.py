@@ -1,0 +1,7 @@
+"""tf.keras callbacks (ref: horovod/tensorflow/keras/callbacks.py —
+same classes as the standalone-Keras surface)."""
+from ...keras.callbacks import *  # noqa: F401,F403
+from ...keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    MetricAverageCallback,
+)

@@ -1,0 +1,3 @@
+"""Elastic state for tf.keras (ref: horovod/tensorflow/keras/elastic.py
+— KerasState over the shared implementation)."""
+from ...keras.elastic import KerasState  # noqa: F401

@@ -606,3 +606,29 @@ def test_dynamic_topology_ops():
         return True
 
     assert _two(fn) == [True, True]
+
+
+def test_tensorflow_keras_alias_surface(hvd_single):
+    """`import horovod_tpu.tensorflow.keras as hvd` must expose the
+    reference's tf.keras surface (ref:
+    horovod/tensorflow/keras/__init__.py) — same objects as
+    horovod_tpu.keras under the tf-flavored path."""
+    import keras
+
+    import horovod_tpu.keras as hk
+    import horovod_tpu.tensorflow.keras as hvd
+
+    for name in ("DistributedOptimizer", "allreduce", "broadcast",
+                 "allgather", "load_model", "Compression", "Adasum",
+                 "broadcast_global_variables", "init", "rank", "size",
+                 "mpi_built", "cuda_built"):
+        assert hasattr(hvd, name), name
+    assert hvd.DistributedOptimizer is hk.DistributedOptimizer
+    assert hvd.callbacks.BroadcastGlobalVariablesCallback \
+        is hk.callbacks.BroadcastGlobalVariablesCallback
+    assert hvd.elastic.KerasState is hk.KerasState
+
+    # The surface is live, not just importable.
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    assert type(opt).__name__ == "DistributedSGD"
+    assert hvd.size() == 1 and not hvd.cuda_built()
