@@ -455,8 +455,11 @@ def main():
                 args.model, n_chips, bs, args.image_size
             )
             scan_fn = _make_scan_step(step_fn, mesh, chunk)
-            # Short probe decides the sweep; the winner gets the full run.
-            dt, state = _time_scan(state, scan_fn, images, labels, chunk, 1)
+            # Short probe decides the sweep; two chunks, not one — a
+            # single-chunk probe has occasionally crowned the slower
+            # batch size on scheduler noise. The winner gets the full
+            # run.
+            dt, state = _time_scan(state, scan_fn, images, labels, chunk, 2)
             rate = global_batch / dt
         except Exception:
             continue
