@@ -254,11 +254,29 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = ReduceOp.AVERAGE,
+                         gradient_predivide_factor: float = 1.0,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0):
     """(ref: horovod/torch/optimizer.py:337-414; Adasum dispatch at
     :437-445 — op=Adasum with >1 rank returns the delta-model
-    optimizer, NOT a gradient allreduce)."""
+    optimizer, NOT a gradient allreduce).
+
+    ``gradient_predivide_factor`` splits the averaging around the sum
+    exactly as the reference does (ref: optimizer.py:428-435 guards,
+    :100-111 split): gradients are scaled by 1/f before the sum and
+    f/size after it (the engine applies the extra 1/size when lowering
+    AVERAGE — see engine.py enqueue_allreduce). Average-only, like the
+    reference; the reference's second guard (ROCm) has no TPU analogue.
+    ``prescale_factor``/``postscale_factor`` remain exposed as the raw
+    mechanics and compose multiplicatively with the split.
+    """
+    if gradient_predivide_factor != 1.0:
+        if op != ReduceOp.AVERAGE:
+            raise ValueError(
+                "gradient_predivide_factor not supported with op != Average"
+            )
+        prescale_factor = prescale_factor / gradient_predivide_factor
+        postscale_factor = postscale_factor * gradient_predivide_factor
     base_cls = type(optimizer)
     mixin = _DistributedMixin
     if op == ReduceOp.ADASUM and _basics.size() > 1:

@@ -147,6 +147,75 @@ def test_backward_passes_per_step_accumulates():
     assert _two(fn) == [True, True]
 
 
+def test_gradient_predivide_factor_splits_average():
+    """The reference's `gradient_predivide_factor` kwarg works unchanged:
+    the averaging splits into 1/f before the sum and f/size after it,
+    Average-only (ref: horovod/torch/optimizer.py:428-435 guards,
+    :100-111 split; the engine adds the 1/size when lowering AVERAGE)."""
+
+    def fn():
+        import torch
+
+        import horovod_tpu.torch as hvd
+        from horovod_tpu.common.types import ReduceOp
+
+        hvd.init()
+        f = 4.0
+        torch.manual_seed(7)
+        model = torch.nn.Linear(2, 1, bias=False)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.5),
+            named_parameters=model.named_parameters(),
+            gradient_predivide_factor=f,
+        )
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+        # Spy on the launch to assert the split factors reach the wire.
+        seen = {}
+        real = hvd.allreduce_async
+
+        def spy(tensor, name=None, op=None, prescale_factor=1.0,
+                postscale_factor=1.0):
+            seen["op"] = op
+            seen["pre"] = prescale_factor
+            seen["post"] = postscale_factor
+            return real(tensor, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor)
+
+        hvd.allreduce_async = spy
+        try:
+            w0 = next(model.parameters()).detach().clone()
+            # Dyadic values: every intermediate is exact in fp32, so the
+            # split must land on the plain average bit-for-bit.
+            x = torch.tensor([[2.0 ** (hvd.rank() + 1), 4.0]])
+            opt.zero_grad()
+            model(x).sum().backward()
+            opt.step()
+        finally:
+            hvd.allreduce_async = real
+        assert seen["op"] == ReduceOp.AVERAGE
+        assert seen["pre"] == 1.0 / f and seen["post"] == f, seen
+        # Net update equals lr * mean-grad: grad_r = x_r, mean = [3, 4].
+        w1 = next(model.parameters()).detach()
+        got = (w0 - w1).flatten().tolist()
+        assert got == [0.5 * 3.0, 0.5 * 4.0], got
+
+        # Reference guard: Average-only.
+        try:
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.5),
+                op=ReduceOp.SUM, gradient_predivide_factor=2.0,
+            )
+        except ValueError as e:
+            assert "op != Average" in str(e)
+        else:
+            raise AssertionError("expected ValueError for op != Average")
+        return True
+
+    assert _two(fn) == [True, True]
+
+
 def test_join_and_compression():
     def fn():
         import torch
