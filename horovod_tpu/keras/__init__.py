@@ -186,7 +186,10 @@ def _make_adasum_optimizer(optimizer, name, device_dense, device_sparse,
     Between communication steps the local optimizer keeps stepping on
     `var` (the reference's `_is_comm_step` schedule, :356,383-386) —
     unlike the gradient wrapper, which accumulates grads and applies
-    once per boundary.
+    once per boundary. The k-schedule lives IN-GRAPH — a tf.Variable
+    iteration counter gating the combine through `tf.cond` — so it
+    survives a traced `model.fit` exactly as the reference bakes
+    `_is_comm_step` into the graph.
     """
     from ..tensorflow import _make_allreduce_grads_fn
 
@@ -202,7 +205,20 @@ def _make_adasum_optimizer(optimizer, name, device_dense, device_sparse,
         def __init__(self):
             object.__setattr__(self, "__dict__", optimizer.__dict__)
             object.__setattr__(self, "_hvd_start", None)
-            object.__setattr__(self, "_hvd_count", 0)
+            object.__setattr__(self, "_hvd_iter", None)
+
+        def _hvd_combine(self, tvars):
+            import tensorflow as tf
+
+            deltas = [
+                tf.convert_to_tensor(v) - s
+                for v, s in zip(tvars, self._hvd_start)
+            ]
+            combined = allreduce_deltas(deltas)
+            for v, s, d in zip(tvars, self._hvd_start, combined):
+                s.assign_add(d)
+                v.assign(s)
+            return tf.constant(True)
 
         def apply(self, grads, trainable_variables=None):
             import tensorflow as tf
@@ -218,34 +234,31 @@ def _make_adasum_optimizer(optimizer, name, device_dense, device_sparse,
                     "or build the optimizer first"
                 )
             tvars = list(tvars)
-            if k > 1 and not tf.executing_eagerly():
-                # The k-th-step combine is decided by Python-side state;
-                # baked into a trace it would silently skip ALL
-                # communication (the v1 wrapper guards the same way).
-                raise NotImplementedError(
-                    "op=Adasum with backward_passes_per_step > 1 "
-                    "requires eager execution (compile with "
-                    "run_eagerly=True), or use "
-                    "backward_passes_per_step=1"
-                )
-            # First step: start <- var (ref: __init__.py:361-364).
+            # First step: start <- var (ref: __init__.py:361-364). The
+            # iteration counter is a tf.Variable so the k-schedule is
+            # part of the graph, not Python trace-time state.
             if self._hvd_start is None:
                 self._hvd_start = [
                     tf.Variable(tf.convert_to_tensor(v), trainable=False)
                     for v in tvars
                 ]
+                self._hvd_iter = tf.Variable(
+                    0, dtype=tf.int64, trainable=False
+                )
             result = cls.apply(self, grads, trainable_variables)
-            self._hvd_count += 1
-            if self._hvd_count % k:
+            it = self._hvd_iter.assign_add(1)
+            if k <= 1:
+                self._hvd_combine(tvars)
                 return result
-            deltas = [
-                tf.convert_to_tensor(v) - s
-                for v, s in zip(tvars, self._hvd_start)
-            ]
-            combined = allreduce_deltas(deltas)
-            for v, s, d in zip(tvars, self._hvd_start, combined):
-                s.assign_add(d)
-                v.assign(s)
+            # In-graph comm-step schedule (ref: `_is_comm_step`,
+            # horovod/tensorflow/__init__.py:356,383-386): local step
+            # every batch, delta-combine every k-th. All ranks share the
+            # counter trajectory, so the branches stay aligned.
+            tf.cond(
+                tf.equal(it % k, 0),
+                lambda: self._hvd_combine(tvars),
+                lambda: tf.constant(False),
+            )
             return result
 
         def apply_gradients(self, grads_and_vars, **kwargs):

@@ -529,9 +529,8 @@ def test_singleton_collectives_in_trace_warn():
 
 def test_keras_adasum_fit_traced_k1():
     """Adasum wrapper inside a TRACED model.fit (no run_eagerly): with
-    backward_passes_per_step=1 the combine has no Python-side schedule
-    to bake, so the graph path must train and keep ranks identical;
-    k>1 without eager must raise instead of silently skipping comms."""
+    backward_passes_per_step=1 the combine has no schedule to gate, so
+    the graph path must train and keep ranks identical."""
     def fn():
         import keras
         import numpy as np
@@ -559,23 +558,58 @@ def test_keras_adasum_fit_traced_k1():
         w = model.get_weights()[0].ravel()
         gathered = hvd.allgather(tf.constant(w[None, :])).numpy()
         assert np.allclose(gathered[0], gathered[1], atol=1e-5), gathered
+        return True
 
-        # k>1 under tracing must refuse loudly.
-        m2 = keras.Sequential(
-            [keras.Input((4,)), keras.layers.Dense(1)]
+    assert _two(fn) == [True, True]
+
+
+def test_keras_adasum_fit_traced_k2_in_graph_schedule():
+    """Traced model.fit at backward_passes_per_step=2: the comm-step
+    schedule is in-graph (ref: `_is_comm_step`,
+    horovod/tensorflow/__init__.py:356,383-386), so ranks must be
+    IDENTICAL right after every k-th (comm) step and DIVERGED after the
+    local-only steps in between."""
+    def fn():
+        import keras
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu.keras as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        keras.utils.set_random_seed(11)
+
+        model = keras.Sequential(
+            [keras.Input((4,)), keras.layers.Dense(1, use_bias=False)]
         )
-        o2 = hvd.DistributedOptimizer(
+        opt = hvd.DistributedOptimizer(
             keras.optimizers.SGD(0.05), op=hvd.Adasum,
             backward_passes_per_step=2)
-        m2.compile(optimizer=o2, loss="mse")
-        try:
-            m2.fit(X, Y, epochs=1, batch_size=16, verbose=0)
-            raised = False
-        except NotImplementedError:
-            raised = True
-        except Exception as e:  # keras may wrap it — require OUR guard
-            raised = "backward_passes_per_step" in str(e)
-        assert raised, "traced k>1 Adasum must not silently skip comms"
+        model.compile(optimizer=opt, loss="mse")  # traced train_step
+        rng = np.random.RandomState(r)  # rank-dependent data
+        X = rng.randn(16, 4).astype(np.float32)
+        Y = (X @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32))
+        hvd.broadcast_variables(model.variables, root_rank=0)
+
+        def spread():
+            w = model.get_weights()[0].ravel()
+            g = hvd.allgather(tf.constant(w[None, :])).numpy()
+            return float(np.abs(g[0] - g[1]).max())
+
+        first_loss = None
+        # One full batch per fit call => exactly one apply per epoch.
+        for step in range(1, 5):
+            h = model.fit(X, Y, epochs=1, batch_size=16, verbose=0)
+            if first_loss is None:
+                first_loss = h.history["loss"][0]
+            if step % 2 == 0:
+                # comm step: Adasum-combined, ranks identical
+                assert spread() < 1e-5, (step, spread())
+            else:
+                # local-only step on rank-dependent data: diverged
+                assert spread() > 1e-4, (step, spread())
+        assert h.history["loss"][-1] < first_loss
         return True
 
     assert _two(fn) == [True, True]
