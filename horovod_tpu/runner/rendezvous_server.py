@@ -17,6 +17,7 @@ reference's own gap of an unauthenticated rendezvous.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -152,6 +153,7 @@ class RendezvousServer:
         self.secret_key = secret_key
         self._store: Dict[str, bytes] = {}
         self._seen_digests: Dict[str, float] = {}
+        self._evict_warned = False
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -187,6 +189,14 @@ class RendezvousServer:
                 # replay defeats dedup — but timestamps are unverifiable
                 # under a disabled window anyway, and unbounded growth
                 # is a guaranteed DoS on long-lived servers.
+                if len(self._seen_digests) >= 65536 \
+                        and not self._evict_warned:
+                    self._evict_warned = True
+                    logging.getLogger("horovod_tpu.runner").warning(
+                        "rendezvous replay dedup reached its 64Ki cap "
+                        "with HOROVOD_REPLAY_WINDOW disabled; evicting "
+                        "oldest digests — dedup is best-effort from here"
+                    )
                 while len(self._seen_digests) >= 65536:
                     del self._seen_digests[next(iter(self._seen_digests))]
             elif len(self._seen_digests) > 4096:

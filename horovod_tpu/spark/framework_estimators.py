@@ -398,8 +398,11 @@ class TorchEstimator:
                     loss.backward()
                     opt.step()
                     ep_loss += float(loss.detach())
-                history["loss"].append(
-                    rank_mean(ep_loss / max(steps, 1)))
+                # steps is rank-agreed: every rank skips together, so
+                # no fabricated 0.0 loss when there were no batches
+                # (mirrors the Keras estimator).
+                if steps:
+                    history["loss"].append(rank_mean(ep_loss / steps))
                 if val_steps:
                     model.eval()
                     vit = plan.batches(epoch, rank, size, subset="val")
@@ -587,10 +590,12 @@ class KerasEstimator:
                     res = as_vector(model.train_on_batch(
                         bx, np.asarray(by), sample_weight=bw))
                     ep = res if ep is None else ep + res
-                for name, v in zip(series_names(),
-                                   (ep if ep is not None else [0.0])):
-                    history.setdefault(name, []).append(
-                        rank_mean(float(v) / max(steps, 1)))
+                # steps is rank-agreed, so every rank skips together:
+                # no fabricated 0.0 loss when there were no batches.
+                if ep is not None:
+                    for name, v in zip(series_names(), ep):
+                        history.setdefault(name, []).append(
+                            rank_mean(float(v) / steps))
                 if val_steps:
                     vit = plan.batches(epoch, rank, size, subset="val")
                     vp = None

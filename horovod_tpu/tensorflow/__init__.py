@@ -739,8 +739,11 @@ def _make_v1_optimizer(optimizer, name, device_dense, device_sparse,
         (ref: __init__.py:289-332)."""
 
         def __init__(self):
-            self._opt = optimizer
-            self.__dict__.update(optimizer.__dict__)
+            # Alias (not copy) the wrapped instance's state so
+            # post-wrap mutations of the original optimizer (e.g. its
+            # learning rate) reach the wrapper, matching the torch and
+            # Keras surfaces.
+            object.__setattr__(self, "__dict__", optimizer.__dict__)
 
         def compute_gradients(self, *args, **kwargs):
             gradients = type(optimizer).compute_gradients(
@@ -774,8 +777,10 @@ def _make_v1_adasum_optimizer(optimizer, name, device_dense, device_sparse,
 
     class _V1AdasumOptimizer(type(optimizer)):
         def __init__(self):
-            self._opt = optimizer
-            self.__dict__.update(optimizer.__dict__)
+            # Alias (not copy) the wrapped optimizer's __dict__ for
+            # consistency with the torch and Keras wrappers: mutating
+            # the original instance after wrapping must be visible here.
+            object.__setattr__(self, "__dict__", optimizer.__dict__)
             self._hvd_start = None
             self._hvd_count = 0
 
