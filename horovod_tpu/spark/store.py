@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import os
 import pickle
+import posixpath
+import re
 import shutil
 import time
 from typing import Any, List, Optional
@@ -116,11 +118,14 @@ class Store:
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         """(ref: store.py:141-146 Store.create dispatches on URL
         scheme.)"""
-        if prefix_path.startswith(("hdfs://", "gs://", "s3://")):
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith(("gs://", "s3://")):
             raise ValueError(
                 f"remote filesystem URL {prefix_path!r} is not natively "
-                "supported: mount it (gcsfuse / hdfs-fuse) and pass the "
-                "mounted path, the idiomatic arrangement on TPU-VMs"
+                "supported: mount it (gcsfuse) and pass the mounted "
+                "path — the idiomatic arrangement on TPU-VMs — or "
+                "construct FilesystemStore with a pyarrow.fs filesystem"
             )
         return LocalStore(prefix_path, *args, **kwargs)
 
@@ -199,6 +204,19 @@ class LocalStore(Store):
 
         return pq.ParquetDataset(path)
 
+    # Filesystem hooks — FilesystemStore overrides these two to route
+    # all parquet IO through an arbitrary pyarrow.fs.FileSystem while
+    # inheriting the sharding math unchanged.
+    def _open_parquet(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(path)
+
+    def _read_table(self, path: str, columns: Optional[List[str]]):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=columns)
+
     def read_parquet(self, path: str, columns: Optional[List[str]] = None,
                      shard_rank: Optional[int] = None,
                      shard_size: Optional[int] = None):
@@ -209,19 +227,17 @@ class LocalStore(Store):
         rank::size (the reference's Petastorm readers similarly shard
         by row group, common/util.py); otherwise the caller must
         row-slice the returned frame itself."""
-        import pyarrow.parquet as pq
-
         parts = self._part_files(path)
         if (shard_rank is not None and shard_size is not None
                 and shard_size > 1 and len(parts) >= shard_size):
             tables = [
-                pq.read_table(p, columns=columns)
+                self._read_table(p, columns)
                 for p in parts[shard_rank::shard_size]
             ]
             import pyarrow as pa
 
             return pa.concat_tables(tables).to_pandas()
-        return pq.read_table(path, columns=columns).to_pandas()
+        return self._read_table(path, columns).to_pandas()
 
     def sharding_by_parts(self, path: str, shard_size: int) -> bool:
         """True when read_parquet(shard_rank=..., shard_size=...) will
@@ -239,8 +255,6 @@ class LocalStore(Store):
         files; otherwise rows are strided rank::size by GLOBAL row
         index, so per-rank totals match `shard_num_rows` exactly (the
         estimator's collective step-count agreement depends on that)."""
-        import pyarrow.parquet as pq
-
         parts = self._part_files(path)
         sharded = (shard_rank is not None and shard_size is not None
                    and shard_size > 1)
@@ -248,7 +262,7 @@ class LocalStore(Store):
         files = parts[shard_rank::shard_size] if by_parts else parts
         offset = 0
         for f in files:
-            pf = pq.ParquetFile(f)
+            pf = self._open_parquet(f)
             try:
                 for rb in pf.iter_batches(batch_size=batch_rows,
                                           columns=columns):
@@ -266,15 +280,17 @@ class LocalStore(Store):
                        shard_size: Optional[int] = None) -> int:
         """Exact per-shard row count from Parquet metadata (no data
         read), matching iter_parquet_batches' sharding."""
-        import pyarrow.parquet as pq
-
         parts = self._part_files(path)
         sharded = (shard_rank is not None and shard_size is not None
                    and shard_size > 1)
         by_parts = sharded and len(parts) >= shard_size
 
         def rows(f):
-            return pq.ParquetFile(f).metadata.num_rows
+            pf = self._open_parquet(f)
+            try:
+                return pf.metadata.num_rows
+            finally:
+                pf.close()
 
         if by_parts:
             return sum(rows(f) for f in parts[shard_rank::shard_size])
@@ -340,13 +356,218 @@ class LocalStore(Store):
         return self.exists(mark) and self.read(mark).decode() == fp
 
 
-class HDFSStore(Store):
-    """Placeholder matching the reference's class name
-    (ref: store.py:263-433). Native HDFS clients are out of scope on
-    TPU-VMs; use a FUSE mount + LocalStore."""
+class FilesystemStore(LocalStore):
+    """Store over an arbitrary `pyarrow.fs.FileSystem`
+    (ref: store.py:148-260 FilesystemStore — the reference's
+    pyarrow-based generalization that LocalStore and HDFSStore share).
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "HDFSStore is not supported in horovod_tpu: mount HDFS "
-            "(hdfs-fuse) and use LocalStore on the mounted path"
+    Inherits LocalStore's path scheme and all the sharding math; only
+    the filesystem primitives are rerouted through the pyarrow fs. Any
+    filesystem implementing that interface works — HDFS via
+    `HadoopFileSystem`, tests via `LocalFileSystem`."""
+
+    def __init__(self, prefix_path: str, fs=None,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 runs_path: Optional[str] = None,
+                 url_prefix: Optional[str] = None):
+        default_local = fs is None
+        if fs is None:
+            import pyarrow.fs as pafs
+
+            fs = pafs.LocalFileSystem()
+        self.fs = fs
+        # URL scheme Spark executors can address this filesystem by
+        # (e.g. "file://", "hdfs://nn:8020"). None = no Spark-visible
+        # URL exists for this fs; save_data_frame then refuses Spark
+        # DataFrames instead of silently writing executor-local files.
+        self._url_prefix = ("file://" if default_local and
+                           url_prefix is None else url_prefix)
+        if prefix_path.startswith(self.FS_PREFIX):
+            prefix_path = prefix_path[len(self.FS_PREFIX):]
+        # No abspath: paths are rooted inside the target filesystem.
+        self.prefix_path = prefix_path.rstrip("/") or "/"
+        join = posixpath.join
+        self._train_path = train_path or join(
+            self.prefix_path, "intermediate_train_data")
+        self._val_path = val_path or join(
+            self.prefix_path, "intermediate_val_data")
+        self._runs_path = runs_path or join(self.prefix_path, "runs")
+        self.fs.create_dir(self.prefix_path, recursive=True)
+
+    # -- path scheme over posix joins ---------------------------------
+    def get_run_path(self, run_id: str) -> str:
+        return posixpath.join(self._runs_path, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return posixpath.join(self.get_run_path(run_id), "checkpoint.pkl")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return posixpath.join(self.get_run_path(run_id), "logs")
+
+    # -- filesystem primitives ----------------------------------------
+    def _info(self, path: str):
+        return self.fs.get_file_info(path)
+
+    def exists(self, path: str) -> bool:
+        import pyarrow.fs as pafs
+
+        return self._info(path).type != pafs.FileType.NotFound
+
+    def read(self, path: str) -> bytes:
+        with self.fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        import pyarrow.fs as pafs
+
+        self.fs.create_dir(posixpath.dirname(path), recursive=True)
+        # Write-then-rename: rename is atomic on HDFS (and POSIX), so
+        # readers never observe partial files — same guarantee as
+        # LocalStore.write. HDFS rename does NOT overwrite an existing
+        # destination (unlike os.replace / LocalFileSystem.move), so an
+        # existing target — e.g. checkpoint.pkl rewritten every epoch —
+        # must be deleted first; single-writer paths make the
+        # delete/move window benign.
+        tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
+        with self.fs.open_output_stream(tmp) as f:
+            f.write(data)
+        if self._info(path).type == pafs.FileType.File:
+            self.fs.delete_file(path)
+        self.fs.move(tmp, path)
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        import pyarrow.fs as pafs
+
+        info = self._info(path)
+        if info.type == pafs.FileType.File:
+            return path.endswith(".parquet")
+        if info.type != pafs.FileType.Directory:
+            return False
+        return bool(self._part_files(path)) or self.exists(
+            posixpath.join(path, "_SUCCESS"))
+
+    def _part_files(self, path: str) -> List[str]:
+        import pyarrow.fs as pafs
+
+        info = self._info(path)
+        if info.type == pafs.FileType.File:
+            return [path]
+        if info.type != pafs.FileType.Directory:
+            return []
+        sel = pafs.FileSelector(path)
+        return sorted(
+            fi.path for fi in self.fs.get_file_info(sel)
+            if fi.type == pafs.FileType.File
+            and fi.path.endswith(".parquet")
         )
+
+    # -- parquet IO hooks ---------------------------------------------
+    def get_parquet_dataset(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetDataset(path, filesystem=self.fs)
+
+    def _open_parquet(self, path: str):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(self.fs.open_input_file(path))
+
+    def _read_table(self, path: str, columns: Optional[List[str]]):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=columns, filesystem=self.fs)
+
+    def save_data_frame(self, df, path: str):
+        """(ref: common/util.py prepare_data → df.write.parquet; the
+        pandas fallback writes one part through the pyarrow fs.)"""
+        import pyarrow as pa
+        import pyarrow.fs as pafs
+        import pyarrow.parquet as pq
+
+        fp = self.dataset_fingerprint(df)
+        if hasattr(df, "write"):  # real pyspark DataFrame
+            if self._url_prefix is None:
+                raise ValueError(
+                    "this FilesystemStore's pyarrow filesystem has no "
+                    "Spark-addressable URL; pass url_prefix= (e.g. "
+                    "'hdfs://namenode:8020') or materialize a pandas "
+                    "DataFrame instead"
+                )
+            # The full URL (scheme + authority) — not the bare path —
+            # so Spark executors write to the SAME filesystem this
+            # store reads (ref: store.py path_prefix/get_full_path).
+            df.write.mode("overwrite").parquet(
+                f"{self._url_prefix}{path}")
+        else:
+            pdf = df.toPandas() if hasattr(df, "toPandas") else df
+            if self._info(path).type == pafs.FileType.Directory:
+                self.fs.delete_dir(path)
+            self.fs.create_dir(path, recursive=True)
+            pq.write_table(
+                pa.Table.from_pandas(pdf),
+                posixpath.join(path, "part-00000.parquet"),
+                filesystem=self.fs,
+            )
+            with self.fs.open_output_stream(
+                    posixpath.join(path, "_SUCCESS")):
+                pass
+        if fp is not None:
+            self.write(self._fingerprint_path(path), fp.encode())
+
+
+class HDFSStore(FilesystemStore):
+    """HDFS-backed store (ref: store.py:263-433 HDFSStore). Accepts the
+    reference's prefix forms — ``hdfs://namenode:8020/user/x``,
+    ``hdfs:///user/x``, or ``/user/x`` — plus its connection kwargs,
+    and talks to HDFS through `pyarrow.fs.HadoopFileSystem` (libhdfs).
+
+    On hosts without a usable libhdfs (the common TPU-VM case), the
+    constructor raises with the FUSE-mount guidance instead of failing
+    downstream; pass ``fs=`` explicitly to use any stand-in
+    `pyarrow.fs.FileSystem` (tests use `LocalFileSystem`)."""
+
+    FS_PREFIX = "hdfs://"
+    # prefix, host, port, path — the reference's URL shape (ref:
+    # store.py:319 URL_PATTERN), expressed as a stricter hdfs-only re.
+    _URL = re.compile(
+        r"^(?:hdfs://)?(?:([^/:]+))?(?::(\d+))?(/.*)?$")
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None,
+                 kerb_ticket: Optional[str] = None,
+                 extra_conf: Optional[dict] = None, fs=None, **kwargs):
+        if prefix_path.startswith(self.FS_PREFIX):
+            m = self._URL.match(prefix_path[len(self.FS_PREFIX):])
+            url_host, url_port, path = m.groups() if m else (None, None, None)
+        else:
+            url_host, url_port, path = None, None, prefix_path
+        if not path:
+            raise ValueError(
+                f"could not parse an HDFS path out of {prefix_path!r}")
+        host = host or url_host or "default"
+        port = port if port is not None else (
+            int(url_port) if url_port else 0)
+        # Spark-visible URL authority (ref: store.py:329 _url_prefix):
+        # an explicit namenode rides along; 'default' defers to the
+        # cluster's fs.defaultFS.
+        kwargs.setdefault(
+            "url_prefix",
+            f"hdfs://{host}:{port}" if host != "default" and port
+            else (f"hdfs://{host}" if host != "default" else "hdfs://"),
+        )
+        if fs is None:
+            import pyarrow.fs as pafs
+
+            try:
+                fs = pafs.HadoopFileSystem(
+                    host=host, port=port, user=user,
+                    kerb_ticket=kerb_ticket, extra_conf=extra_conf)
+            except Exception as e:
+                raise RuntimeError(
+                    "HDFSStore could not initialize libhdfs "
+                    f"({e}); on TPU-VMs mount HDFS (hdfs-fuse) and use "
+                    "LocalStore on the mounted path, or pass fs= with "
+                    "any pyarrow.fs.FileSystem"
+                ) from e
+        super().__init__(path, fs=fs, **kwargs)
