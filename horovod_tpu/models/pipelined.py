@@ -82,4 +82,4 @@ class PipelinedLM:
         else:
             kernel = params["lm_head"]["kernel"]
             logits = jnp.einsum("bsd,dv->bsv", x, kernel.astype(x.dtype))
-        return logits.astype(jnp.float32)
+        return logits.astype(cfg.logits_dtype)

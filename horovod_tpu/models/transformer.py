@@ -62,6 +62,15 @@ class TransformerConfig:
     remat: bool = False
     scan_layers: bool = False
     logits_via_embedding: bool = False
+    # Output logits dtype. bf16 (the compute dtype) is the TPU-first
+    # default: the (B, S, V) logits tensor is the largest activation in
+    # the model (1.65 GB in f32 at the GPT-2 bench shape) and every
+    # loss in this repo upcasts to f32 *inside* its softmax reduction
+    # (parallel/train.py softmax_xent), so emitting f32 here only
+    # doubles the HBM traffic of the lm-head region — measured 6.0 ms
+    # of a 98 ms step on v5e (docs/benchmarks.md, r5). Set
+    # jnp.float32 to hand downstream consumers full-precision logits.
+    logits_dtype: Dtype = jnp.bfloat16
     # Learned (gpt2/bert/vit) vs fixed sinusoidal positions.
     learned_pos: bool = True
     # Attention implementation: "dense", or the sequence-parallel kernels
@@ -503,7 +512,7 @@ class TransformerLM(nn.Module):
             logits = _dense(cfg.vocab_size, cfg, "lm_head", ("embed", "vocab"),
                             use_bias=False)(x)
         return nn.with_logical_constraint(
-            logits.astype(jnp.float32), ("batch", "seq", "vocab")
+            logits.astype(cfg.logits_dtype), ("batch", "seq", "vocab")
         )
 
 
@@ -523,7 +532,7 @@ class TransformerEncoder(nn.Module):
         x = functools_partial_ln(cfg)(name="ln_f")(x)
         logits = _dense(cfg.vocab_size, cfg, "mlm_head", ("embed", "vocab"),
                         use_bias=False)(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(cfg.logits_dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -99,6 +99,7 @@ def test_transformer_flash_impl_matches_dense():
     base = dataclasses.replace(
         BERT_CONFIGS["bert-tiny"], max_len=64, n_layers=1,
         dtype=jnp.float32, param_dtype=jnp.float32,
+        logits_dtype=jnp.float32,
     )
     ids = np.random.RandomState(0).randint(0, 1000, (2, 64), np.int32)
     mask = np.ones((2, 64), np.float32)
@@ -133,6 +134,7 @@ def test_flash_under_gspmd_mesh_is_sharded_and_correct():
     base = dataclasses.replace(
         BERT_CONFIGS["bert-tiny"], max_len=64, n_layers=1,
         dtype=jnp.float32, param_dtype=jnp.float32,
+        logits_dtype=jnp.float32,
     )
     ids = np.random.RandomState(0).randint(0, 1000, (4, 64), np.int32)
     mask = np.ones((4, 64), np.float32)
@@ -202,6 +204,7 @@ def test_model_ulysses_flash_on_dp_sp_mesh():
     base = dataclasses.replace(
         BERT_CONFIGS["bert-tiny"], max_len=64, n_layers=1, n_heads=4,
         dtype=jnp.float32, param_dtype=jnp.float32,
+        logits_dtype=jnp.float32,
     )  # 4 heads: Ulysses needs n_heads divisible by sp
     ids = np.random.RandomState(0).randint(0, 1000, (4, 64), np.int32)
     mask = np.ones((4, 64), np.float32)

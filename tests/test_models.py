@@ -34,7 +34,33 @@ def test_forward_shapes(name):
     variables = m.init(jax.random.PRNGKey(0), *batch)
     out = m.apply(variables, *batch)
     assert out.shape[0] == 2
-    assert out.dtype == jnp.float32
+    if name in ("gpt2-tiny", "bert-tiny"):
+        # Transformers emit compute-dtype logits by default (the loss
+        # upcasts inside its softmax; see TransformerConfig.logits_dtype)
+        # and f32 on request.
+        assert out.dtype == jnp.bfloat16
+        m32 = spec.make_model(logits_dtype=jnp.float32)
+        assert m32.apply(variables, *batch).dtype == jnp.float32
+    else:
+        assert out.dtype == jnp.float32
+
+
+def test_bf16_logits_loss_matches_f32_logits():
+    """The bf16-logits default must not move the loss: softmax_xent
+    computes in f32 internally, so the only difference is the logits'
+    own bf16 rounding."""
+    ids = np.random.RandomState(3).randint(0, 512, (4, 32), dtype=np.int32)
+    base = dict(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                d_ff=128, max_len=32)
+    m16 = TransformerLM(TransformerConfig(**base))
+    m32 = TransformerLM(TransformerConfig(**base,
+                                          logits_dtype=jnp.float32))
+    variables = m16.init(jax.random.PRNGKey(0), ids)
+    l16 = lm_loss(m16.apply(variables, ids), ids)
+    l32 = lm_loss(m32.apply(variables, ids), ids)
+    assert l16.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(l16), np.asarray(l32),
+                               rtol=2e-3)
 
 
 def test_resnet_batchstats_update():
@@ -137,7 +163,7 @@ def test_bert_ring_attention_with_padding_mask():
 
     base = dataclasses.replace(
         BERT_CONFIGS["bert-tiny"], max_len=32, n_layers=1, dtype=jnp.float32,
-        param_dtype=jnp.float32,
+        param_dtype=jnp.float32, logits_dtype=jnp.float32,
     )
     ids = np.random.RandomState(0).randint(0, 1000, (2, 32), dtype=np.int32)
     mask = np.ones((2, 32), np.float32)
