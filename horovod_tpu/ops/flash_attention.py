@@ -20,9 +20,14 @@ Semantics match parallel/ring.py's dense_attention exactly, including
 the padding-mask convention (1 = attend, 0 = pad; fully-masked rows
 yield zeros). The backward pass is blockwise Pallas too (Dao et al.
 structure): the forward saves only the output and the per-row
-logsumexp, and two kernels (dQ; dK/dV) recompute probability tiles
-on the fly — so neither direction ever materializes (S, S) scores in
-HBM, and causal block-skipping applies in both.
+logsumexp, and ONE fused kernel (`_dqkv_kernel`, r5) recomputes each
+probability tile exactly once while producing dQ, dK, and dV in a
+single k-block sweep (dQ rides a persistent VMEM scratch) — so
+neither direction ever materializes (S, S) scores in HBM, causal
+block-skipping applies in both, and the backward does 5 tile matmuls
+instead of the classic two-pass structure's 7. Tiles that cannot be
+touched by masking (below-diagonal, no padding) take a stripped
+VPU-light body — see `_prep`'s `plain`.
 
 Gradients therefore differentiate the same math; forward numerics agree
 with the reference to bf16/f32 tolerance (asserted in
@@ -59,7 +64,7 @@ except Exception:  # pragma: no cover
 
 
 def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale: float,
-            causal: bool, block_q: int, block_k: int):
+            causal: bool, block_q: int, block_k: int, plain: bool):
     """One (batch*head, q-block) grid step, streaming k-blocks.
 
     q_ref: (1, block_q, D); k_ref/v_ref: (1, S_pad, D) VMEM-resident;
@@ -71,6 +76,13 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale: float,
     scores is O(block_q*block_k) regardless of S, and causal q-blocks
     skip every k-block entirely above the diagonal — the canonical
     ~2x FLOP saving for causal attention.
+
+    `plain=True` (no padding mask, keys unpadded): tiles fully below the
+    diagonal take a mask-free body — no position iotas, compares, or
+    where-selects. At D=64 the per-score softmax VPU work, not the MXU,
+    bounds this kernel (docs/benchmarks.md), so stripping the masking
+    VPU ops from the ~60% of tiles that never needed them is a direct
+    win; only the tiles straddling the diagonal run the masked body.
     """
     qi = pl.program_id(1)
 
@@ -80,32 +92,36 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale: float,
     D = q.shape[-1]
     s_pad = k_ref.shape[1]
 
-    qpos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-
-    def body(kb, carry):
+    def tile(kb, carry, masked):
         acc, m, l = carry
         # Ref-level dynamic slices (Mosaic lowers pl.ds on refs; value-
         # level lax.dynamic_slice is not supported in-kernel).
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_prec(q.dtype),
         ) * scale                               # (block_q, block_k) f32
-        kpos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = m_blk[None, :] > 0              # padded keys masked here
-        if causal:
-            valid = jnp.logical_and(valid, kpos <= qpos)
-        s = jnp.where(valid, s, NEG_INF)
+        if masked:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if plain:
+                valid = kpos <= qpos
+            else:
+                m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
+                valid = m_blk[None, :] > 0      # padded keys masked here
+                if causal:
+                    valid = jnp.logical_and(valid, kpos <= qpos)
+            s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        # Explicit zeroing: an all-masked tile would otherwise turn the
-        # NEG_INF plateau into exp(0)=1 rows (same convention as
-        # parallel/ring.py _flash_block_update).
-        p = jnp.where(valid, p, 0.0)
+        if masked:
+            # Explicit zeroing: an all-masked tile would otherwise turn
+            # the NEG_INF plateau into exp(0)=1 rows (same convention as
+            # parallel/ring.py _flash_block_update).
+            p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
@@ -123,10 +139,26 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale: float,
         last_q = (qi + 1) * block_q - 1
         num_kb = jnp.minimum(num_kb, last_q // block_k + 1)
 
-    acc = jnp.zeros((block_q, D), jnp.float32)
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc, m, l))
+    carry = (
+        jnp.zeros((block_q, D), jnp.float32),
+        jnp.full((block_q,), NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+    )
+    if plain and causal:
+        # Tiles whose last key row sits at/below this q-block's first
+        # query row need no causal masking at all.
+        n_full = (qi * block_q) // block_k
+        carry = jax.lax.fori_loop(
+            0, n_full, lambda kb, c: tile(kb, c, masked=False), carry)
+        carry = jax.lax.fori_loop(
+            n_full, num_kb, lambda kb, c: tile(kb, c, masked=True), carry)
+    elif plain:
+        carry = jax.lax.fori_loop(
+            0, num_kb, lambda kb, c: tile(kb, c, masked=False), carry)
+    else:
+        carry = jax.lax.fori_loop(
+            0, num_kb, lambda kb, c: tile(kb, c, masked=True), carry)
+    acc, m, l = carry
 
     l_safe = jnp.maximum(l, 1e-30)
     o = acc / l_safe[:, None]
@@ -146,13 +178,27 @@ def _prep(q, k, v, mask, block_q: int):
     sliced off after) and keys/values/mask padded to a block_k multiple
     (padded keys carry mask 0, so they never contribute). Both passes
     MUST use identical block/pad arithmetic for the saved lse residual
-    to line up with the backward's blocks."""
+    to line up with the backward's blocks.
+
+    Also returns `plain`: True when no padding mask exists and keys
+    needed no block padding — the kernels then take the mask-free fast
+    path on below-diagonal tiles (the key-validity mask is the only
+    thing key padding relies on, so it must force the masked path)."""
     B, S, H, D = q.shape
     if block_q is None:
-        # Measured on v5e (B4 H12 D64, fwd+bwd, in-jit loops): 128 wins
-        # at S<=2048; 512 is ~22% faster at S=4096 (fewer grid steps,
-        # better k/v reuse, and causal skipping grows coarser anyway).
-        block_q = DEFAULT_BLOCK_Q if S <= 2048 else 512
+        # Measured on v5e (B4 H12 D64, full GPT-2 train step, r5,
+        # mask-free fast path + fused single-sweep backward): at
+        # S=2048, 256 wins (77.0 ms vs 81.4 at 512 and 96.6 at 128);
+        # at S=4096, 512 stays ~25% ahead of 256 (coarser causal
+        # skipping amortizes, VMEM pressure per q-block matters less).
+        # Below 2048 the finer grid's causal skipping pays: 128. (384
+        # and 1024 lose everywhere — Mosaic tiling/VMEM pressure.)
+        if S < 2048:
+            block_q = DEFAULT_BLOCK_Q
+        elif S == 2048:
+            block_q = 256
+        else:
+            block_q = 512
     bq = min(block_q, S)
     bk = min(DEFAULT_BLOCK_K, S)
     pad_q = (-S) % bq
@@ -178,19 +224,21 @@ def _prep(q, k, v, mask, block_q: int):
         mask2 = mask.astype(jnp.float32).reshape(B, 1, S)
     if pad_k:
         mask2 = jnp.pad(mask2, ((0, 0), (0, 0), (0, pad_k)))
-    return qb, kb_arr, vb, mask2, to_bh, bq, bk, S + pad_q, S + pad_k
+    plain = mask is None and pad_k == 0
+    return (qb, kb_arr, vb, mask2, to_bh, bq, bk, S + pad_q, S + pad_k,
+            plain)
 
 
 def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
                interpret: bool) -> "tuple[jax.Array, jax.Array]":
     B, S, H, D = q.shape
     scale = 1.0 / float(np.sqrt(D))
-    qb, kb_arr, vb, mask2, _, bq, bk, Sq, Sk = _prep(q, k, v, mask,
-                                                     block_q)
+    qb, kb_arr, vb, mask2, _, bq, bk, Sq, Sk, plain = _prep(q, k, v,
+                                                            mask, block_q)
     grid = (B * H, Sq // bq)
     out, lse = pl.pallas_call(
         functools.partial(_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, plain=plain),
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
@@ -216,78 +264,36 @@ def _flash_fwd(q, k, v, mask, causal: bool, block_q: int,
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3), lse[:, :, :S]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, scale: float, causal: bool, block_q: int,
-               block_k: int):
-    """dQ pass: grid (B*H, q-block); stream k-blocks.
+def _dqkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dk_ref, dv_ref, dq_acc, *, scale: float,
+                 causal: bool, block_q: int, block_k: int, plain: bool):
+    """FUSED backward: grid (B*H, k-block), ki innermost. One sweep
+    computes dK/dV for this k-block AND accumulates every q-block's dQ
+    contribution into a persistent f32 VMEM scratch (written out once,
+    on the last k-block) — so each probability tile is recomputed ONCE
+    per backward instead of once per pass, and the dO@V^T `dp` matmul
+    is shared between dQ and dK instead of being issued twice (5 tile
+    matmuls vs the two-pass structure's 7, and half the exp/VPU work).
+    Measured on the GPT-2 seq-2048 v5e step this is the difference
+    between ~0.49 and >=0.50 MFU (docs/benchmarks.md).
 
-    ds = p * (dO @ V^T - delta) * scale; dq = sum_k ds @ K
-    (Dao et al. flash-attention backward; p = exp(s - L) is the
-    normalized probability, delta = rowsum(dO * O))."""
-    qi = pl.program_id(1)
-    q = q_ref[0]                                 # (bq, D)
-    do = do_ref[0]                               # (bq, D), input dtype
-    L = lse_ref[0, 0]                            # (bq,)
-    delta = delta_ref[0, 0]                      # (bq,)
-    D = q.shape[-1]
-    s_pad = k_ref.shape[1]
-    qpos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        m_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=_prec(q.dtype),
-        ) * scale
-        kpos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = m_blk[None, :] > 0
-        if causal:
-            valid = jnp.logical_and(valid, kpos <= qpos)
-        p = jnp.where(valid, jnp.exp(s - L[:, None]), 0.0)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=_prec(v_blk.dtype),
-        )
-        ds = p * (dp - delta[:, None]) * scale
-        dq = dq + jax.lax.dot_general(
-            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=_prec(k_blk.dtype),
-        )
-        return dq
-
-    num_kb = s_pad // block_k
-    if causal:
-        last_q = (qi + 1) * block_q - 1
-        num_kb = jnp.minimum(num_kb, last_q // block_k + 1)
-    dq = jax.lax.fori_loop(
-        0, num_kb, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale: float, causal: bool,
-                block_q: int, block_k: int):
-    """dK/dV pass: grid (B*H, k-block); stream q-blocks.
-
-    dv = sum_q p^T @ dO;  dk = sum_q ds^T @ Q. Causal k-blocks start at
-    the first q-block reaching their diagonal. Padded q rows carry
-    lse=+inf (set by the host wrapper), so p = 0 for them."""
+    The scratch depends on TPU grid semantics: grid steps run
+    sequentially with the last dim innermost, so dq_acc persists across
+    the ki sweep of one (b, h) program and is re-zeroed at ki=0.
+    Padded q rows carry lse=+inf, killing their p rows — which is what
+    keeps the `plain` fast path valid under q padding."""
     ki = pl.program_id(1)
     k = k_ref[0]                                 # (bk, D)
     v = v_ref[0]
-    m_blk = mask_ref[0, 0]                       # (bk,)
     D = k.shape[-1]
     sq_pad = q_ref.shape[1]
-    kpos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    num_kb = pl.num_programs(1)
 
-    def body(qi, carry):
+    @pl.when(ki == 0)
+    def _zero():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def tile(qi, carry, masked):
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :]
         do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :]
@@ -298,12 +304,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
             precision=_prec(q_blk.dtype),
         ) * scale                                # (bq, bk)
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        valid = m_blk[None, :] > 0
-        if causal:
-            valid = jnp.logical_and(valid, kpos <= qpos)
-        p = jnp.where(valid, jnp.exp(s - L[:, None]), 0.0)
+        p = jnp.exp(s - L[:, None])
+        if masked:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if plain:
+                valid = kpos <= qpos
+            else:
+                m_blk = mask_ref[0, 0]           # (bk,)
+                valid = m_blk[None, :] > 0
+                if causal:
+                    valid = jnp.logical_and(valid, kpos <= qpos)
+            p = jnp.where(valid, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -320,21 +334,42 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
             precision=_prec(q_blk.dtype),
         )                                        # (bk, D)
+        dq_acc[pl.ds(qi * block_q, block_q), :] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(k.dtype),
+        )                                        # (bq, D)
         return dk, dv
 
     num_qb = sq_pad // block_q
     start_qb = 0
     if causal:
-        # q-blocks entirely above this k-block's diagonal contribute
-        # nothing: start at the first block whose last row reaches it.
         start_qb = (ki * block_k) // block_q
-    dk, dv = jax.lax.fori_loop(
-        start_qb, num_qb, body,
-        (jnp.zeros((block_k, D), jnp.float32),
-         jnp.zeros((block_k, D), jnp.float32)),
-    )
+    carry = (jnp.zeros((block_k, D), jnp.float32),
+             jnp.zeros((block_k, D), jnp.float32))
+    if plain and causal:
+        diag_end = jnp.minimum(
+            ((ki + 1) * block_k + block_q - 1) // block_q, num_qb)
+        carry = jax.lax.fori_loop(
+            start_qb, diag_end, lambda qi, c: tile(qi, c, masked=True),
+            carry)
+        carry = jax.lax.fori_loop(
+            diag_end, num_qb, lambda qi, c: tile(qi, c, masked=False),
+            carry)
+    elif plain:
+        carry = jax.lax.fori_loop(
+            0, num_qb, lambda qi, c: tile(qi, c, masked=False), carry)
+    else:
+        carry = jax.lax.fori_loop(
+            start_qb, num_qb, lambda qi, c: tile(qi, c, masked=True),
+            carry)
+    dk, dv = carry
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(ki == num_kb - 1)
+    def _flush_dq():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _flash_bwd(q, k, v, mask, out, lse, g, causal: bool, block_q: int,
@@ -343,8 +378,8 @@ def _flash_bwd(q, k, v, mask, out, lse, g, causal: bool, block_q: int,
     the (S, S) score matrix is never materialized in HBM."""
     B, S, H, D = q.shape
     scale = 1.0 / float(np.sqrt(D))
-    qb, kb_arr, vb, mask2, to_bh, bq, bk, Sq, Sk = _prep(q, k, v, mask,
-                                                         block_q)
+    qb, kb_arr, vb, mask2, to_bh, bq, bk, Sq, Sk, plain = _prep(
+        q, k, v, mask, block_q)
     pad_q = Sq - S
     dob, ob = to_bh(g), to_bh(out)
     if pad_q:
@@ -359,46 +394,32 @@ def _flash_bwd(q, k, v, mask, out, lse, g, causal: bool, block_q: int,
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
                     axis=-1).reshape(B * H, 1, Sq)
 
-    full_k = pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0))
-    full_q = pl.BlockSpec((1, Sq, D), lambda bh, i: (bh, 0, 0))
-    row_q = pl.BlockSpec((1, 1, Sq), lambda bh, i: (bh, 0, 0))
-    mask_spec = pl.BlockSpec((1, 1, Sk), lambda bh, i, H=H: (bh // H, 0, 0))
+    from jax.experimental.pallas import tpu as pltpu
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-        grid=(B * H, Sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            full_k, full_k, mask_spec,
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-        interpret=interpret,
-    )(qb, kb_arr, vb, mask2, dob, lse, delta)
+    full_q = pl.BlockSpec((1, Sq, D), lambda bh, ki: (bh, 0, 0))
+    row_q = pl.BlockSpec((1, 1, Sq), lambda bh, ki: (bh, 0, 0))
+    blk_k = pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0))
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_dqkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, plain=plain),
         out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
         ],
         grid=(B * H, Sk // bk),
         in_specs=[
             full_q,
-            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            blk_k, blk_k,
             pl.BlockSpec((1, 1, bk), lambda bh, ki, H=H: (bh // H, 0, ki)),
             full_q, row_q, row_q,
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            full_q,       # dq: one block per (b, h), flushed on last ki
+            blk_k, blk_k,
         ],
+        scratch_shapes=[pltpu.VMEM((Sq, D), jnp.float32)],
         interpret=interpret,
     )(qb, kb_arr, vb, mask2, dob, lse, delta)
 
@@ -415,9 +436,9 @@ def flash_attention(q, k, v, mask=None, causal: bool = True,
     """Fused attention. q/k/v: (B, S, H, D); mask: optional (B, S) key
     validity (1 = attend). Returns (B, S, H, D) in q.dtype.
 
-    `block_q=None` auto-selects by sequence length (128 for S<=2048,
-    512 beyond — measured fwd+bwd crossover on v5e); both vjp passes
-    resolve it identically in `_prep`. `interpret=None` auto-selects:
+    `block_q=None` auto-selects by sequence length (128 below S=2048,
+    256 at 2048, 512 beyond — measured full-train-step crossover on
+    v5e, r5); both vjp passes resolve it identically in `_prep`. `interpret=None` auto-selects:
     compiled Pallas on TPU, interpreter elsewhere (so CPU tests and the
     8-device virtual mesh still run)."""
     if not HAVE_PALLAS:
