@@ -58,6 +58,23 @@ def test_flash_fully_masked_rows_zero():
     assert np.isfinite(np.asarray(got)).all()
 
 
+def _assert_grads_match(q, k, v, jmask, causal, block_q):
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, jmask, causal=causal,
+                                       block_q=block_q,
+                                       interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal,
+                                       mask=jmask) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
 @pytest.mark.parametrize("S", [64, 96, 130])  # incl. q-padding paths
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("use_mask", [False, True])
@@ -70,20 +87,37 @@ def test_flash_gradients_match_dense(S, causal, use_mask):
         jmask = jnp.asarray(mask)
     else:
         jmask = None
+    _assert_grads_match(q, k, v, jmask, causal, block_q=64)
 
-    def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, jmask, causal=causal,
-                                       block_q=64, interpret=True) ** 2)
 
-    def loss_dense(q, k, v):
-        return jnp.sum(dense_attention(q, k, v, causal=causal,
-                                       mask=jmask) ** 2)
+@pytest.mark.parametrize("S", [1024, 1025])
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_flash_long_sequence_interior_tiles(S, causal, use_mask):
+    """S > block_k (512): the kernels stream MULTIPLE k-tiles — a scale
+    short-S tests (bk=min(512,S)=S → one tile) can never reach.
 
-    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(gf, gd):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-4)
+    S=1024 (512-multiple, pad_k=0): with no mask the below-diagonal
+    tiles take the mask-free `plain` body, the only CI coverage of that
+    path; with a mask, the multi-tile MASKED path at the same scale.
+    S=1025: keys pad to 1536 with an (almost) fully-masked final
+    k-tile, so `plain` is forced off even with mask=None and the
+    synthesized all-ones-then-padded mask path runs multi-tile. Covers
+    fwd and the fused single-sweep backward (interior/diagonal loop
+    splits in both)."""
+    q, k, v = _qkv(B=1, S=S, H=2, D=16)
+    if use_mask:
+        mask = np.ones((1, S), np.float32)
+        mask[0, 900:] = 0.0
+        jmask = jnp.asarray(mask)
+    else:
+        jmask = None
+    got = flash_attention(q, k, v, jmask, causal=causal, block_q=128,
+                          interpret=True)
+    want = dense_attention(q, k, v, causal=causal, mask=jmask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    _assert_grads_match(q, k, v, jmask, causal, block_q=128)
 
 
 def test_transformer_flash_impl_matches_dense():
