@@ -17,15 +17,24 @@ Beyond throughput this also reports, in the same JSON line:
     flagship (BERT-base, seq 128 — one of the reference's own headline
     workloads, docs/benchmarks.rst:44-61). This is the MXU-bound
     number: ≥0.5 on v5e.
+  - `gpt2_mfu`/`gpt2_mfu_dense`/`gpt2_flash_speedup`: the flagship
+    GPT-2-small seq-2048 step, flash (Pallas) vs XLA dense at the SAME
+    shape; `gpt2_long_mfu` at seq 4096 where dense cannot run
+    (`gpt2_long_flops` labels the FLOP-numerator methodology).
+  - `fused_bn_step_ms`/`fused_bn_delta_ms`: the ResNet step with the
+    Pallas fused-BN kernel wired into stage 2 — keeps the wire-or-not
+    question answered by a fresh measurement (docs/benchmarks.md).
   - `scaling_efficiency`: sharding-overhead efficiency, the north-star
     "allreduce scaling efficiency 1->N" trend (docs/benchmarks.rst:11-14
     measures 90% for ResNet on 512 GPUs). On a single host this is
     measured on an 8-virtual-device CPU mesh as t(1 device, batch B) /
     t(8 devices, same B): identical total compute on the same silicon,
-    so any drop is the cost the GSPMD collectives add. Median of
-    `--scaling-reps` independent probe pairs; `scaling_spread` is the
-    (max-min)/median across reps. With >=2 real chips visible, a true
-    weak-scaling sweep runs instead.
+    so any drop is the cost the GSPMD collectives add. Median over
+    `--scaling-reps` order-statistic-paired probe samples;
+    `scaling_spread` is the (max-min)/median across them and
+    `scaling_samples` carries the raw per-rep seconds (+ the
+    index-paired spread) for diagnosis. With >=2 real chips visible, a
+    true weak-scaling sweep runs instead.
 
 The training loop is a `lax.scan` over steps inside one jit (chunked),
 so steps dispatch on-device back-to-back with no host round-trip
