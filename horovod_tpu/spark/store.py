@@ -9,9 +9,12 @@ there, ref: horovod/spark/common/util.py prepare_data).
 
 Here `LocalStore` covers any locally-mounted filesystem (POSIX path or
 ``file://`` URL — on TPU-VMs GCS typically arrives via gcsfuse mounts,
-so a mounted path is the common case). A true ``hdfs://``/``gs://``
-client layer is deliberately out of scope; `Store.create` says so
-explicitly rather than failing downstream.
+so a mounted path is the common case); `FilesystemStore` generalizes
+the same store over any `pyarrow.fs.FileSystem`, and `HDFSStore`
+(r5) rides it via `pyarrow.fs.HadoopFileSystem` with the reference's
+URL forms. ``gs://``/``s3://`` client layers remain out of scope —
+`Store.create` says so explicitly rather than failing downstream
+(mount, or hand `FilesystemStore` a pyarrow filesystem).
 """
 from __future__ import annotations
 
