@@ -1,0 +1,57 @@
+"""Adasum delta-model training with Keras under a TRACED model.fit.
+
+`DistributedOptimizer(op=hvd.Adasum)` applies the LOCAL optimizer step
+and Adasum-combines the weight deltas (VHDD) — the reference's
+delta-model optimizer, not a gradient allreduce (ref:
+horovod/tensorflow/__init__.py:334-428; docs/adasum.md). With
+`backward_passes_per_step=k` the combine fires every k-th batch, and
+the schedule is IN-GRAPH (`_is_comm_step` pattern), so it survives a
+compiled `model.fit` — no `run_eagerly=True` needed. No lr rescaling
+with world size is needed: Adasum is scale-insensitive.
+
+Run:  hvdrun -np 2 python examples/keras_adasum_delta.py
+(power-of-2 world sizes only — the VHDD ladder requires it)
+"""
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    hvd.init()
+    keras.utils.set_random_seed(0)
+
+    model = keras.Sequential([
+        keras.Input((8,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    # Local Adam steps every batch; delta-combine every 2nd batch.
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.Adam(1e-2), op=hvd.Adasum,
+        backward_passes_per_step=2,
+    )
+    model.compile(optimizer=opt, loss="mse")  # traced train_step
+
+    rng = np.random.RandomState(hvd.rank())  # rank-local data
+    X = rng.randn(256, 8).astype(np.float32)
+    W = np.linspace(-1, 1, 8).astype(np.float32)
+    Y = (X @ W)[:, None]
+
+    # Broadcast BEFORE the first step, not via the batch-0 callback:
+    # the Adasum wrapper captures its delta baseline (start = var) at
+    # the FIRST apply(), so ranks must already hold identical weights
+    # there — a post-batch broadcast would leave each rank's baseline
+    # at its own pre-broadcast values and the combines would diverge
+    # (docs/adasum.md).
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    hist = model.fit(X, Y, epochs=10, batch_size=64, verbose=0)
+    if hvd.rank() == 0:
+        losses = hist.history["loss"]
+        print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+              f"over {len(losses)} epochs on {hvd.size()} ranks")
+
+
+if __name__ == "__main__":
+    main()
