@@ -175,7 +175,8 @@ def _make_scan_step(step_fn, mesh, chunk: int):
         return jax.lax.scan(body, state, None, length=chunk)
 
     def run(state, inputs, labels):
-        with jax.sharding.set_mesh(mesh):
+        from horovod_tpu.utils.compat import set_mesh as _set_mesh
+        with _set_mesh(mesh):
             return multi(state, inputs, labels)
 
     return run

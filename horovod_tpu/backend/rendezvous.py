@@ -14,6 +14,8 @@ import http.client
 import time
 from typing import Optional
 
+from ..utils.retry import call_with_retry
+
 
 class RendezvousClient:
     def __init__(self, addr: str, port: int, timeout: float = 60.0,
@@ -30,6 +32,16 @@ class RendezvousClient:
     def _conn(self) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.addr, self.port, timeout=10.0)
 
+    def _retry(self, fn, what: str):
+        """KV requests retry transient transport failures (refused while
+        the server restarts mid-elastic-reset, reset, timeout) with
+        exponential backoff + jitter; HTTP-level rejections (403 etc.)
+        are NOT transport failures and propagate immediately."""
+        return call_with_retry(
+            fn, what,
+            retry_on=(OSError, http.client.HTTPException),
+        )
+
     def _headers(self, method: str, path: str, body: bytes = b"") -> dict:
         if self.secret_key is None:
             return {}
@@ -39,36 +51,42 @@ class RendezvousClient:
         return {"X-Horovod-Digest": digest, "X-Horovod-Timestamp": ts}
 
     def put(self, scope: str, key: str, value: bytes):
-        c = self._conn()
-        path = f"/{scope}/{key}"
-        try:
-            c.request("PUT", path, body=value,
-                      headers=self._headers("PUT", path, value))
-            r = c.getresponse()
-            r.read()
-            if r.status != 200:
-                raise RuntimeError(f"rendezvous PUT failed: {r.status}")
-        finally:
-            c.close()
+        def _put():
+            c = self._conn()
+            path = f"/{scope}/{key}"
+            try:
+                c.request("PUT", path, body=value,
+                          headers=self._headers("PUT", path, value))
+                r = c.getresponse()
+                r.read()
+                if r.status != 200:
+                    raise RuntimeError(f"rendezvous PUT failed: {r.status}")
+            finally:
+                c.close()
+
+        self._retry(_put, f"rendezvous PUT {scope}/{key}")
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        c = self._conn()
-        path = f"/{scope}/{key}"
-        try:
-            c.request("GET", path, headers=self._headers("GET", path))
-            r = c.getresponse()
-            body = r.read()
-            if r.status == 200:
-                return body
-            if r.status == 403:
-                raise PermissionError(
-                    "rendezvous rejected request: "
-                    + (r.getheader("X-Horovod-Reject-Reason")
-                       or "bad or missing HOROVOD_SECRET_KEY digest")
-                )
-            return None
-        finally:
-            c.close()
+        def _get():
+            c = self._conn()
+            path = f"/{scope}/{key}"
+            try:
+                c.request("GET", path, headers=self._headers("GET", path))
+                r = c.getresponse()
+                body = r.read()
+                if r.status == 200:
+                    return body
+                if r.status == 403:
+                    raise PermissionError(
+                        "rendezvous rejected request: "
+                        + (r.getheader("X-Horovod-Reject-Reason")
+                           or "bad or missing HOROVOD_SECRET_KEY digest")
+                    )
+                return None
+            finally:
+                c.close()
+
+        return self._retry(_get, f"rendezvous GET {scope}/{key}")
 
     def wait_get(self, scope: str, key: str) -> bytes:
         """Poll until the key exists (peers registering)."""

@@ -13,17 +13,29 @@ Gather/Bcast, ref: mpi_controller.cc:108-199); the data-plane algorithms
 come from StarCollectivesMixin. On TPU hardware the data plane is
 XLA/ICI — this path serves CPU process-mode and tests; the C++ engine
 (horovod_tpu/cc) supersedes it for performance.
+
+Fault tolerance (docs/fault_tolerance.md): every peer send/recv is
+bounded (HOROVOD_TCP_TIMEOUT_SECONDS, polled so dead-peer FINs are
+seen even when unbounded), connects retry with backoff + jitter, and
+any transport failure is translated to TransportError — the
+HorovodInternalError subclass the elastic contract keys on — with the
+failed connection hard-closed so later ops fail fast. The
+HOROVOD_FAULT_INJECT chaos harness (common/fault_injection.py) hooks
+the same choke points.
 """
 from __future__ import annotations
 
 import os
 import socket
 import struct
+import time
 from typing import Dict, List, Optional
 
-from ..common.exceptions import HorovodInternalError
+from ..common import fault_injection
+from ..common.exceptions import HorovodInternalError, TransportError
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
+from ..utils.retry import call_with_retry
 from .rendezvous import RendezvousClient
 from .ring import RingCollectivesMixin
 
@@ -51,6 +63,46 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
+def _recv_exact_bounded(sock: socket.socket, n: int,
+                        timeout: float, poll: float) -> bytes:
+    """Bounded recv: polls at `poll` granularity instead of blocking
+    forever, so a dead peer is detected within `timeout` seconds of its
+    last byte (or, if timeout == 0, the moment the OS delivers its
+    FIN/RST — a process that dies, even via SIGKILL, still gets its
+    sockets closed by the kernel). The deadline is an IDLE bound that
+    resets on every received chunk, not a total-transfer bound: a live
+    peer legitimately streaming a large payload for longer than the
+    timeout must not be declared dead mid-transfer. This is the
+    heartbeat the reference gets from gloo's timeout-bounded transports
+    (ref: gloo store/ioTimeout)."""
+    buf = bytearray()
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    prev = sock.gettimeout()
+    sock.settimeout(poll)
+    try:
+        while len(buf) < n:
+            try:
+                chunk = sock.recv(n - len(buf))
+            except (socket.timeout, TimeoutError):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"recv made no progress for {timeout:.1f}s "
+                        f"(HOROVOD_TCP_TIMEOUT_SECONDS)"
+                    ) from None
+                continue
+            if not chunk:
+                raise ConnectionError("peer closed connection")
+            buf.extend(chunk)
+            if deadline is not None:
+                deadline = time.monotonic() + timeout
+        return bytes(buf)
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:  # pragma: no cover - socket already dead
+            pass
+
+
 class TcpBackend(RingCollectivesMixin):
     """Full-mesh sockets; rank 0 doubles as the coordinator."""
 
@@ -68,6 +120,10 @@ class TcpBackend(RingCollectivesMixin):
             # topology epoch (stale peer addresses must not be reused).
             scope = env_cfg.get_str(env_cfg.MESH_SCOPE, "hvd_mesh")
         self.peers: Dict[int, socket.socket] = {}
+        # Data-plane I/O bounds + chaos hooks (docs/fault_tolerance.md).
+        self._timeout = env_cfg.tcp_timeout_seconds()
+        self._poll = env_cfg.tcp_poll_seconds()
+        self._injector = fault_injection.get_injector()
         if size == 1:
             return
         if rendezvous is None:
@@ -88,6 +144,24 @@ class TcpBackend(RingCollectivesMixin):
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(("0.0.0.0", 0))
         listener.listen(self.size)
+        try:
+            self._bootstrap_mesh(scope, listener)
+        except (OSError, TimeoutError) as exc:
+            # Any transport failure the inner paths did not already
+            # translate (rendezvous down past the retry budget, a peer
+            # dying mid-identification, a stray socket error): honor the
+            # no-raw-ConnectionError contract and drop every fd so
+            # elastic retries cannot accumulate leaks.
+            self._close_all_peers()
+            raise TransportError(
+                f"rank {self.rank}: mesh bootstrap failed: {exc}"
+            ) from exc
+        finally:
+            # Idempotent: the specific error paths (and the success
+            # path) close it themselves.
+            listener.close()
+
+    def _bootstrap_mesh(self, scope: str, listener: socket.socket):
         my_port = listener.getsockname()[1]
         # HOROVOD_MESH_ADDR separates the ADVERTISED address from the
         # slot identity: Spark-task slots carry logical hostnames
@@ -108,12 +182,49 @@ class TcpBackend(RingCollectivesMixin):
         # an indefinite hang (ref: gloo's store_timeout on rendezvous).
         bootstrap_timeout = env_cfg.get_float(
             "HOROVOD_MESH_BOOTSTRAP_TIMEOUT", 300.0)
+        bootstrap_deadline = time.monotonic() + bootstrap_timeout
         for peer in range(self.rank):
             addr = self._rendezvous.wait_get(scope, str(peer)).decode()
             host, port = addr.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            _send_all(s, struct.pack("<i", self.rank))
+            s = None
+            try:
+                self._injector.check_io(self.rank, peer, "connect")
+                # Retry with backoff+jitter: under elastic churn a peer's
+                # listener may briefly refuse between epochs even though
+                # its rendezvous row is live (ref: gloo retries its
+                # connectFullMesh pair dials the same way).
+                s = call_with_retry(
+                    lambda: socket.create_connection(
+                        (host, int(port)),
+                        timeout=min(60.0, bootstrap_timeout)),
+                    what=f"connect to rank {peer} at {addr}",
+                    retry_on=(ConnectionError, socket.timeout, TimeoutError),
+                    deadline=bootstrap_deadline,
+                )
+                # create_connection's timeout sticks to the socket; clear
+                # it (like the accept side does) or every post-bootstrap
+                # send/recv would silently inherit a 60s bound even with
+                # HOROVOD_TCP_TIMEOUT_SECONDS=0 (unbounded).
+                s.settimeout(None)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # The identification frame must be inside the translate+
+                # cleanup scope too: a peer that accepts then dies sends
+                # RST here, and a raw ConnectionResetError would both
+                # skip elastic recovery and leak every socket opened so
+                # far on this retry.
+                _send_all(s, struct.pack("<i", self.rank))
+            except (OSError, TimeoutError) as exc:
+                listener.close()
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                self._close_all_peers()
+                raise TransportError(
+                    f"rank {self.rank}: cannot connect to rank {peer} at "
+                    f"{addr}: {exc}"
+                ) from exc
             self.peers[peer] = s
         listener.settimeout(bootstrap_timeout)
         for _ in range(self.rank + 1, self.size):
@@ -141,20 +252,87 @@ class TcpBackend(RingCollectivesMixin):
                 # Elastic retries catch HorovodInternalError and re-init;
                 # abandoned sockets must not accumulate across retries.
                 listener.close()
-                for p in self.peers.values():
-                    try:
-                        p.close()
-                    except OSError:
-                        pass
-                self.peers.clear()
+                self._close_all_peers()
                 raise HorovodInternalError(
                     f"rank {self.rank}: mesh bootstrap timed out after "
                     f"{bootstrap_timeout:.0f}s waiting for rank(s) "
                     f"{missing} to connect (HOROVOD_MESH_BOOTSTRAP_TIMEOUT)"
                 )
+            except OSError:
+                # A peer that connected then died mid-identification
+                # (RST during elastic churn). Close the orphan socket
+                # here — _connect_full_mesh's outer handler cleans up
+                # the rest and translates to TransportError.
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                raise
             self.peers[peer] = s
         listener.close()
         logger.debug("rank %d: TCP mesh connected (%d peers)", self.rank, len(self.peers))
+
+    # ------------------------------------------------------------------
+    # bounded, chaos-aware peer I/O. Every byte to or from a peer flows
+    # through _peer_send/_peer_recv: fault-injection verdicts apply, any
+    # OSError (dead peer, refused, reset) or deadline overrun is
+    # translated to TransportError — the HorovodInternalError subclass
+    # that triggers elastic restore — and the failed socket is hard-
+    # closed so later ops on it fail fast instead of re-hanging.
+    def _peer_sock(self, peer: int) -> socket.socket:
+        s = self.peers.get(peer)
+        if s is None:
+            raise TransportError(
+                f"rank {self.rank}: connection to peer {peer} is down "
+                f"(severed by an earlier transport failure)"
+            )
+        return s
+
+    def _sever(self, peer: int):
+        s = self.peers.pop(peer, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:  # pragma: no cover - already dead
+                pass
+
+    def _peer_send(self, peer: int, data: bytes):
+        sock = self._peer_sock(peer)
+        try:
+            if self._injector.active:
+                if (self._injector.check_io(self.rank, peer, "send")
+                        == fault_injection.DROP):
+                    return
+            if self._timeout > 0:
+                sock.settimeout(self._timeout)
+            try:
+                _send_all(sock, data)
+            finally:
+                if self._timeout > 0:
+                    try:
+                        sock.settimeout(None)
+                    except OSError:
+                        pass
+        except (OSError, TimeoutError) as exc:
+            self._sever(peer)
+            raise TransportError(
+                f"rank {self.rank}: send to peer {peer} failed: {exc}"
+            ) from exc
+
+    def _peer_recv(self, peer: int) -> bytes:
+        sock = self._peer_sock(peer)
+        try:
+            if self._injector.active:
+                self._injector.check_io(self.rank, peer, "recv")
+            (n,) = _LEN.unpack(
+                _recv_exact_bounded(sock, 8, self._timeout, self._poll))
+            return _recv_exact_bounded(sock, n, self._timeout, self._poll)
+        except (OSError, TimeoutError) as exc:
+            self._sever(peer)
+            raise TransportError(
+                f"rank {self.rank}: recv from peer {peer} failed: {exc}"
+            ) from exc
 
     # ------------------------------------------------------------------
     # transport primitives
@@ -164,9 +342,9 @@ class TcpBackend(RingCollectivesMixin):
         if self.rank == 0:
             out = [payload]
             for r in range(1, self.size):
-                out.append(_recv_frame(self.peers[r]))
+                out.append(self._peer_recv(r))
             return out
-        _send_all(self.peers[0], payload)
+        self._peer_send(0, payload)
         return None
 
     def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
@@ -176,9 +354,9 @@ class TcpBackend(RingCollectivesMixin):
         if self.rank == 0:
             assert payload is not None
             for r in range(1, self.size):
-                _send_all(self.peers[r], payload)
+                self._peer_send(r, payload)
             return payload
-        return _recv_frame(self.peers[0])
+        return self._peer_recv(0)
 
     def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
         if self.size == 1:
@@ -187,22 +365,25 @@ class TcpBackend(RingCollectivesMixin):
         if self.rank == 0:
             assert payloads is not None
             for r in range(1, self.size):
-                _send_all(self.peers[r], payloads[r])
+                self._peer_send(r, payloads[r])
             return payloads[0]
-        return _recv_frame(self.peers[0])
+        return self._peer_recv(0)
 
     # ------------------------------------------------------------------
     def send_to(self, peer: int, payload: bytes):
         """Point-to-point framed send (ring data plane primitive)."""
-        _send_all(self.peers[peer], payload)
+        self._peer_send(peer, payload)
 
     def recv_from(self, peer: int) -> bytes:
-        return _recv_frame(self.peers[peer])
+        return self._peer_recv(peer)
 
-    def shutdown(self):
+    def _close_all_peers(self):
         for s in self.peers.values():
             try:
                 s.close()
             except OSError:
                 pass
         self.peers.clear()
+
+    def shutdown(self):
+        self._close_all_peers()

@@ -8,6 +8,15 @@ class HorovodInternalError(RuntimeError):
     (ref: horovod/common/exceptions.py:17-22)."""
 
 
+class TransportError(HorovodInternalError):
+    """A data/control-plane transport failure (peer died, socket timed
+    out, rendezvous unreachable past retries). Subclass of
+    HorovodInternalError so the elastic run loop's catch — and every
+    public API contract — sees exactly the collective-failure signal;
+    the distinct type lets tests and tooling assert the *transport*
+    layer did the translating (no raw ConnectionError may escape)."""
+
+
 class HostsUpdatedInterrupt(RuntimeError):
     """Raised when the set of hosts changed mid-training; the current batch
     result is still valid, so state is committed rather than restored

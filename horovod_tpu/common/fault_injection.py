@@ -1,0 +1,254 @@
+"""Deterministic fault-injection harness for the CPU data plane.
+
+The elastic contract (exceptions.py:4-9) is only as good as the failure
+modes that exercise it. This module makes chaos a first-class, testable
+input: the transports call the hooks below at every connect/send/recv,
+and a training loop (or the chaos smoke script) advances a step counter
+— so "sever rank 2's link to rank 0 after 3 frames" or "kill rank 1 at
+step 5" is a deterministic scenario, not a flaky race.
+
+Two configuration surfaces, mirroring the reference's env-first style
+(HOROVOD_* knobs; common.h:64-90):
+
+* env var ``HOROVOD_FAULT_INJECT`` — a ';'-separated rule list parsed at
+  first use, e.g.::
+
+      HOROVOD_FAULT_INJECT="kill:step=5"
+      HOROVOD_FAULT_INJECT="sever:peer=0:after=3;delay:peer=2:secs=0.2"
+
+* the programmatic API — ``install(rules)`` / ``add_rule(...)`` /
+  ``clear()`` for unit tests.
+
+Rule actions:
+
+``kill``    ``os._exit(1)`` when the step counter reaches ``step=N``
+            (``advance_step()`` is the trigger point — the worker's
+            training loop calls it once per batch).
+``sever``   raise + hard-close the connection on the Nth I/O with
+            ``peer=P`` (``after=K`` frames, default 0 = immediately).
+``drop``    silently swallow sends to ``peer=P`` (the peer then hangs
+            until its recv timeout — exercises bounded-time detection).
+``delay``   sleep ``secs=S`` before I/O with ``peer=P``.
+
+Every rule may carry ``rank=R`` so one job-wide env var can target a
+single rank, and ``op=connect|send|recv`` to confine it to one hook
+(default: send+recv for sever/drop/delay).
+
+The harness is a no-op singleton when no rules are installed — the
+hooks cost one attribute check on the hot path.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+ENV_VAR = "HOROVOD_FAULT_INJECT"
+
+# Hook verdicts (sever is raised, not returned)
+PASS = "pass"
+DROP = "drop"
+
+
+class InjectedFault(ConnectionError):
+    """Raised by a sever rule; transports translate it like any other
+    transport failure (→ TransportError → elastic recovery)."""
+
+
+@dataclass
+class Rule:
+    action: str                       # kill | sever | drop | delay
+    peer: Optional[int] = None        # None = any peer
+    rank: Optional[int] = None        # None = any rank
+    op: Optional[str] = None          # connect | send | recv | None=both
+    after: int = 0                    # fire from the Nth matching I/O on
+    step: Optional[int] = None        # kill trigger
+    secs: float = 0.0                 # delay duration
+    # mutable state: matching-I/O counter per rule
+    hits: int = field(default=0, compare=False)
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    """Parse the ``HOROVOD_FAULT_INJECT`` rule grammar."""
+    rules: List[Rule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        action = fields[0].strip().lower()
+        if action not in ("kill", "sever", "drop", "delay"):
+            raise ValueError(f"unknown fault action {action!r} in {part!r}")
+        kw: Dict[str, str] = {}
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(f"bad fault field {f!r} in {part!r}")
+            k, v = f.split("=", 1)
+            kw[k.strip()] = v.strip()
+        rule = Rule(action=action)
+        if "peer" in kw:
+            rule.peer = int(kw["peer"])
+        if "rank" in kw:
+            rule.rank = int(kw["rank"])
+        if "op" in kw:
+            if kw["op"] not in ("connect", "send", "recv"):
+                raise ValueError(f"bad fault op {kw['op']!r}")
+            rule.op = kw["op"]
+        if action == "drop" and kw.get("op") not in (None, "send"):
+            # A recv cannot be "dropped" — the bytes either arrive or
+            # they don't. Reject instead of silently arming a no-op.
+            raise ValueError(
+                f"drop rules apply to sends only (got op={kw['op']!r})"
+            )
+        if "after" in kw:
+            rule.after = int(kw["after"])
+        if "step" in kw:
+            rule.step = int(kw["step"])
+        if "secs" in kw:
+            rule.secs = float(kw["secs"])
+        if rule.action == "kill" and rule.step is None:
+            raise ValueError(f"kill rule needs step=N: {part!r}")
+        if rule.action == "delay" and rule.secs <= 0:
+            raise ValueError(f"delay rule needs secs=S: {part!r}")
+        rules.append(rule)
+    return rules
+
+
+class FaultInjector:
+    """Process-wide injector; see module docstring for the rule model."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[Rule] = []
+        self._step = 0
+        self._env_loaded = False
+        # Fast-path flag: hooks bail on a single read when inactive.
+        self.active = False
+
+    # -- configuration -------------------------------------------------
+    def _load_env(self):
+        if self._env_loaded:
+            return
+        self._env_loaded = True
+        spec = os.environ.get(ENV_VAR, "")
+        if spec:
+            self._rules.extend(parse_spec(spec))
+            self.active = True
+            logger.warning("fault injection armed: %s", spec)
+
+    def install(self, rules: List[Rule]):
+        with self._lock:
+            self._env_loaded = True  # explicit install overrides env
+            self._rules = list(rules)
+            self._step = 0
+            self.active = bool(self._rules)
+
+    def add_rule(self, rule: Rule):
+        with self._lock:
+            self._env_loaded = True
+            self._rules.append(rule)
+            self.active = True
+
+    def clear(self):
+        with self._lock:
+            self._rules = []
+            self._step = 0
+            self._env_loaded = True
+            self.active = False
+
+    def reload_env(self):
+        """Re-read HOROVOD_FAULT_INJECT (tests mutate the env)."""
+        with self._lock:
+            self._rules = []
+            self._step = 0
+            self._env_loaded = False
+            self._load_env()
+            self.active = bool(self._rules)
+
+    # -- triggers --------------------------------------------------------
+    def advance_step(self) -> int:
+        """Advance the worker step counter; fires any armed kill rule.
+        Called by training loops (and the chaos smoke worker) once per
+        batch so worker death is deterministic in *steps*, not seconds."""
+        if not self.active:
+            return 0
+        with self._lock:
+            self._load_env()
+            self._step += 1
+            step = self._step
+            for r in self._rules:
+                if r.action == "kill" and r.step is not None and step >= r.step:
+                    logger.error("fault injection: killing worker at step %d",
+                                 step)
+                    # os._exit: no atexit/finally — the closest analogue
+                    # of a SIGKILLed or OOM-killed worker that still lets
+                    # the OS send FIN on its sockets.
+                    os._exit(1)
+        return step
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def check_io(self, rank: int, peer: int, op: str) -> str:
+        """Hook for a transport about to do `op` ('connect'|'send'|'recv')
+        with `peer`. Returns PASS or DROP; raises InjectedFault for a
+        sever (the caller hard-closes the connection and translates)."""
+        if not self.active:
+            return PASS
+        with self._lock:
+            self._load_env()
+            verdict = PASS
+            for r in self._rules:
+                if r.action == "kill":
+                    continue
+                if r.rank is not None and r.rank != rank:
+                    continue
+                if r.peer is not None and r.peer != peer:
+                    continue
+                if r.op is not None:
+                    if r.op != op:
+                        continue
+                elif op == "connect":
+                    # sever/drop/delay default to data-plane I/O only
+                    continue
+                elif r.action == "drop" and op != "send":
+                    # Drop is send-only; a recv must not advance its hit
+                    # counter either, or `after=K` would fire early.
+                    continue
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.action == "delay":
+                    # Sleep outside the lock? Delay rules are test-only
+                    # and short; holding the lock keeps ordering exact.
+                    time.sleep(r.secs)
+                elif r.action == "drop":
+                    verdict = DROP
+                elif r.action == "sever":
+                    raise InjectedFault(
+                        f"fault injection severed rank {rank} <-> peer "
+                        f"{peer} ({op})"
+                    )
+            return verdict
+
+
+# The process-wide singleton the transports consult.
+injector = FaultInjector()
+
+
+def get_injector() -> FaultInjector:
+    with injector._lock:
+        injector._load_env()
+    return injector
+
+
+def advance_step() -> int:
+    """Module-level convenience for training loops: one call per batch."""
+    return get_injector().advance_step()

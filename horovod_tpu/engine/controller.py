@@ -240,8 +240,16 @@ class Controller:
                     self.joined_ranks.clear()
                 new_responses = [self._construct_response(n) for n in ready_names]
                 negotiated.extend(self._fuse_responses(new_responses))
-                if self.stall_inspector.check():
+                stall_reason = self.stall_inspector.check()
+                if stall_reason:
                     shutdown = True
+                    # Tensor-less ERROR response: carries the stall
+                    # diagnosis to every rank inside the existing wire
+                    # format; the engine finalizes ALL pending handles
+                    # with it (engine.py _run_loop_once).
+                    negotiated.append(Response(
+                        ResponseType.ERROR, [], error_message=stall_reason
+                    ))
                 # Broadcast only the negotiated responses; every rank
                 # prepends its (identical) cached fast-path list locally.
                 self.transport.bcast_bytes(

@@ -209,6 +209,15 @@ class Engine:
             self.op_manager = build_default(self.backend)
             while self._run_loop_once():
                 pass
+        except HorovodInternalError as e:
+            # Transport death (peer gone, socket timeout) or injected
+            # fault: the mesh is unusable, so EVERY pending handle —
+            # and every enqueue from here on — fails with this reason,
+            # unblocking all framework threads into elastic recovery at
+            # once (ref: the reference's ShutDown → callbacks-with-
+            # status path, operations.cc:300-330).
+            logger.error("background loop failed: %s", e)
+            self.tensor_queue.finalize(Status.Aborted(str(e)))
         except BaseException as e:
             logger.error("background loop failed: %s", e)
             self.tensor_queue.finalize(Status.UnknownError(str(e)))
@@ -264,7 +273,16 @@ class Engine:
         for resp in resp_list.responses:
             self._perform_operation(resp)
         if should_shutdown:
-            self.tensor_queue.finalize(Status.Aborted("Horovod has been shut down."))
+            # A stall-inspector abort rides the shutdown broadcast as a
+            # tensor-less ERROR response; its diagnosis becomes the
+            # failure reason every pending handle sees (on every rank,
+            # not just the coordinator that detected the stall).
+            reason = "Horovod has been shut down."
+            for resp in resp_list.responses:
+                if (resp.response_type == ResponseType.ERROR
+                        and not resp.tensor_names and resp.error_message):
+                    reason = resp.error_message
+            self.tensor_queue.finalize(Status.Aborted(reason))
             return False
         return True
 
@@ -326,6 +344,16 @@ class Engine:
                     self._finish(
                         e, Status.UnknownError(f"bad response {resp.response_type}"), None
                     )
+        except HorovodInternalError as exc:
+            # Transport failure mid-collective: fail the in-flight
+            # entries, then re-raise so the background loop dies and
+            # finalizes every OTHER pending handle with the same error —
+            # a broken mesh can't serve the next response either, and
+            # leaving those handles parked would hang their waiters.
+            status = Status.Aborted(str(exc))
+            for e in entries:
+                self._finish(e, status, None)
+            raise
         except Exception as exc:
             for e in entries:
                 self._finish(e, Status.UnknownError(str(exc)), None)

@@ -8,7 +8,7 @@ aborts after HOROVOD_STALL_SHUTDOWN_TIME_SECONDS.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
@@ -39,19 +39,25 @@ class StallInspector:
         self.pending.pop(name, None)
         self.warned.discard(name)
 
-    def check(self) -> bool:
-        """Returns True if the job should abort (stall past shutdown time)."""
+    def check(self) -> Optional[str]:
+        """Returns the abort reason when the job should shut down (a
+        tensor stalled past HOROVOD_STALL_SHUTDOWN_TIME_SECONDS), else
+        None. Truthy-on-abort keeps the old boolean contract; the reason
+        string rides the coordinator's shutdown broadcast so EVERY
+        rank's pending handles fail with the stall diagnosis — the same
+        HorovodInternalError path a transport death takes — instead of a
+        generic 'shut down' message only rank 0 can explain."""
         if not self.enabled:
-            return False
+            return None
         now = time.monotonic()
         if now - self.last_check < min(self.warning_time, 10.0):
-            return False
+            return None
         self.last_check = now
-        abort = False
+        abort: Optional[str] = None
         for name, (t0, ready) in self.pending.items():
             age = now - t0
+            missing = sorted(set(range(self.size)) - ready)
             if age > self.warning_time and name not in self.warned:
-                missing = sorted(set(range(self.size)) - ready)
                 logger.warning(
                     "One or more tensors were submitted to be reduced/gathered "
                     "but were not ready on all ranks for %.0fs. Stalled op: %s "
@@ -61,5 +67,10 @@ class StallInspector:
                 self.warned.add(name)
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 logger.error("Stall shutdown time exceeded for %s; aborting.", name)
-                abort = True
+                if abort is None:
+                    abort = (
+                        f"stall shutdown: op {name} waited {age:.0f}s "
+                        f"(> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                        f"{self.shutdown_time:.0f}) for rank(s) {missing}"
+                    )
         return abort

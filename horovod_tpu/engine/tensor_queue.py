@@ -38,9 +38,18 @@ class TensorQueue:
         self._lock = threading.Lock()
         self._tensor_table: Dict[str, TensorTableEntry] = {}
         self._message_queue: List[Request] = []
+        # Set by finalize(): the engine died (transport failure, stall
+        # abort, shutdown). Enqueues after that point fail IMMEDIATELY
+        # with the terminal status instead of parking an entry no
+        # background loop will ever pop — without this, the first
+        # collective after a worker death hangs forever even though the
+        # failure was already detected.
+        self._final_status: Optional[Status] = None
 
     def add_to_tensor_queue(self, entry: TensorTableEntry, request: Request) -> Status:
         with self._lock:
+            if self._final_status is not None:
+                return self._final_status
             if entry.tensor_name in self._tensor_table:
                 return Status.InvalidArgument(DUPLICATE_NAME_ERROR)
             self._tensor_table[entry.tensor_name] = entry
@@ -79,8 +88,14 @@ class TensorQueue:
             return len(self._tensor_table)
 
     def finalize(self, status: Status):
-        """Abort all pending entries (ref: tensor_queue.cc FinalizeTensorQueue)."""
+        """Abort ALL pending entries with `status` and latch it as the
+        terminal state (ref: tensor_queue.cc FinalizeTensorQueue). Every
+        handle a framework thread is waiting on — not just the op that
+        hit the failure — fails with the same reason, so N threads
+        blocked on N tensors all unblock into the elastic recovery path
+        at once."""
         with self._lock:
+            self._final_status = status
             for e in self._tensor_table.values():
                 if e.callback:
                     e.callback(status, None)

@@ -245,6 +245,31 @@ def _make_adasum_optimizer(optimizer, name, device_dense, device_sparse,
                 self._hvd_iter = tf.Variable(
                     0, dtype=tf.int64, trainable=False
                 )
+                # Adasum delta-baseline trap (docs/adasum.md): each rank
+                # combines (var - start), so ranks reaching this first
+                # apply with non-identical weights (e.g. a broadcast
+                # deferred to a batch-0 callback that hasn't fired)
+                # would anchor divergent baselines and silently drift
+                # forever. Broadcasting the baseline itself from rank 0
+                # makes the anchor rank-identical by construction. The
+                # in-graph iter==0 gate keeps it ONE broadcast even
+                # under tf.function, where this creation block is baked
+                # into the first concrete trace and would otherwise
+                # re-broadcast every step of that trace. (No init_scope:
+                # the variables' lifted initializers haven't run at
+                # trace time, so an eager read here would see
+                # uninitialized storage. All ranks share the counter
+                # trajectory, so the branches stay collectively
+                # aligned, like the k-schedule below.)
+                def _sync_baseline():
+                    broadcast_variables(self._hvd_start, root_rank=0)
+                    return tf.constant(True)
+
+                tf.cond(
+                    tf.equal(self._hvd_iter, 0),
+                    _sync_baseline,
+                    lambda: tf.constant(False),
+                )
             result = cls.apply(self, grads, trainable_variables)
             it = self._hvd_iter.assign_add(1)
             if k <= 1:

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compat import get_abstract_mesh as _get_abstract_mesh
+
 Dtype = Any
 
 default_kernel_init = nn.initializers.normal(stddev=0.02)
@@ -142,7 +144,7 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
         # (batch, head), so no collectives are needed inside.
         from ..ops.flash_attention import flash_attention
 
-        am = jax.sharding.get_abstract_mesh()
+        am = _get_abstract_mesh()
         manual = [
             ax for ax in ("dp", "tp") if am is not None
             and ax in am.axis_names and am.shape[ax] > 1
@@ -176,7 +178,7 @@ def _attention_dispatch(cfg: TransformerConfig, q, k, v, mask):
         return fn(q, k, v, mask).astype(cfg.dtype)
     if cfg.attn_impl not in ("ring", "ulysses"):
         return _dense_attention_masked(cfg, q, k, v, mask)
-    am = jax.sharding.get_abstract_mesh()
+    am = _get_abstract_mesh()
     if am is None or cfg.sp_axis not in am.axis_names \
             or am.shape[cfg.sp_axis] == 1:
         return _dense_attention_masked(cfg, q, k, v, mask)

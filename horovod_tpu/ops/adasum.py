@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils.compat import axis_size as _axis_size
+
 
 def _combine(a, b):
     """The Adasum pair combination (ref: adasum.h:100-140)."""
@@ -46,7 +48,7 @@ def _combine(a, b):
 
 def adasum_allreduce(tensor, axis_name: str):
     """Adasum over a named mesh axis; axis size must be a power of two."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n & (n - 1) != 0:
         raise ValueError(
             f"Adasum requires a power-of-2 axis size, got {n} "

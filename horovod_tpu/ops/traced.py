@@ -24,6 +24,8 @@ from jax import lax
 
 from ..common.types import ReduceOp
 
+from ..utils.compat import axis_size as _axis_size
+
 
 def _scale(x, factor):
     if factor is None or factor == 1.0:
@@ -53,7 +55,7 @@ def allreduce(
     if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
         out = lax.psum(x, axis_name)
         if op == ReduceOp.AVERAGE:
-            n = lax.axis_size(axis_name)
+            n = _axis_size(axis_name)
             out = _scale(out, 1.0 / n)
     elif op == ReduceOp.MIN:
         out = lax.pmin(x, axis_name)
@@ -144,7 +146,7 @@ def alltoall(tensor, axis_name: str, split_axis: int = 0, concat_axis: int = 0):
     206-256). The leading dim must be divisible by the axis size; uneven
     splits are an eager-engine feature (dynamic shapes don't jit).
     This is the MoE dispatch / Ulysses sequence-exchange primitive."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if tensor.shape[split_axis] % n != 0:
         raise ValueError(
             f"alltoall under jit requires dim {split_axis} divisible by axis size {n}"
@@ -162,7 +164,7 @@ def reducescatter(tensor, axis_name: str, op: ReduceOp = ReduceOp.SUM):
         raise ValueError("reducescatter supports SUM/AVERAGE")
     out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
     if op == ReduceOp.AVERAGE:
-        out = out / lax.axis_size(axis_name)
+        out = out / _axis_size(axis_name)
     return out
 
 
@@ -192,7 +194,7 @@ def hierarchical_allreduce(
     x = _scale(tensor, prescale_factor)
     orig_shape = x.shape
     flat = jnp.ravel(x)
-    n_inner = lax.axis_size(inner_axis)
+    n_inner = _axis_size(inner_axis)
     pad = (-flat.size) % n_inner
     if pad:
         flat = jnp.pad(flat, (0, pad))
@@ -203,7 +205,7 @@ def hierarchical_allreduce(
         full = full[: flat.size - pad]
     out = jnp.reshape(full, orig_shape)
     if op == ReduceOp.AVERAGE:
-        total = lax.axis_size(inner_axis) * lax.axis_size(outer_axis)
+        total = _axis_size(inner_axis) * _axis_size(outer_axis)
         out = _scale(out, 1.0 / total)
     elif op != ReduceOp.SUM:
         raise ValueError("hierarchical_allreduce supports SUM/AVERAGE")

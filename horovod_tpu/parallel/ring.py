@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compat import axis_size as _axis_size
+
 
 def _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale, causal,
                         kmask=None):
@@ -73,7 +75,7 @@ def ring_attention(
     0 = pad; it rotates around the ring with its K/V block. Fully-padded
     query rows produce zeros (their normalizer is clamped), the BERT
     convention — the loss must mask them anyway."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]

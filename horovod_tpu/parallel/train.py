@@ -26,6 +26,8 @@ from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .sharding import DEFAULT_RULES, batch_spec, filter_rules, logical_sharding
+from ..utils.compat import (set_mesh as _set_mesh,
+                            tree_leaves_with_path as _tree_leaves_with_path)
 
 
 @dataclasses.dataclass
@@ -145,8 +147,8 @@ def make_train_step(
         # Build opt-state shardings by structural mapping: any leaf whose
         # shape matches a param leaf gets that param's sharding, else
         # replicated. optax states are pytrees of param-shaped moments.
-        flat_params = jax.tree.leaves_with_path(abstract_params)
-        flat_pshard = jax.tree.leaves_with_path(pshard)
+        flat_params = _tree_leaves_with_path(abstract_params)
+        flat_pshard = _tree_leaves_with_path(pshard)
         pmap_by_path = {
             jax.tree_util.keystr(kp): s
             for (kp, _), (_, s) in zip(flat_params, flat_pshard)
@@ -223,7 +225,7 @@ def make_train_step(
 
     def build(rng, *example_batch):
         model_inputs = example_batch[:1]
-        with jax.sharding.set_mesh(mesh):
+        with _set_mesh(mesh):
             ssh = state_shardings(rng, *model_inputs)
         init_jit = jax.jit(
             lambda r: init_state(r, *model_inputs), out_shardings=ssh
@@ -241,7 +243,7 @@ def make_train_step(
         def with_mesh(fn):
             @functools.wraps(fn)
             def run(*a, **kw):
-                with jax.sharding.set_mesh(mesh):
+                with _set_mesh(mesh):
                     return fn(*a, **kw)
 
             return run

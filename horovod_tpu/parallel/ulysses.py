@@ -17,6 +17,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..utils.compat import axis_size as _axis_size
+
 from .ring import dense_attention
 
 
@@ -39,7 +41,7 @@ def ulysses_attention(
     flash kernel (ops/flash_attention.py) — after the head exchange the
     full sequence is local, exactly the kernel's layout, so the fused
     path composes with sequence parallelism for free."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     H = q.shape[2]
     if H % n != 0:
         raise ValueError(f"n_heads={H} must be divisible by sp={n}")

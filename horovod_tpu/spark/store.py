@@ -418,8 +418,26 @@ class FilesystemStore(LocalStore):
         return self._info(path).type != pafs.FileType.NotFound
 
     def read(self, path: str) -> bytes:
-        with self.fs.open_input_stream(path) as f:
-            return f.read()
+        # Crash recovery: a writer that died between write()'s two moves
+        # leaves the previous (valid) version at <path>.bak and nothing
+        # at <path> — serve the backup rather than failing a resume that
+        # would otherwise find "no checkpoint". Open-first (no
+        # exists-then-open pre-check, which would TOCTOU-race write()'s
+        # rename-aside window) and WITHOUT renaming: a mutating promote
+        # here would race concurrent readers and break read-only
+        # credentials. A reader that loses both races — path moved
+        # aside, then the finished writer already deleted the backup —
+        # retries the canonical path, where the new version now lives.
+        try:
+            with self.fs.open_input_stream(path) as f:
+                return f.read()
+        except FileNotFoundError:
+            try:
+                with self.fs.open_input_stream(f"{path}.bak") as f:
+                    return f.read()
+            except FileNotFoundError:
+                with self.fs.open_input_stream(path) as f:
+                    return f.read()
 
     def write(self, path: str, data: bytes):
         import pyarrow.fs as pafs
@@ -430,14 +448,38 @@ class FilesystemStore(LocalStore):
         # LocalStore.write. HDFS rename does NOT overwrite an existing
         # destination (unlike os.replace / LocalFileSystem.move), so an
         # existing target — e.g. checkpoint.pkl rewritten every epoch —
-        # must be deleted first; single-writer paths make the
-        # delete/move window benign.
+        # is first RENAMED ASIDE to <path>.bak, not deleted: a crash
+        # between the two moves leaves either the backup or the new file
+        # on disk, never zero copies of the only checkpoint (read()
+        # promotes a stranded backup). The backup is removed only after
+        # the new file is in place; a failed promote restores it.
+        # Single-writer paths (per-run checkpoint ownership) make the
+        # fixed backup name safe.
         tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
         with self.fs.open_output_stream(tmp) as f:
             f.write(data)
+        backup = None
         if self._info(path).type == pafs.FileType.File:
-            self.fs.delete_file(path)
-        self.fs.move(tmp, path)
+            backup = f"{path}.bak"
+            if self._info(backup).type == pafs.FileType.File:
+                # Stale backup from an interrupted earlier write; the
+                # live <path> supersedes it.
+                self.fs.delete_file(backup)
+            self.fs.move(path, backup)
+        try:
+            self.fs.move(tmp, path)
+        except BaseException:
+            if backup is not None:
+                try:
+                    self.fs.move(backup, path)
+                except OSError:  # pragma: no cover - double fault
+                    pass
+            raise
+        if backup is not None:
+            try:
+                self.fs.delete_file(backup)
+            except OSError:  # pragma: no cover - benign leak
+                pass
 
     def is_parquet_dataset(self, path: str) -> bool:
         import pyarrow.fs as pafs

@@ -32,6 +32,58 @@ except ImportError:  # pragma: no cover
                               check_rep=check_rep, **kw)
 
 
+import contextlib
+import threading
+
+_ambient = threading.local()
+
+
+def set_mesh(mesh):
+    """Ambient-mesh context (jax >= 0.5 jax.sharding.set_mesh). On older
+    jax, enter the legacy `with mesh:` context AND track the mesh in a
+    thread-local so get_abstract_mesh() below can answer at trace
+    time."""
+    try:
+        return jax.sharding.set_mesh(mesh)
+    except AttributeError:
+        pass
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = getattr(_ambient, "mesh", None)
+        _ambient.mesh = mesh
+        try:
+            with mesh:
+                yield
+        finally:
+            _ambient.mesh = prev
+
+    return _cm()
+
+
+def get_abstract_mesh():
+    """jax >= 0.5 jax.sharding.get_abstract_mesh; on older jax, the
+    abstract mesh of whatever set_mesh() above made ambient (None when
+    nothing is)."""
+    try:
+        return jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        m = getattr(_ambient, "mesh", None)
+        return None if m is None else m.abstract_mesh
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis from inside shard_map/pmap.
+    jax >= 0.5 spells it lax.axis_size; on older versions psum of the
+    literal 1 constant-folds to the same static Python int."""
+    import jax
+
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
 def pvary(x, axis):
     """Mark x as varying over `axis` for shard_map's VMA tracking.
     No-op under check_vma=False (our shard_map default); under VMA
@@ -42,7 +94,13 @@ def pvary(x, axis):
     try:
         return jax.lax.pcast(x, to="varying", axes=axis)
     except (AttributeError, TypeError):
+        pass
+    try:
         return jax.lax.pvary(x, axis)
+    except AttributeError:
+        # jax 0.4.x: no VMA tracking at all (shard_map check_rep=False
+        # is the only mode we use) — the annotation is a true no-op.
+        return x
 
 
 def tree_map(f, *trees):
@@ -59,3 +117,12 @@ def tree_flatten(tree):
 
 def tree_unflatten(treedef, leaves):
     return jax.tree.unflatten(treedef, leaves)
+
+
+def tree_leaves_with_path(tree):
+    """jax >= 0.5 jax.tree.leaves_with_path; older jax spells it
+    jax.tree_util.tree_leaves_with_path."""
+    try:
+        return jax.tree.leaves_with_path(tree)
+    except AttributeError:
+        return jax.tree_util.tree_leaves_with_path(tree)

@@ -46,6 +46,24 @@ ELASTIC = "HOROVOD_ELASTIC"
 # with stale peer addresses.
 MESH_SCOPE = "HOROVOD_MESH_SCOPE"
 
+# -- fault-tolerance knobs (docs/fault_tolerance.md) -------------------
+# Bound on any single socket send/recv on the TCP data plane; 0 (the
+# default) means unbounded, but dead-peer FINs are still detected
+# because the recv loop polls instead of blocking forever.
+TCP_TIMEOUT = "HOROVOD_TCP_TIMEOUT_SECONDS"
+# Poll interval of the bounded recv loop (the heartbeat granularity).
+TCP_POLL = "HOROVOD_TCP_POLL_SECONDS"
+# Connect-time retry budget against peers and the rendezvous KV store:
+# attempts, base backoff (doubles per attempt, +/- 50% jitter), cap.
+CONNECT_ATTEMPTS = "HOROVOD_CONNECT_ATTEMPTS"
+CONNECT_BACKOFF = "HOROVOD_CONNECT_BACKOFF_SECONDS"
+CONNECT_BACKOFF_CAP = "HOROVOD_CONNECT_BACKOFF_CAP_SECONDS"
+
+DEFAULT_TCP_POLL_SECONDS = 1.0
+DEFAULT_CONNECT_ATTEMPTS = 5
+DEFAULT_CONNECT_BACKOFF_SECONDS = 0.1
+DEFAULT_CONNECT_BACKOFF_CAP_SECONDS = 2.0
+
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # ref: operations.cc:432
 DEFAULT_CYCLE_TIME_MS = 5.0  # ref: operations.cc:442
 DEFAULT_CACHE_CAPACITY = 1024  # ref: global_state.h:88
@@ -98,3 +116,27 @@ def cache_enabled() -> bool:
     """HOROVOD_CACHE_CAPACITY=0 disables the response cache
     (ref: operations.cc:455-462)."""
     return cache_capacity() != 0
+
+
+def tcp_timeout_seconds() -> float:
+    """0 = unbounded (the recv loop still polls for dead-peer FINs)."""
+    return get_float(TCP_TIMEOUT, 0.0)
+
+
+def tcp_poll_seconds() -> float:
+    poll = get_float(TCP_POLL, DEFAULT_TCP_POLL_SECONDS)
+    timeout = tcp_timeout_seconds()
+    if timeout > 0:
+        # The poll must subdivide the deadline or a single blocking
+        # recv() could overshoot it.
+        poll = min(poll, max(timeout / 4.0, 0.01))
+    return max(poll, 0.01)
+
+
+def connect_retry_policy() -> "tuple[int, float, float]":
+    """(attempts, base backoff seconds, backoff cap seconds)."""
+    return (
+        max(get_int(CONNECT_ATTEMPTS, DEFAULT_CONNECT_ATTEMPTS), 1),
+        get_float(CONNECT_BACKOFF, DEFAULT_CONNECT_BACKOFF_SECONDS),
+        get_float(CONNECT_BACKOFF_CAP, DEFAULT_CONNECT_BACKOFF_CAP_SECONDS),
+    )

@@ -1,0 +1,71 @@
+"""Retry with exponential backoff + full jitter.
+
+Shared by the rendezvous KV client and the TCP mesh bootstrap (the
+reference bounds its store waits the same way: gloo's store_timeout plus
+the runner's retry loops, horovod/runner/http/http_client.py:17-45).
+Jitter is the standard decorrelation trick: without it, N workers that
+all lost the same peer retry in lockstep and hammer the rendezvous
+server in synchronized waves.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from . import env as env_cfg
+from .logging import get_logger
+
+logger = get_logger()
+
+T = TypeVar("T")
+
+
+def backoff_delays(attempts: int, base: float, cap: float):
+    """Yield attempts-1 sleep durations: base doubling per attempt,
+    capped, with +/-50% jitter."""
+    for i in range(attempts - 1):
+        d = min(base * (2 ** i), cap)
+        yield d * (0.5 + random.random())
+
+
+def call_with_retry(
+    fn: Callable[[], T],
+    what: str,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    no_retry_on: Tuple[Type[BaseException], ...] = (PermissionError,),
+    attempts: Optional[int] = None,
+    base: Optional[float] = None,
+    cap: Optional[float] = None,
+    deadline: Optional[float] = None,
+) -> T:
+    """Call `fn` up to `attempts` times, sleeping a jittered exponential
+    backoff between failures. `deadline` (monotonic timestamp) bounds the
+    whole loop: no retry starts past it. `no_retry_on` wins over
+    `retry_on` (PermissionError by default: an auth rejection — e.g. a
+    bad HMAC digest — never heals by retrying). The last failure is
+    re-raised with its original type so callers can translate
+    precisely."""
+    env_attempts, env_base, env_cap = env_cfg.connect_retry_policy()
+    attempts = env_attempts if attempts is None else max(attempts, 1)
+    base = env_base if base is None else base
+    cap = env_cap if cap is None else cap
+    delays = list(backoff_delays(attempts, base, cap)) + [0.0]
+    last: Optional[BaseException] = None
+    for attempt, delay in enumerate(delays, 1):
+        try:
+            return fn()
+        except no_retry_on:
+            raise
+        except retry_on as exc:
+            last = exc
+            expired = (deadline is not None
+                       and time.monotonic() + delay > deadline)
+            if attempt >= attempts or expired:
+                raise
+            logger.debug(
+                "%s failed (attempt %d/%d): %s; retrying in %.2fs",
+                what, attempt, attempts, exc, delay,
+            )
+            time.sleep(delay)
+    raise last  # pragma: no cover - loop always returns or raises

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Chaos smoke: kill one of N local workers mid-step, by hand.
+
+Reproduces the fault-tolerance acceptance scenario outside pytest
+(tests/test_fault_tolerance.py::test_chaos_kill_one_of_four_workers):
+spawn N process-mode workers allreducing in a loop, arm a deterministic
+``kill:step=K`` fault-injection rule on one rank, and report how every
+survivor died. Success means every survivor exited through
+HorovodInternalError within 2x HOROVOD_TCP_TIMEOUT_SECONDS — no hang,
+no raw ConnectionError.
+
+    python scripts/chaos_smoke.py                 # 4 workers, kill rank 2 at step 3
+    python scripts/chaos_smoke.py --np 8 --kill-rank 5 --kill-step 10
+    python scripts/chaos_smoke.py --timeout 2.0 --steps 100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    STEPS = int(os.environ["CHAOS_STEPS"])
+    hvd.init()
+    rank = hvd.rank()
+    try:
+        for step in range(STEPS):
+            hvd.allreduce(np.ones(8, np.float32), name="g")
+            fault_injection.advance_step()
+            if step % 10 == 0:
+                print(f"rank {rank}: step {step}", flush=True)
+        print(f"rank {rank}: completed all {STEPS} steps", flush=True)
+        sys.exit(0)
+    except HorovodInternalError as e:
+        print(f"rank {rank}: HorovodInternalError: {e}", flush=True)
+        sys.exit(42)
+    except ConnectionError as e:
+        print(f"rank {rank}: RAW ConnectionError LEAKED: {e}", flush=True)
+        sys.exit(13)
+""")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", dest="np_", type=int, default=4,
+                    help="world size (default 4)")
+    ap.add_argument("--kill-rank", type=int, default=2)
+    ap.add_argument("--kill-step", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=50,
+                    help="total training steps per worker")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="HOROVOD_TCP_TIMEOUT_SECONDS for the workers")
+    args = ap.parse_args()
+
+    from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
+    from horovod_tpu.runner.launch import slot_env
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    server = RendezvousServer()
+    port = server.start()
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+
+        slots = get_host_assignments(
+            parse_hosts(f"localhost:{args.np_}"), args.np_)
+        procs = {}
+        try:
+            for slot in slots:
+                env = dict(os.environ)
+                env.update(slot_env(slot, "127.0.0.1", port))
+                env["PYTHONPATH"] = REPO
+                env["HVDRUN_FORCE_LOCAL"] = "1"
+                env["HOROVOD_CYCLE_TIME"] = "1"
+                env["HOROVOD_TCP_TIMEOUT_SECONDS"] = str(args.timeout)
+                env["CHAOS_STEPS"] = str(args.steps)
+                env.pop("HOROVOD_FAULT_INJECT", None)
+                if slot.rank == args.kill_rank:
+                    env["HOROVOD_FAULT_INJECT"] = f"kill:step={args.kill_step}"
+                procs[slot.rank] = subprocess.Popen(
+                    [sys.executable, script], env=env)
+            print(f"spawned {args.np_} workers; rank {args.kill_rank} dies "
+                  f"at step {args.kill_step} "
+                  f"(timeout={args.timeout}s)", flush=True)
+
+            t_death = None
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if procs[args.kill_rank].poll() is not None:
+                    t_death = time.monotonic()
+                    break
+                time.sleep(0.1)
+            if t_death is None:
+                print("FAIL: doomed worker never died", flush=True)
+                return 2
+            print(f"rank {args.kill_rank} died "
+                  f"(exit {procs[args.kill_rank].returncode})", flush=True)
+
+            budget = 2 * args.timeout + 30
+            ok = True
+            for rank, proc in sorted(procs.items()):
+                if rank == args.kill_rank:
+                    continue
+                remaining = budget - (time.monotonic() - t_death)
+                try:
+                    proc.wait(timeout=max(remaining, 1.0))
+                except subprocess.TimeoutExpired:
+                    print(f"FAIL: rank {rank} HUNG past {budget:.0f}s",
+                          flush=True)
+                    ok = False
+                    continue
+                verdict = {42: "clean HorovodInternalError",
+                           0: "completed (died pre-mesh?)",
+                           13: "RAW ConnectionError (FORBIDDEN)"}.get(
+                               proc.returncode, "unexpected")
+                print(f"rank {rank}: exit {proc.returncode} ({verdict})",
+                      flush=True)
+                ok = ok and proc.returncode == 42
+            print("PASS" if ok else "FAIL", flush=True)
+            return 0 if ok else 1
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.5 spells the device-count override as a config option;
+    # on older versions the XLA_FLAGS set above (before `import jax`)
+    # does the same job, so an unknown option is not an error.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 import jax.extend.backend as _jeb
 
 _jeb.clear_backends()

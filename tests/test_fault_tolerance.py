@@ -1,0 +1,514 @@
+"""Fault-tolerant data plane: injection harness, bounded I/O, and clean
+failure propagation (ISSUE 1; ref model: the reference's elastic
+contract — every collective failure surfaces as HorovodInternalError,
+horovod/common/exceptions.py:17-31).
+
+Fast tests (tier-1): rule parsing, injector verdicts, bounded recv,
+TcpBackend error translation, engine fail-all propagation, stall
+inspector verdicts. The subprocess chaos test (kill 1 of 4 workers
+mid-step) is marked `slow`.
+"""
+import logging
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import fault_injection
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError,
+    TransportError,
+)
+from horovod_tpu.common.fault_injection import (
+    DROP,
+    PASS,
+    FaultInjector,
+    InjectedFault,
+    Rule,
+    parse_spec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test starts and ends with a disarmed process-wide injector."""
+    fault_injection.injector.clear()
+    yield
+    fault_injection.injector.clear()
+
+
+# ---------------------------------------------------------------------------
+# rule grammar
+def test_parse_spec_full_grammar():
+    rules = parse_spec(
+        "kill:step=5;sever:peer=0:after=3;drop:peer=2:rank=1;"
+        "delay:peer=1:secs=0.25:op=recv"
+    )
+    assert [r.action for r in rules] == ["kill", "sever", "drop", "delay"]
+    assert rules[0].step == 5
+    assert rules[1].peer == 0 and rules[1].after == 3
+    assert rules[2].rank == 1
+    assert rules[3].secs == 0.25 and rules[3].op == "recv"
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:peer=1",          # unknown action
+    "sever:peer",              # field without '='
+    "kill",                    # kill needs step=N
+    "delay:peer=1",            # delay needs secs=S
+    "sever:op=sideways:peer=1",  # bad op
+    "drop:peer=1:op=recv",     # drop is send-only; reject, don't no-op
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_env_spec_arms_injector(monkeypatch):
+    monkeypatch.setenv(fault_injection.ENV_VAR, "sever:peer=1")
+    inj = FaultInjector()
+    inj._load_env()
+    assert inj.active
+    with pytest.raises(InjectedFault):
+        inj.check_io(rank=0, peer=1, op="send")
+
+
+# ---------------------------------------------------------------------------
+# injector verdicts
+def test_sever_after_n_frames():
+    inj = FaultInjector()
+    inj.install([Rule(action="sever", peer=1, after=2)])
+    assert inj.check_io(0, 1, "send") == PASS
+    assert inj.check_io(0, 1, "send") == PASS
+    with pytest.raises(InjectedFault):
+        inj.check_io(0, 1, "send")
+    # other peers unaffected
+    assert inj.check_io(0, 2, "send") == PASS
+
+
+def test_drop_and_rank_scoping():
+    inj = FaultInjector()
+    inj.install([Rule(action="drop", peer=0, rank=1)])
+    assert inj.check_io(1, 0, "send") == DROP
+    assert inj.check_io(2, 0, "send") == PASS  # different rank
+    # drop is send-only: a recv neither drops...
+    assert inj.check_io(1, 0, "recv") == PASS
+
+
+def test_drop_after_counts_sends_only():
+    inj = FaultInjector()
+    inj.install([Rule(action="drop", peer=0, after=2)])
+    # ...nor advances the after=K hit counter.
+    assert inj.check_io(0, 0, "recv") == PASS
+    assert inj.check_io(0, 0, "recv") == PASS
+    assert inj.check_io(0, 0, "send") == PASS   # hit 1
+    assert inj.check_io(0, 0, "send") == PASS   # hit 2
+    assert inj.check_io(0, 0, "send") == DROP   # hit 3 > after=2
+
+
+def test_delay_sleeps():
+    inj = FaultInjector()
+    inj.install([Rule(action="delay", peer=0, secs=0.15)])
+    t0 = time.monotonic()
+    assert inj.check_io(0, 0, "send") == PASS
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_connect_rules_need_explicit_op():
+    inj = FaultInjector()
+    inj.install([Rule(action="sever", peer=1)])
+    # data-plane default: connect is untouched...
+    assert inj.check_io(0, 1, "connect") == PASS
+    inj.install([Rule(action="sever", peer=1, op="connect")])
+    with pytest.raises(InjectedFault):
+        inj.check_io(0, 1, "connect")
+    # ...and a connect-scoped rule leaves send/recv alone.
+    assert inj.check_io(0, 1, "send") == PASS
+
+
+def test_kill_rule_fires_at_step():
+    """kill:step=N must down the process exactly at step N (subprocess:
+    os._exit is unfakeable in-process)."""
+    prog = textwrap.dedent("""
+        import os
+        os.environ["HOROVOD_FAULT_INJECT"] = "kill:step=3"
+        from horovod_tpu.common import fault_injection
+        for i in range(10):
+            fault_injection.advance_step()
+            print("survived", i + 1, flush=True)
+    """)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop(fault_injection.ENV_VAR, None)
+    proc = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert proc.stdout.splitlines() == ["survived 1", "survived 2"]
+
+
+# ---------------------------------------------------------------------------
+# bounded recv + translation
+def test_recv_exact_bounded_times_out():
+    from horovod_tpu.backend.tcp import _recv_exact_bounded
+
+    a, b = socket.socketpair()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="HOROVOD_TCP_TIMEOUT"):
+            _recv_exact_bounded(a, 8, timeout=0.4, poll=0.05)
+        assert time.monotonic() - t0 < 2.0  # bounded, not hung
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_bounded_detects_peer_close():
+    from horovod_tpu.backend.tcp import _recv_exact_bounded
+
+    a, b = socket.socketpair()
+    try:
+        b.close()
+        with pytest.raises(ConnectionError):
+            _recv_exact_bounded(a, 8, timeout=0.0, poll=0.05)
+    finally:
+        a.close()
+
+
+def _tcp_pair(scope, monkeypatch):
+    """Two real TcpBackends full-meshed through a local rendezvous."""
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+    from horovod_tpu.backend.tcp import TcpBackend
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    monkeypatch.setenv("HVDRUN_FORCE_LOCAL", "1")
+    server = RendezvousServer()
+    port = server.start()
+    rdv = RendezvousClient("127.0.0.1", port)
+    backends = [None, None]
+    errs = []
+
+    def build(rank):
+        try:
+            backends[rank] = TcpBackend(rank, 2, rendezvous=rdv, scope=scope)
+        except BaseException as e:  # pragma: no cover - bootstrap bug
+            errs.append(e)
+
+    threads = [threading.Thread(target=build, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs, errs
+    assert backends[0] is not None and backends[1] is not None
+    return server, backends
+
+
+def test_tcp_dead_peer_translates_to_transport_error(monkeypatch):
+    """A peer whose sockets die mid-collective must surface as
+    TransportError (⊂ HorovodInternalError) on the survivor — never a
+    raw ConnectionError (the elastic contract, exceptions.py:4-9)."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "5")
+    server, (b0, b1) = _tcp_pair("t_dead_peer", monkeypatch)
+    try:
+        b1.shutdown()  # rank 1 "dies": OS closes its sockets
+        with pytest.raises(TransportError, match="peer 1"):
+            b0.gather_bytes(b"x")  # rank 0 recvs from rank 1
+        # the failed peer is severed: later ops fail fast, same type
+        with pytest.raises(TransportError):
+            b0.gather_bytes(b"x")
+    finally:
+        b0.shutdown()
+        server.stop()
+
+
+def test_tcp_injected_sever_translates(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "5")
+    server, (b0, b1) = _tcp_pair("t_sever", monkeypatch)
+    try:
+        fault_injection.injector.install(
+            [Rule(action="sever", peer=1, rank=0, op="recv")]
+        )
+        with pytest.raises(TransportError, match="severed"):
+            b0.gather_bytes(b"x")
+    finally:
+        fault_injection.injector.clear()
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_tcp_timeout_on_silent_peer(monkeypatch):
+    """A peer that is alive but never sends must trip the bounded recv
+    within HOROVOD_TCP_TIMEOUT_SECONDS — the hang this PR exists to
+    kill."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "0.5")
+    server, (b0, b1) = _tcp_pair("t_silent", monkeypatch)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TransportError, match="no progress"):
+            b0.recv_from(1)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine: fail ALL pending handles, latch terminal state
+class _FailingBackend:
+    """LocalBackend shape whose data plane dies like a broken mesh."""
+
+    rank, size = 0, 1
+    local_rank, local_size, cross_rank, cross_size = 0, 1, 0, 1
+    hierarchical = hier_allgather = False
+
+    def set_topology(self, *a):
+        pass
+
+    def gather_bytes(self, payload):
+        return [payload]
+
+    def bcast_bytes(self, payload):
+        return payload
+
+    def allreduce_words(self, words, op):
+        return list(words)
+
+    def barrier(self):
+        pass
+
+    def allreduce(self, arr, op=None):
+        raise TransportError("rank 0: send to peer 1 failed: injected")
+
+    def allgatherv(self, arr, first_dims):
+        raise TransportError("rank 0: send to peer 1 failed: injected")
+
+    def broadcast(self, arr, root):
+        raise TransportError("rank 0: send to peer 1 failed: injected")
+
+    def alltoallv(self, arr, splits):
+        raise TransportError("rank 0: send to peer 1 failed: injected")
+
+    def adasum_allreduce_all(self, arr):
+        raise TransportError("rank 0: send to peer 1 failed: injected")
+
+    def shutdown(self):
+        pass
+
+
+def test_engine_transport_error_fails_all_pending_and_latches():
+    from horovod_tpu.engine.engine import Engine
+
+    eng = Engine(rank=0, size=1, backend=_FailingBackend())
+    eng.start()
+    try:
+        h1 = eng.enqueue_allreduce(np.ones(4, np.float32), name="a")
+        h2 = eng.enqueue_allreduce(np.ones(4, np.float32), name="b")
+        with pytest.raises(HorovodInternalError, match="peer 1"):
+            eng.synchronize(h1, timeout=30)
+        with pytest.raises(HorovodInternalError, match="peer 1"):
+            eng.synchronize(h2, timeout=30)
+        # The engine is dead: a NEW enqueue must fail immediately with
+        # the latched reason, not park forever.
+        h3 = eng.enqueue_allreduce(np.ones(4, np.float32), name="c")
+        with pytest.raises(HorovodInternalError, match="peer 1"):
+            eng.synchronize(h3, timeout=30)
+    finally:
+        eng.shutdown()
+
+
+def test_tensor_queue_finalize_latches_status():
+    from horovod_tpu.common.message import Request
+    from horovod_tpu.common.types import Status, StatusType
+    from horovod_tpu.engine.tensor_queue import TensorQueue, TensorTableEntry
+
+    q = TensorQueue()
+    q.finalize(Status.Aborted("mesh down"))
+    st = q.add_to_tensor_queue(
+        TensorTableEntry(tensor_name="t", tensor=None), Request()
+    )
+    assert st.type == StatusType.ABORTED and "mesh down" in st.reason
+
+
+# ---------------------------------------------------------------------------
+# stall inspector (satellite: the abort path had no direct test)
+@pytest.fixture
+def _hvd_log_capture():
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    h = _Cap(level=logging.DEBUG)
+    lg = logging.getLogger("horovod_tpu")
+    lg.addHandler(h)
+    yield records
+    lg.removeHandler(h)
+
+
+def _make_inspector(monkeypatch, warn="0.05", shut="0"):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", warn)
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", shut)
+    from horovod_tpu.engine.stall import StallInspector
+
+    insp = StallInspector(size=2)
+    insp.last_check = 0.0  # open the rate gate for the first check()
+    return insp
+
+
+def test_stall_warning_emitted_once(monkeypatch, _hvd_log_capture):
+    insp = _make_inspector(monkeypatch)
+    insp.record("allreduce.g", 0)  # rank 1 never shows up
+    time.sleep(0.08)
+    assert insp.check() is None  # warn, not abort
+    warnings = [r for r in _hvd_log_capture
+                if "Stalled op: allreduce.g" in r.getMessage()]
+    assert len(warnings) == 1
+    assert "[missing ranks: [1]]" in warnings[0].getMessage()
+    insp.last_check = 0.0
+    assert insp.check() is None  # second check: already warned, no spam
+    assert len([r for r in _hvd_log_capture
+                if "Stalled op" in r.getMessage()]) == 1
+
+
+def test_stall_shutdown_verdict(monkeypatch):
+    insp = _make_inspector(monkeypatch, warn="0.01", shut="0.05")
+    insp.record("allreduce.g", 0)
+    time.sleep(0.08)
+    reason = insp.check()
+    assert reason is not None and "stall shutdown" in reason
+    assert "allreduce.g" in reason and "[1]" in reason
+
+
+def test_stall_remove_clears_warned_state(monkeypatch, _hvd_log_capture):
+    insp = _make_inspector(monkeypatch)
+    insp.record("allreduce.g", 0)
+    time.sleep(0.08)
+    insp.check()
+    assert "allreduce.g" in insp.warned
+    insp.remove("allreduce.g")
+    assert not insp.pending and "allreduce.g" not in insp.warned
+    # the op comes back (next batch) and stalls again -> fresh warning
+    insp.record("allreduce.g", 0)
+    time.sleep(0.08)
+    insp.last_check = 0.0
+    insp.check()
+    assert len([r for r in _hvd_log_capture
+                if "Stalled op" in r.getMessage()]) == 2
+
+
+def test_stall_disabled_never_aborts(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.01")
+    from horovod_tpu.engine.stall import StallInspector
+
+    insp = StallInspector(size=2)
+    insp.record("allreduce.g", 0)
+    insp.last_check = 0.0
+    time.sleep(0.05)
+    assert insp.check() is None
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill 1 of 4 real workers mid-step (the acceptance scenario)
+_CHAOS_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    STEPS = int(os.environ.get("TEST_CHAOS_STEPS", "50"))
+    hvd.init()
+    try:
+        for step in range(STEPS):
+            out = hvd.allreduce(np.ones(8, np.float32), name="g")
+            fault_injection.advance_step()  # doomed rank dies here
+        sys.exit(0)
+    except HorovodInternalError:
+        sys.exit(42)   # the contract: collective failure -> HIE
+    except ConnectionError:
+        sys.exit(13)   # raw transport error leaked: forbidden
+    except Exception:
+        sys.exit(14)
+""")
+
+
+@pytest.mark.slow
+def test_chaos_kill_one_of_four_workers(tmp_path):
+    """Kill 1 of 4 subprocess workers mid-step; every survivor must
+    raise HorovodInternalError within 2x HOROVOD_TCP_TIMEOUT_SECONDS of
+    the death — no indefinite hang, no raw ConnectionError escaping."""
+    from horovod_tpu.runner.hosts import parse_hosts, get_host_assignments
+    from horovod_tpu.runner.launch import slot_env
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    timeout_s = 5.0
+    np_world = 4
+    kill_rank = 2
+
+    server = RendezvousServer()
+    port = server.start()
+    script = tmp_path / "worker.py"
+    script.write_text(_CHAOS_WORKER)
+
+    hosts = parse_hosts(f"localhost:{np_world}")
+    slots = get_host_assignments(hosts, np_world)
+    procs = {}
+    try:
+        for slot in slots:
+            env = dict(os.environ)
+            env.update(slot_env(slot, "127.0.0.1", port))
+            env["PYTHONPATH"] = REPO
+            env["HVDRUN_FORCE_LOCAL"] = "1"
+            env["HOROVOD_CYCLE_TIME"] = "1"
+            env["HOROVOD_TCP_TIMEOUT_SECONDS"] = str(timeout_s)
+            env.pop("HOROVOD_FAULT_INJECT", None)
+            if slot.rank == kill_rank:
+                env["HOROVOD_FAULT_INJECT"] = "kill:step=3"
+            procs[slot.rank] = subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+            )
+        # The doomed worker exits first (around step 3)...
+        t_death = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if procs[kill_rank].poll() is not None:
+                t_death = time.monotonic()
+                break
+            time.sleep(0.1)
+        assert t_death is not None, "doomed worker never died"
+        assert procs[kill_rank].returncode == 1
+
+        # ...and every survivor must fail CLEANLY within 2x the timeout.
+        budget = 2 * timeout_s + 30  # + slack for jax import/teardown
+        for rank, proc in procs.items():
+            if rank == kill_rank:
+                continue
+            remaining = budget - (time.monotonic() - t_death)
+            try:
+                proc.wait(timeout=max(remaining, 1.0))
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"survivor rank {rank} hung past the bound")
+        codes = {r: p.returncode for r, p in procs.items() if r != kill_rank}
+        assert all(c == 42 for c in codes.values()), (
+            f"survivors must exit via HorovodInternalError (42): {codes}"
+        )
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        server.stop()

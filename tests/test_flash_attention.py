@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from horovod_tpu.utils.compat import set_mesh as _set_mesh
 from horovod_tpu.ops.flash_attention import flash_attention
 from horovod_tpu.parallel.ring import dense_attention
 
@@ -182,7 +183,7 @@ def test_flash_under_gspmd_mesh_is_sharded_and_correct():
     mesh = create_mesh({"dp": 2, "tp": 2, "sp": 2})
     m_flash = TransformerEncoder(dataclasses.replace(base,
                                                      attn_impl="flash"))
-    with jax.sharding.set_mesh(mesh):
+    with _set_mesh(mesh):
         got = jax.jit(lambda v, i, mk: m_flash.apply(v, i, mask=mk))(
             variables, ids, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -252,7 +253,7 @@ def test_model_ulysses_flash_on_dp_sp_mesh():
     mesh = create_mesh({"dp": 2, "sp": 4})
     m_uf = TransformerEncoder(dataclasses.replace(
         base, attn_impl="ulysses", sp_use_flash=True))
-    with jax.sharding.set_mesh(mesh):
+    with _set_mesh(mesh):
         got = jax.jit(lambda v, i, mk: m_uf.apply(v, i, mask=mk))(
             variables, ids, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

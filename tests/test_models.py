@@ -6,6 +6,7 @@ import numpy as np
 import optax
 import pytest
 
+from horovod_tpu.utils.compat import set_mesh as _set_mesh
 from horovod_tpu.models import (
     GPT2_CONFIGS,
     TransformerConfig,
@@ -176,7 +177,7 @@ def test_bert_ring_attention_with_padding_mask():
 
     mesh = create_mesh({"dp": 2, "sp": 4})
     m_ring = TransformerEncoder(dataclasses.replace(base, attn_impl="ring"))
-    with jax.sharding.set_mesh(mesh):
+    with _set_mesh(mesh):
         got = jax.jit(lambda v, i, mk: m_ring.apply(v, i, mask=mk))(
             variables, ids, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

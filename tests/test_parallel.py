@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horovod_tpu.utils.compat import set_mesh as _set_mesh
 from horovod_tpu.parallel.mesh import create_mesh
 from horovod_tpu.parallel.pipeline import gpipe, stack_stage_params
 from horovod_tpu.parallel.ring import dense_attention, ring_attention
@@ -151,7 +152,7 @@ def test_pipelined_lm_matches_and_trains():
     base = TransformerLM(cfg)
     plm = PipelinedLM(cfg, mesh, num_microbatches=4)
     vu = nn.unbox(base.init(jax.random.PRNGKey(0), ids))
-    with jax.sharding.set_mesh(mesh):
+    with _set_mesh(mesh):
         out_base = jax.jit(lambda v, i: base.apply(v, i))(vu, ids)
         out_pipe = jax.jit(lambda v, i: plm.apply(v, i))(vu, ids)
     np.testing.assert_allclose(np.asarray(out_base), np.asarray(out_pipe),
