@@ -29,6 +29,7 @@ from .common.basics import (
     is_homogeneous,
     mesh,
     axis_name,
+    metrics,
     mode,
     mpi_built,
     nccl_built,

@@ -14,7 +14,27 @@ import http.client
 import time
 from typing import Optional
 
+from ..utils.logging import get_logger
 from ..utils.retry import call_with_retry
+
+logger = get_logger()
+
+_request_counter_cache = None
+
+
+def _request_counter():
+    # Cached: wait_get polls the KV store at 20Hz during bootstrap; the
+    # registry lookup happens once, not per poll.
+    global _request_counter_cache
+    if _request_counter_cache is None:
+        from ..common import telemetry
+
+        _request_counter_cache = telemetry.counter(
+            "horovod_rendezvous_requests_total",
+            "HTTP requests issued against the rendezvous server "
+            "(retries included)",
+        )
+    return _request_counter_cache
 
 
 class RendezvousClient:
@@ -36,9 +56,18 @@ class RendezvousClient:
         """KV requests retry transient transport failures (refused while
         the server restarts mid-elastic-reset, reset, timeout) with
         exponential backoff + jitter; HTTP-level rejections (403 etc.)
-        are NOT transport failures and propagate immediately."""
+        are NOT transport failures and propagate immediately. Per-attempt
+        noise policy lives in call_with_retry: first and final failures
+        log at WARNING, the rest only bump
+        horovod_retry_attempts_total."""
+        counter = _request_counter()
+
+        def counted():
+            counter.inc()
+            return fn()
+
         return call_with_retry(
-            fn, what,
+            counted, what,
             retry_on=(OSError, http.client.HTTPException),
         )
 
@@ -89,13 +118,23 @@ class RendezvousClient:
         return self._retry(_get, f"rendezvous GET {scope}/{key}")
 
     def wait_get(self, scope: str, key: str) -> bytes:
-        """Poll until the key exists (peers registering)."""
+        """Poll until the key exists (peers registering). One WARNING
+        when the wait turns long (a peer is slow to register — the
+        bootstrap-time analogue of a stall warning), not one per poll."""
         deadline = time.monotonic() + self.timeout
+        warn_at: Optional[float] = time.monotonic() + min(self.timeout / 2, 15.0)
         while True:
             v = self.get(scope, key)
             if v is not None:
                 return v
-            if time.monotonic() > deadline:
+            now = time.monotonic()
+            if warn_at is not None and now > warn_at:
+                logger.warning(
+                    "still waiting for rendezvous key %s/%s after %.0fs "
+                    "(peer slow to register?)", scope, key, now - (deadline - self.timeout),
+                )
+                warn_at = None
+            if now > deadline:
                 raise TimeoutError(f"rendezvous key {scope}/{key} never appeared")
             time.sleep(0.05)
 

@@ -112,7 +112,24 @@ class TcpBackend(RingCollectivesMixin):
         size: int,
         rendezvous: Optional[RendezvousClient] = None,
         scope: Optional[str] = None,
+        registry=None,
     ):
+        from ..common import telemetry
+
+        if registry is None:
+            registry = telemetry.default_registry()
+        self._m_bytes_sent = registry.counter(
+            "horovod_tcp_bytes_sent_total",
+            "Bytes written to peer sockets (frame headers included)")
+        self._m_bytes_recv = registry.counter(
+            "horovod_tcp_bytes_recv_total",
+            "Bytes read from peer sockets (frame headers included)")
+        self._m_timeouts = registry.counter(
+            "horovod_tcp_timeouts_total",
+            "Peer I/O operations that hit HOROVOD_TCP_TIMEOUT_SECONDS")
+        self._m_severed = registry.counter(
+            "horovod_tcp_peers_severed_total",
+            "Peer connections hard-closed after a transport failure")
         self.rank = rank
         self.size = size
         if scope is None:
@@ -292,6 +309,7 @@ class TcpBackend(RingCollectivesMixin):
     def _sever(self, peer: int):
         s = self.peers.pop(peer, None)
         if s is not None:
+            self._m_severed.inc()
             try:
                 s.close()
             except OSError:  # pragma: no cover - already dead
@@ -308,6 +326,7 @@ class TcpBackend(RingCollectivesMixin):
                 sock.settimeout(self._timeout)
             try:
                 _send_all(sock, data)
+                self._m_bytes_sent.inc(len(data) + 8)
             finally:
                 if self._timeout > 0:
                     try:
@@ -315,6 +334,8 @@ class TcpBackend(RingCollectivesMixin):
                     except OSError:
                         pass
         except (OSError, TimeoutError) as exc:
+            if isinstance(exc, (socket.timeout, TimeoutError)):
+                self._m_timeouts.inc()
             self._sever(peer)
             raise TransportError(
                 f"rank {self.rank}: send to peer {peer} failed: {exc}"
@@ -327,8 +348,12 @@ class TcpBackend(RingCollectivesMixin):
                 self._injector.check_io(self.rank, peer, "recv")
             (n,) = _LEN.unpack(
                 _recv_exact_bounded(sock, 8, self._timeout, self._poll))
-            return _recv_exact_bounded(sock, n, self._timeout, self._poll)
+            data = _recv_exact_bounded(sock, n, self._timeout, self._poll)
+            self._m_bytes_recv.inc(n + 8)
+            return data
         except (OSError, TimeoutError) as exc:
+            if isinstance(exc, (socket.timeout, TimeoutError)):
+                self._m_timeouts.inc()
             self._sever(peer)
             raise TransportError(
                 f"rank {self.rank}: recv from peer {peer} failed: {exc}"

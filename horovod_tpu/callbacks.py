@@ -71,6 +71,24 @@ class MetricAverageCallback(Callback):
         }
 
 
+class MetricsCallback(Callback):
+    """Log a one-line telemetry summary every `interval` batches: step
+    time, allreduce MB/s and response-cache hit rate over the window
+    (docs/metrics.md). `log_fn` overrides the destination (default: the
+    horovod logger at INFO); only `root_only` rank 0 logs by default so
+    an N-rank job prints one line, not N."""
+
+    def __init__(self, interval: int = 100, log_fn=None, root_only: bool = True,
+                 registry=None):
+        from .common import telemetry
+
+        self._logger = telemetry.StepSummaryLogger(
+            interval, log_fn, root_only, registry)
+
+    def on_batch_end(self, batch: int, context: dict):
+        self._logger.step()
+
+
 class LearningRateScheduleCallback(Callback):
     """Multiply base LR by `multiplier(epoch)` (ref: _keras/callbacks.py:
     90-132). Works with a mutable lr holder dict: {"lr": float}."""

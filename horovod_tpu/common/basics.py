@@ -31,6 +31,7 @@ from typing import List, Optional, Sequence
 
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
+from . import telemetry
 from .exceptions import NotInitializedError
 from .types import ReduceOp
 
@@ -51,6 +52,7 @@ class _State:
         self.axis_name = None
         self.engine = None
         self.ranks: Optional[List[int]] = None  # subset init (ref: basics.py:33-65)
+        self.exporters: List = []  # mesh-mode metrics exporters
         self.lock = threading.Lock()
 
 
@@ -151,7 +153,25 @@ def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "
                 all_devices = jax.devices()
                 devices = [all_devices[r] for r in ranks]
             _init_mesh_mode(devices, axis_name)
+            # Process mode's engine owns its exporters; mesh mode has no
+            # engine, so the env-driven exporters start here (registry
+            # only — there is no negotiation state to report).
+            from . import metrics_export
+
+            _state.exporters = metrics_export.start_exporters_from_env(
+                status_fn=lambda: {
+                    "rank": _state.rank,
+                    "size": _state.size,
+                    "mode": _state.mode,
+                },
+                rank=_state.rank,
+            )
         _state.initialized = True
+        # Baseline gauge for "world shrank" alerts — set on EVERY init,
+        # not only after an elastic reset (elastic/run.py updates it too).
+        telemetry.gauge(
+            "horovod_world_size", "World size after the last (re)init"
+        ).set(_state.size)
         logger.debug(
             "horovod_tpu initialized: mode=%s rank=%d size=%d local=%d/%d cross=%d/%d",
             _state.mode, _state.rank, _state.size, _state.local_rank,
@@ -167,6 +187,12 @@ def shutdown():
         if _state.engine is not None:
             _state.engine.shutdown()
             _state.engine = None
+        for exp in _state.exporters:
+            try:
+                exp.stop()
+            except Exception:  # pragma: no cover - exporter already dead
+                pass
+        _state.exporters = []
         _state.mesh = None
         _state.initialized = False
         _state.mode = None
@@ -247,6 +273,36 @@ def mode() -> str:
 def engine():
     _require_init()
     return _state.engine
+
+
+def metrics() -> dict:
+    """Snapshot of the telemetry registry (docs/metrics.md).
+
+    Returns ``{"rank", "size", "mode", "metrics", "status"?, "fleet"?}``:
+    `metrics` is the flat name → value dict (histograms as
+    {count,sum,bounds,counts}); in process mode `status` is the live
+    engine state (queue depth, pending tensors, last-cycle age) and, on
+    rank 0, `fleet` is the cross-rank per-rank/min/max/sum view. Usable
+    before init too — module-level counters (retries, faults) exist
+    regardless."""
+    eng = _state.engine
+    reg = eng.registry if eng is not None else telemetry.default_registry()
+    out = {
+        "rank": _state.rank,
+        "size": _state.size,
+        "mode": _state.mode,
+        "metrics": reg.snapshot(),
+    }
+    if eng is not None:
+        status = eng.status()
+        # One fleet snapshot, hoisted to the top level (status() embeds
+        # it for the /status endpoint; two separate snapshots here could
+        # disagree within one result).
+        fleet = status.pop("fleet", None)
+        out["status"] = status
+        if fleet is not None:
+            out["fleet"] = fleet
+    return out
 
 
 # Capability introspection (ref: basics.py:174-208 mpi_built/nccl_built...).

@@ -51,6 +51,16 @@ logger = get_logger()
 
 ENV_VAR = "HOROVOD_FAULT_INJECT"
 
+
+def _fault_counter(action: str):
+    from . import telemetry
+
+    return telemetry.counter(
+        "horovod_faults_injected_total",
+        "Faults fired by the chaos harness, by action",
+        labels={"action": action},
+    )
+
 # Hook verdicts (sever is raised, not returned)
 PASS = "pass"
 DROP = "drop"
@@ -228,10 +238,13 @@ class FaultInjector:
                 if r.action == "delay":
                     # Sleep outside the lock? Delay rules are test-only
                     # and short; holding the lock keeps ordering exact.
+                    _fault_counter("delay").inc()
                     time.sleep(r.secs)
                 elif r.action == "drop":
+                    _fault_counter("drop").inc()
                     verdict = DROP
                 elif r.action == "sever":
+                    _fault_counter("sever").inc()
                     raise InjectedFault(
                         f"fault injection severed rank {rank} <-> peer "
                         f"{peer} ({op})"

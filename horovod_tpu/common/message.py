@@ -116,15 +116,26 @@ class Request:
 
 @dataclass
 class RequestList:
-    """(ref: message.h RequestList; shutdown flag at message.h:120-135)"""
+    """(ref: message.h RequestList; shutdown flag at message.h:120-135)
+
+    `telemetry` is an optional opaque blob a rank piggybacks on its
+    per-cycle gather so rank 0 can hold a fleet metrics view
+    (common/telemetry.py FleetView) without a second collective. It is a
+    TRAILING optional field: decoders that stop after `requests` (the
+    C++ engine's codec) stay wire-compatible, and this decoder treats a
+    missing tail as None.
+    """
 
     requests: List[Request] = field(default_factory=list)
     shutdown: bool = False
+    telemetry: Optional[bytes] = None
 
     def serialize(self) -> bytes:
         out = struct.pack("<?I", self.shutdown, len(self.requests))
         for r in self.requests:
             out += r.serialize()
+        if self.telemetry is not None:
+            out += struct.pack("<I", len(self.telemetry)) + self.telemetry
         return out
 
     @staticmethod
@@ -135,7 +146,12 @@ class RequestList:
         for _ in range(n):
             r, off = Request.deserialize(buf, off)
             reqs.append(r)
-        return RequestList(reqs, shutdown)
+        telemetry = None
+        if off + 4 <= len(buf):
+            (tn,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            telemetry = buf[off : off + tn]
+        return RequestList(reqs, shutdown, telemetry)
 
 
 @dataclass

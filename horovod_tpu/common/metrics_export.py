@@ -1,0 +1,271 @@
+"""Metrics export: Prometheus text exposition, JSON dumps, live endpoints.
+
+Three consumers of the `telemetry` registry (docs/metrics.md):
+
+* `hvd.metrics()` — in-process snapshot dict (common/basics.py).
+* `HOROVOD_METRICS_FILE=<path>` — a daemon thread dumps a JSON snapshot
+  every `HOROVOD_METRICS_FILE_INTERVAL` seconds (atomic tmp+rename, like
+  spark/store.py's crash-safe write). `{rank}` in the path expands to the
+  rank so multi-process runs don't clobber one file.
+* `HOROVOD_METRICS_PORT=<port>` — rank 0 serves Prometheus text at
+  `/metrics`, a JSON snapshot at `/metrics.json`, and live per-rank state
+  at `/status` (pending tensors, queue depth, last-cycle age — the live
+  version of the stall inspector's post-mortem) from a daemon thread.
+
+Everything here is default-off: with neither env var set, no thread is
+started and no socket is opened (the registry itself costs a few int
+adds per engine cycle).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from . import telemetry
+
+logger = get_logger()
+
+
+# ---------------------------------------------------------------------------
+# Renderers
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def _prom_labels(labels, extra: str = "") -> str:
+    parts = []
+    if labels:
+        parts.extend(f'{k}="{labels[k]}"' for k in sorted(labels))
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        return repr(v)
+    return str(v)
+
+
+def to_prometheus(registry: Optional[telemetry.MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text exposition format 0.0.4.
+    Histogram buckets are emitted cumulatively with `le` labels plus the
+    `+Inf` bucket, `_sum` and `_count`, per the exposition spec."""
+    registry = registry or telemetry.default_registry()
+    lines = []
+    seen_headers = set()
+    # Sort by name so all series of one family render contiguously:
+    # lazily-created labeled series (op latency) otherwise interleave
+    # with other families, which strict exposition parsers reject.
+    for m in sorted(registry.metrics(), key=lambda m: m.name):
+        name = _prom_name(m.name)
+        if name not in seen_headers:
+            seen_headers.add(name)
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+        if isinstance(m, telemetry.Histogram):
+            snap = m.snapshot()
+            cum = 0
+            for bound, c in zip(snap["bounds"], snap["counts"]):
+                cum += c
+                le = 'le="' + _fmt(bound) + '"'
+                lines.append(f"{name}_bucket{_prom_labels(m.labels, le)} {cum}")
+            cum += snap["counts"][-1]
+            le_inf = 'le="+Inf"'
+            lines.append(f"{name}_bucket{_prom_labels(m.labels, le_inf)} {cum}")
+            lines.append(f"{name}_sum{_prom_labels(m.labels)} {_fmt(snap['sum'])}")
+            lines.append(f"{name}_count{_prom_labels(m.labels)} {snap['count']}")
+        else:
+            lines.append(f"{name}{_prom_labels(m.labels)} {_fmt(m.snapshot())}")
+    return "\n".join(lines) + "\n"
+
+
+def to_json(registry: Optional[telemetry.MetricsRegistry] = None,
+            fleet: Optional[telemetry.FleetView] = None,
+            extra: Optional[dict] = None) -> str:
+    registry = registry or telemetry.default_registry()
+    doc = {"time": time.time(), "metrics": registry.snapshot()}
+    if fleet is not None:
+        doc["fleet"] = fleet.snapshot()
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, indent=1, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Periodic JSON file dump
+
+class MetricsFileWriter:
+    """Daemon thread dumping a JSON snapshot every `interval` seconds.
+    Writes are atomic (tmp + rename) so a scraper never reads a torn
+    file; a final dump runs at stop() so shutdown state is captured."""
+
+    def __init__(self, path: str, registry: Optional[telemetry.MetricsRegistry] = None,
+                 fleet: Optional[telemetry.FleetView] = None,
+                 interval: float = 30.0, rank: int = 0):
+        self.path = path.replace("{rank}", str(rank))
+        self.registry = registry or telemetry.default_registry()
+        self.fleet = fleet
+        self.interval = max(interval, 0.05)
+        self.rank = rank
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-metrics-file", daemon=True
+        )
+
+    def start(self) -> "MetricsFileWriter":
+        self._thread.start()
+        return self
+
+    def _dump(self):
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(to_json(self.registry, self.fleet,
+                                extra={"rank": self.rank}))
+            os.replace(tmp, self.path)
+        except OSError as e:  # an unwritable path must not kill the job
+            logger.warning("metrics file dump to %s failed: %s", self.path, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self._dump()
+        self._dump()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Live HTTP endpoint (rank 0)
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hvd-metrics"
+
+    def _send(self, code: int, body: str, ctype: str):
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        srv: "MetricsHTTPServer" = self.server.owner  # type: ignore[attr-defined]
+        try:
+            if self.path.startswith("/metrics.json"):
+                self._send(200, to_json(srv.registry, srv.fleet),
+                           "application/json")
+            elif self.path.startswith("/metrics"):
+                self._send(200, to_prometheus(srv.registry),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path.startswith("/status"):
+                status = srv.status_fn() if srv.status_fn else {}
+                self._send(200, json.dumps(status, indent=1, sort_keys=True),
+                           "application/json")
+            else:
+                self._send(404, "not found: try /metrics, /metrics.json, /status\n",
+                           "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper hung up mid-response; nothing left to answer
+        except Exception as e:  # a broken provider must not kill the server
+            try:
+                self._send(500, f"error: {e}\n", "text/plain")
+            except OSError:  # pragma: no cover - peer gone during the 500
+                pass
+
+    def log_message(self, fmt, *args):
+        logger.debug("metrics http: " + fmt, *args)
+
+
+class MetricsHTTPServer:
+    """Daemon-thread HTTP server for /metrics, /metrics.json and /status.
+    `port=0` binds an ephemeral port (tests); read it back via `.port`."""
+
+    def __init__(self, port: int,
+                 registry: Optional[telemetry.MetricsRegistry] = None,
+                 fleet: Optional[telemetry.FleetView] = None,
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 addr: str = "127.0.0.1"):
+        self.registry = registry or telemetry.default_registry()
+        self.fleet = fleet
+        self.status_fn = status_fn
+        self._httpd = ThreadingHTTPServer((addr, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-metrics-http",
+            daemon=True,
+        )
+
+    def start(self) -> "MetricsHTTPServer":
+        self._thread.start()
+        logger.info("metrics endpoint serving on :%d (/metrics, /status)",
+                    self.port)
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Env-driven lifecycle (wired from Engine.start on rank 0 / every rank
+# for the file dump; see engine/engine.py).
+
+def start_exporters_from_env(
+    registry: Optional[telemetry.MetricsRegistry] = None,
+    fleet: Optional[telemetry.FleetView] = None,
+    status_fn: Optional[Callable[[], dict]] = None,
+    rank: int = 0,
+):
+    """Start the exporters the environment asks for. Returns a list of
+    started exporter objects (each has .stop()). The HTTP endpoint only
+    starts on rank 0 — it serves the fleet view; the JSON file dump runs
+    on rank 0 too unless the path contains `{rank}` (then every rank
+    writes its own file)."""
+    started = []
+    path = env_cfg.get_str(env_cfg.METRICS_FILE)
+    if path and (rank == 0 or "{rank}" in path):
+        # Interval <= 0 disables, matching HOROVOD_METRICS_SYNC_SECONDS
+        # (not "dump as fast as possible").
+        interval = env_cfg.get_float(env_cfg.METRICS_FILE_INTERVAL, 30.0)
+        if interval > 0:
+            started.append(MetricsFileWriter(
+                path, registry, fleet, interval=interval, rank=rank
+            ).start())
+    port = env_cfg.get_int(env_cfg.METRICS_PORT, -1)
+    if port >= 0 and rank == 0:
+        # Loopback by default: the endpoint is unauthenticated, so
+        # network exposure (remote Prometheus scrapers) is the explicit
+        # opt-in, matching the rendezvous server's HMAC-everything
+        # posture.
+        addr = env_cfg.get_str(env_cfg.METRICS_ADDR, "127.0.0.1")
+        try:
+            started.append(MetricsHTTPServer(
+                port, registry, fleet, status_fn=status_fn, addr=addr
+            ).start())
+        except OSError as e:
+            logger.warning("metrics endpoint on port %d failed to start: %s",
+                           port, e)
+    return started

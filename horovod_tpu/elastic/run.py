@@ -4,12 +4,25 @@ from __future__ import annotations
 import functools
 from typing import Callable
 
-from ..common import basics
+from ..common import basics, telemetry
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 from .state import State
 
 logger = get_logger()
+
+# Elastic lifecycle counters (docs/metrics.md): a fleet whose
+# resets_total climbs while restores_total stays flat is churning on
+# topology changes; the reverse means workers keep dying mid-step.
+_m_resets = telemetry.counter(
+    "horovod_elastic_resets_total",
+    "Full shutdown+init cycles taken by the elastic run loop")
+_m_restores = telemetry.counter(
+    "horovod_elastic_restores_total",
+    "State restores after a collective failure (worker death)")
+_m_host_updates = telemetry.counter(
+    "horovod_elastic_host_updates_total",
+    "Host add/remove notifications that interrupted training")
 
 
 def _reset():
@@ -18,8 +31,11 @@ def _reset():
     rank/size are re-read from the rendezvous-updated env)."""
     from ..backend import elastic_env
 
+    _m_resets.inc()
     basics.shutdown()
     elastic_env.refresh_topology_from_rendezvous()
+    # init() re-sets the horovod_world_size gauge, so shrink/grow
+    # history shows up next to the reset count.
     basics.init()
 
 
@@ -48,10 +64,12 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 logger.warning("collective failure; restoring last commit")
+                _m_restores.inc()
                 state.restore()
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
                 logger.info("hosts updated; re-initializing")
+                _m_host_updates.inc()
                 skip_sync = e.skip_sync
             _reset()
             state.on_reset()

@@ -81,7 +81,9 @@ class _TensorRecord:
 
 class Controller:
     def __init__(self, transport: ControllerTransport, size: int, rank: int,
-                 timeline=None):
+                 timeline=None, registry=None):
+        from ..common import telemetry
+
         # Coordinator-side timeline hook: negotiation phases are only
         # observable here (ref: timeline written on coordinator only,
         # operations.cc:416-429).
@@ -90,10 +92,22 @@ class Controller:
         self.size = size
         self.rank = rank
         self.is_coordinator = rank == 0
-        self.response_cache = ResponseCache(env_cfg.cache_capacity())
+        self.registry = registry if registry is not None else telemetry.default_registry()
+        self.response_cache = ResponseCache(env_cfg.cache_capacity(),
+                                            registry=self.registry)
         self.cache_enabled = env_cfg.cache_enabled()
         self.fusion_threshold = env_cfg.fusion_threshold_bytes()
-        self.stall_inspector = StallInspector(size)
+        self.stall_inspector = StallInspector(size, registry=self.registry)
+        # Cross-rank telemetry: every HOROVOD_METRICS_SYNC_SECONDS each
+        # rank piggybacks a scalar snapshot on the RequestList it already
+        # gathers to rank 0; the coordinator folds them into the fleet
+        # view (per-rank min/max/sum — a straggler is a rank-tagged
+        # outlier). 0 disables. _last_metrics_push = 0 makes the very
+        # first gather carry a snapshot, so the fleet view exists as
+        # soon as the first negotiation completes.
+        self.fleet = telemetry.FleetView(size) if self.is_coordinator else None
+        self._metrics_sync_s = env_cfg.metrics_sync_seconds()
+        self._last_metrics_push = 0.0
         # Coordinator state
         self.message_table: Dict[str, _TensorRecord] = {}
         # Join state (ref: global_state.h:103-107, controller.cc:220-308)
@@ -168,9 +182,14 @@ class Controller:
                 uncached.append(self._pending_cached.pop(bit))
 
             # Pass 2: OR of status flags + invalid bits, computed *after*
-            # the requeue so HAS_UNCACHED reflects it.
+            # the requeue so HAS_UNCACHED reflects it. A rank overdue for
+            # a telemetry push raises the flag too: in a fully-cached
+            # steady state no gather would otherwise run, and the fleet
+            # view would go stale exactly when the job is busiest. The
+            # cost is one ordinary (empty) negotiation round per sync
+            # interval.
             flags = 0
-            if uncached:
+            if uncached or self._telemetry_due():
                 flags |= _FLAG_HAS_UNCACHED
             if shutdown:
                 flags |= _FLAG_SHUTDOWN
@@ -204,19 +223,33 @@ class Controller:
                 ):
                     responses.append(self.response_cache.get_response_by_bit(bit))
                     self._pending_cached.pop(bit, None)
+                    self.response_cache.count_hit()
         else:
             any_uncached = True
 
         # --- full negotiation for uncached tensors ---------------------
         if any_uncached or not self.cache_enabled:
             req_list = RequestList(uncached, shutdown=shutdown)
+            # Attach at HALF the interval once a gather is happening
+            # anyway: a rank dragged into another rank's telemetry-forced
+            # round publishes too and resets its timer, so per-rank
+            # deadlines coalesce into ~one forced round per interval
+            # instead of random-walking apart into world-size rounds.
+            if self._telemetry_elapsed() >= self._metrics_sync_s / 2 > 0:
+                from ..common import telemetry as _telemetry
+
+                self._last_metrics_push = time.monotonic()
+                req_list.telemetry = _telemetry.encode_push(
+                    self.registry, self.rank)
             gathered = self.transport.gather_bytes(req_list.serialize())
             if self.is_coordinator:
                 negotiated: List[Response] = []
                 ready_names: List[str] = []
                 joined_before = len(self.joined_ranks)
-                for payload in gathered:
+                for peer_rank, payload in enumerate(gathered):
                     rl = RequestList.deserialize(payload)
+                    if rl.telemetry is not None and self.fleet is not None:
+                        self.fleet.ingest(rl.telemetry, rank_hint=peer_rank)
                     shutdown = shutdown or rl.shutdown
                     for req in rl.requests:
                         if req.request_type == RequestType.JOIN:
@@ -271,6 +304,14 @@ class Controller:
             return resp_list, resp_list.shutdown
 
         return ResponseList(responses, shutdown=shutdown), shutdown
+
+    # ------------------------------------------------------------------
+    def _telemetry_elapsed(self) -> float:
+        return time.monotonic() - self._last_metrics_push
+
+    def _telemetry_due(self) -> bool:
+        return (self._metrics_sync_s > 0
+                and self._telemetry_elapsed() >= self._metrics_sync_s)
 
     # ------------------------------------------------------------------
     def _increment_tensor_count(self, req: Request) -> bool:
@@ -508,6 +549,12 @@ class Controller:
                 else (),
                 prescale_factor=resp.prescale_factor,
                 postscale_factor=resp.postscale_factor,
+                # Without echoing the negotiated reduce_op the key never
+                # matches the live request (which carries SUM=1), so every
+                # steady-state lookup came back INVALID and the cache fast
+                # path never engaged — invisible until the hit/miss
+                # counters existed.
+                reduce_op=resp.reduce_op,
             )
             self.response_cache.put(key_req, resp)
 

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import telemetry
 from ..common.exceptions import HorovodInternalError
 from ..common.message import Request, RequestType, Response, ResponseType
 from ..common.types import ReduceOp, Status, StatusType, to_wire_dtype
@@ -98,6 +99,7 @@ class Engine:
         cross_size: int = 1,
         backend=None,
         scope: Optional[str] = None,
+        registry: Optional[telemetry.MetricsRegistry] = None,
     ):
         # Rendezvous scope for the TCP mesh (subset communicators use a
         # ranks-derived scope; None = env / default world scope).
@@ -113,9 +115,37 @@ class Engine:
         self.controller: Optional[Controller] = None
         self.param_manager = None
         self.op_manager = None
-        self.tensor_queue = TensorQueue()
+        # Telemetry: one-process-per-rank jobs use the process default
+        # registry; the in-process multi-rank test harness passes one
+        # registry per engine so per-"rank" accounting stays separable.
+        self.registry = registry if registry is not None else telemetry.default_registry()
+        self._exporters: list = []
+        self._last_cycle_ts: Optional[float] = None
+        self._m_cycle = self.registry.histogram(
+            "horovod_cycle_seconds",
+            "Engine cycle work duration (sleep excluded)")
+        self._m_responses = self.registry.counter(
+            "horovod_responses_total", "Fused responses executed")
+        self._m_resp_tensors = self.registry.histogram(
+            "horovod_response_tensors",
+            "Tensors per fused response", min_exp=0, max_exp=12)
+        self._m_resp_bytes = self.registry.histogram(
+            "horovod_response_bytes",
+            "Payload bytes per fused response", min_exp=0, max_exp=34)
+        self._m_op_counters: Dict[str, Tuple] = {}
+        self._m_op_latency: Dict[str, telemetry.Histogram] = {}
+        self.registry.gauge(
+            "horovod_tensor_queue_depth",
+            "Tensors currently pending in the queue",
+        ).set_function(self.tensor_queue_depth)
+        self.registry.gauge(
+            "horovod_last_cycle_age_seconds",
+            "Seconds since the background loop last completed a cycle",
+        ).set_function(self._last_cycle_age)
+        self.tensor_queue = TensorQueue(registry=self.registry)
         self.handles = HandleManager()
-        self.timeline = Timeline() if rank == 0 else Timeline(use_env=False)
+        self.timeline = (Timeline(registry=self.registry) if rank == 0
+                         else Timeline(use_env=False, registry=self.registry))
         self.cycle_time_s = env_cfg.cycle_time_ms() / 1000.0
         self._thread: Optional[threading.Thread] = None
         self._shutdown_requested = threading.Event()
@@ -135,6 +165,75 @@ class Engine:
         self._fusion_storage: Dict[str, np.ndarray] = {}
 
     # ------------------------------------------------------------------
+    def tensor_queue_depth(self) -> int:
+        return self.tensor_queue.size()
+
+    def _last_cycle_age(self) -> float:
+        ts = self._last_cycle_ts
+        return (time.monotonic() - ts) if ts is not None else -1.0
+
+    def _observe_op(self, op_name: str, seconds: float):
+        h = self._m_op_latency.get(op_name)
+        if h is None:
+            h = self.registry.histogram(
+                "horovod_op_latency_seconds",
+                "Data-plane op execution latency by backend implementation",
+                labels={"op": op_name},
+            )
+            self._m_op_latency[op_name] = h
+        h.observe(seconds)
+
+    def _record_response(self, resp_type: ResponseType, ntensors: int,
+                         nbytes: int):
+        self._m_responses.inc()
+        self._m_resp_tensors.observe(ntensors)
+        self._m_resp_bytes.observe(nbytes)
+        ent = self._m_op_counters.get(resp_type.name)
+        if ent is None:
+            low = resp_type.name.lower()
+            ent = (
+                self.registry.counter(
+                    f"horovod_{low}_tensors_total",
+                    f"Tensors processed by {resp_type.name} responses"),
+                self.registry.counter(
+                    f"horovod_{low}_bytes_total",
+                    f"Input payload bytes moved by {resp_type.name}"),
+            )
+            self._m_op_counters[resp_type.name] = ent
+        ent[0].inc(ntensors)
+        ent[1].inc(nbytes)
+
+    def status(self) -> dict:
+        """Live job state for the /status endpoint — the running version
+        of the stall inspector's post-mortem (docs/metrics.md)."""
+        st = {
+            "rank": self.rank,
+            "size": self.size,
+            "queue_depth": self.tensor_queue.size(),
+            "pending_tensors": self.tensor_queue.pending_names(),
+            "last_cycle_age_seconds": self._last_cycle_age(),
+            "response_cycles": self.response_cycles,
+        }
+        ctrl = self.controller
+        if ctrl is not None and ctrl.is_coordinator:
+            now = time.monotonic()
+            pending = {}
+            try:
+                for name, (t0, ready) in list(ctrl.stall_inspector.pending.items()):
+                    ready = set(ready)
+                    pending[name] = {
+                        "age_seconds": now - t0,
+                        "ready_ranks": sorted(ready),
+                        "missing_ranks": sorted(set(range(self.size)) - ready),
+                    }
+            except RuntimeError:  # table resized under us; next scrape wins
+                pass
+            st["negotiating"] = pending
+            if ctrl.fleet is not None:
+                st["fleet"] = ctrl.fleet.snapshot()
+        return st
+
+    # ------------------------------------------------------------------
     def start(self):
         self._thread = threading.Thread(
             target=self._background_loop, name="hvd-background", daemon=True
@@ -145,6 +244,16 @@ class Engine:
         self._initialized.wait()
         if self._init_error is not None:
             raise self._init_error
+        # Env-driven exporters (HOROVOD_METRICS_PORT / _FILE): started
+        # only after init succeeds so /status always has a live engine
+        # behind it. Default-off: no env knobs, no threads, no sockets.
+        from ..common import metrics_export
+
+        fleet = self.controller.fleet if self.controller is not None else None
+        self._exporters = metrics_export.start_exporters_from_env(
+            registry=self.registry, fleet=fleet, status_fn=self.status,
+            rank=self.rank,
+        )
 
     def _background_loop(self):
         try:
@@ -158,15 +267,18 @@ class Engine:
                 from ..backend.tcp import TcpBackend
 
                 self.backend = TcpBackend(self.rank, self.size,
-                                          scope=self._scope)
+                                          scope=self._scope,
+                                          registry=self.registry)
             self.backend.set_topology(self.local_rank, self.local_size,
                                       self.cross_rank, self.cross_size)
             self.controller = Controller(self.backend, self.size, self.rank,
-                                         timeline=self.timeline)
+                                         timeline=self.timeline,
+                                         registry=self.registry)
             from .parameter_manager import ParameterManager
 
             self.param_manager = ParameterManager(
-                is_coordinator=(self.rank == 0)
+                is_coordinator=(self.rank == 0),
+                registry=self.registry,
             )
         except BaseException as e:  # surface rendezvous failures to init()
             self._init_error = e
@@ -230,6 +342,7 @@ class Engine:
     def _run_loop_once(self) -> bool:
         """(ref: RunLoopOnce, operations.cc:566-616)"""
         time.sleep(self.cycle_time_s)
+        cycle_t0 = time.monotonic()
         self.timeline.mark_cycle()
         messages = self.tensor_queue.pop_messages_from_queue()
         want_shutdown = self._shutdown_requested.is_set()
@@ -272,6 +385,11 @@ class Engine:
                 )
         for resp in resp_list.responses:
             self._perform_operation(resp)
+        # Cycle work duration (sleep excluded) + liveness stamp: the
+        # last-cycle age gauge is how /status distinguishes "idle" from
+        # "background loop wedged".
+        self._last_cycle_ts = time.monotonic()
+        self._m_cycle.observe(self._last_cycle_ts - cycle_t0)
         if should_shutdown:
             # A stall-inspector abort rides the shutdown broadcast as a
             # tensor-less ERROR response; its diagnosis becomes the
@@ -290,6 +408,11 @@ class Engine:
     def _perform_operation(self, resp: Response):
         """(ref: PerformOperation, operations.cc:253-330)"""
         entries = self.tensor_queue.get_tensor_entries(resp.tensor_names)
+        if resp.response_type != ResponseType.ERROR:
+            self._record_response(
+                resp.response_type, len(entries),
+                sum(e.tensor.nbytes for e in entries if e.tensor is not None),
+            )
         for e in entries:
             # Top-level op phase opens when execution begins
             # (ref: Timeline::Start, timeline.h:106-110); activities
@@ -313,21 +436,27 @@ class Engine:
                     op = self.op_manager.select(ResponseType.ALLGATHER,
                                                 nbytes=nbytes,
                                                 ndim=e.tensor.ndim)
+                    t0 = time.monotonic()
                     with self.timeline.activity(e.tensor_name, op.name):
                         out = op.execute(e.tensor, list(resp.tensor_sizes))
+                    self._observe_op(op.name, time.monotonic() - t0)
                     self._finish(e, Status.OK(), out)
             elif resp.response_type == ResponseType.BROADCAST:
                 op = self.op_manager.select(ResponseType.BROADCAST)
                 for e in entries:
                     arr = e.tensor if self.rank == e.root_rank else None
+                    t0 = time.monotonic()
                     with self.timeline.activity(e.tensor_name, op.name):
                         out = op.execute(arr, e.root_rank)
+                    self._observe_op(op.name, time.monotonic() - t0)
                     self._finish(e, Status.OK(), out)
             elif resp.response_type == ResponseType.ALLTOALL:
                 op = self.op_manager.select(ResponseType.ALLTOALL)
                 for e in entries:
+                    t0 = time.monotonic()
                     with self.timeline.activity(e.tensor_name, op.name):
                         out, recv_splits = op.execute(e.tensor, e.splits)
+                    self._observe_op(op.name, time.monotonic() - t0)
                     e.output = out
                     self._finish(e, Status.OK(), (out, recv_splits))
             elif resp.response_type == ResponseType.BARRIER:
@@ -410,8 +539,10 @@ class Engine:
             ResponseType.ADASUM if adasum else ResponseType.ALLREDUCE,
             nbytes=buf.nbytes, reduce_op=rop,
         )
+        t0 = time.monotonic()
         with self.timeline.activity(name0, op.name):
             red = op.execute(buf, rop)
+        self._observe_op(op.name, time.monotonic() - t0)
         if post != 1.0:
             red = _scale_np(red, post)
         if shapes is None:
@@ -586,3 +717,15 @@ class Engine:
         self._shutdown_requested.set()
         self._thread.join(timeout=60)
         self._thread = None
+        for exp in self._exporters:
+            try:
+                exp.stop()
+            except Exception:  # pragma: no cover - exporter already dead
+                pass
+        self._exporters = []
+        # Detach the pull-gauges' bound methods: on the process-default
+        # registry they would otherwise pin this dead Engine (fusion
+        # buffers included) for process lifetime and report its frozen
+        # state as live after an elastic shutdown+init cycle.
+        self.registry.gauge("horovod_tensor_queue_depth").set_function(None)
+        self.registry.gauge("horovod_last_cycle_age_seconds").set_function(None)

@@ -50,7 +50,25 @@ class ParameterManager:
         log_path: Optional[str] = None,
         tune_hierarchical: bool = False,
         tune_cache: bool = True,
+        registry=None,
     ):
+        from ..common import telemetry
+
+        if registry is None:
+            registry = telemetry.default_registry()
+        self._m_samples = registry.counter(
+            "horovod_autotune_samples_total",
+            "Autotune sample windows scored (coordinator)")
+        self._m_score = registry.gauge(
+            "horovod_autotune_score_bytes_per_second",
+            "Last autotune window score")
+        self._m_fusion = registry.gauge(
+            "horovod_fusion_threshold_bytes", "Active fusion threshold")
+        self._m_cycle_ms = registry.gauge(
+            "horovod_cycle_time_ms", "Active engine cycle time")
+        self._m_done = registry.gauge(
+            "horovod_autotune_done",
+            "1 once tuning converged (or autotune is off)")
         self.enabled = (
             env_cfg.get_bool(env_cfg.AUTOTUNE, False)
             if enabled is None else enabled
@@ -75,6 +93,9 @@ class ParameterManager:
         # with its own GP over the continuous box.
         self._tune_cache = tune_cache
         self._build_arms(tune_hierarchical)
+        self._m_fusion.set(self.fusion_threshold)
+        self._m_cycle_ms.set(self.cycle_time_ms)
+        self._m_done.set(1.0 if self.done else 0.0)
         self._log_path = log_path if log_path is not None else (
             env_cfg.get_str(env_cfg.AUTOTUNE_LOG) or None
         )
@@ -148,7 +169,14 @@ class ParameterManager:
             self._on_sample(score)
         return True
 
+    def _sync_gauges(self):
+        self._m_fusion.set(self.fusion_threshold)
+        self._m_cycle_ms.set(self.cycle_time_ms)
+        self._m_done.set(1.0 if self.done else 0.0)
+
     def _on_sample(self, score: float) -> bool:
+        self._m_samples.inc()
+        self._m_score.set(score)
         self._arm_bo[self._arm_idx].register(
             [self.fusion_threshold / (1024.0 * 1024.0), self.cycle_time_ms],
             score,
@@ -174,6 +202,7 @@ class ParameterManager:
                 self.cycle_time_ms = float(best_x[1])
                 self.hierarchical, self.cache_enabled = self._arms[best_arm]
             self.done = True
+            self._sync_gauges()
             logger.info(
                 "autotune done: fusion=%.1fMB cycle=%.2fms hier=%s cache=%s "
                 "(%.0f bytes/s)",
@@ -187,6 +216,7 @@ class ParameterManager:
         nxt = self._arm_bo[self._arm_idx].next_sample()
         self.fusion_threshold = int(nxt[0] * 1024 * 1024)
         self.cycle_time_ms = float(nxt[1])
+        self._sync_gauges()
         return True
 
     # ------------------------------------------------------------------
@@ -207,3 +237,4 @@ class ParameterManager:
         self.hierarchical = bool(d.get("hierarchical", False))
         self.cache_enabled = bool(d.get("cache_enabled", True))
         self.done = bool(d["done"])
+        self._sync_gauges()

@@ -40,7 +40,20 @@ class CacheState:
 
 
 class ResponseCache:
-    def __init__(self, capacity: int = 1024):
+    def __init__(self, capacity: int = 1024, registry=None):
+        from ..common import telemetry
+
+        if registry is None:
+            registry = telemetry.default_registry()
+        self._m_hits = registry.counter(
+            "horovod_response_cache_hits_total",
+            "Negotiations short-circuited by the response cache")
+        self._m_misses = registry.counter(
+            "horovod_response_cache_misses_total",
+            "Requests with no usable cache entry")
+        self._m_invalid = registry.counter(
+            "horovod_response_cache_invalidations_total",
+            "Cache entries dropped because the request signature changed")
         self.capacity = capacity
         # name -> (bit, key, response)
         self._by_name: Dict[str, Tuple[int, Tuple, Response]] = {}
@@ -52,9 +65,23 @@ class ResponseCache:
     def cached(self, req: Request) -> int:
         ent = self._by_name.get(req.tensor_name)
         if ent is None:
+            self._m_misses.inc()
             return CacheState.MISS
         bit, key, _ = ent
-        return CacheState.HIT if key == _request_key(req) else CacheState.INVALID
+        if key == _request_key(req):
+            # NOT counted as a hit yet: the cross-rank AND pass may still
+            # requeue this request into full negotiation (peers not
+            # ready). The controller calls count_hit() only when the
+            # cached response is actually emitted, so the hit rate
+            # measures fast-path responses served, not optimistic local
+            # lookups.
+            return CacheState.HIT
+        self._m_invalid.inc()
+        return CacheState.INVALID
+
+    def count_hit(self):
+        """One response actually served from the cache fast path."""
+        self._m_hits.inc()
 
     def put(self, req: Request, resp: Response):
         if req.tensor_name in self._by_name:

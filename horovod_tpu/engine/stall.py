@@ -17,7 +17,17 @@ logger = get_logger()
 
 
 class StallInspector:
-    def __init__(self, size: int):
+    def __init__(self, size: int, registry=None):
+        from ..common import telemetry
+
+        if registry is None:
+            registry = telemetry.default_registry()
+        self._m_warnings = registry.counter(
+            "horovod_stall_warnings_total",
+            "Tensors that stalled past the warning threshold")
+        self._m_aborts = registry.counter(
+            "horovod_stall_aborts_total",
+            "Stall-shutdown aborts issued by the coordinator")
         self.size = size
         self.enabled = not env_cfg.get_bool(env_cfg.STALL_CHECK_DISABLE, False)
         self.warning_time = env_cfg.get_float(
@@ -65,8 +75,10 @@ class StallInspector:
                     age, name, sorted(ready), missing,
                 )
                 self.warned.add(name)
+                self._m_warnings.inc()
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 logger.error("Stall shutdown time exceeded for %s; aborting.", name)
+                self._m_aborts.inc()
                 if abort is None:
                     abort = (
                         f"stall shutdown: op {name} waited {age:.0f}s "

@@ -34,7 +34,18 @@ class TensorTableEntry:
 
 
 class TensorQueue:
-    def __init__(self):
+    def __init__(self, registry=None):
+        from ..common import telemetry
+
+        if registry is None:
+            registry = telemetry.default_registry()
+        self._m_latched = registry.counter(
+            "horovod_tensor_queue_latched_errors_total",
+            "Enqueues rejected because the engine already died "
+            "(terminal status latched)")
+        self._m_aborted = registry.counter(
+            "horovod_tensor_queue_aborted_entries_total",
+            "Pending entries failed by finalize() on engine death")
         self._lock = threading.Lock()
         self._tensor_table: Dict[str, TensorTableEntry] = {}
         self._message_queue: List[Request] = []
@@ -49,6 +60,7 @@ class TensorQueue:
     def add_to_tensor_queue(self, entry: TensorTableEntry, request: Request) -> Status:
         with self._lock:
             if self._final_status is not None:
+                self._m_latched.inc()
                 return self._final_status
             if entry.tensor_name in self._tensor_table:
                 return Status.InvalidArgument(DUPLICATE_NAME_ERROR)
@@ -87,6 +99,11 @@ class TensorQueue:
         with self._lock:
             return len(self._tensor_table)
 
+    def pending_names(self) -> List[str]:
+        """Names of tensors still awaiting a response (for /status)."""
+        with self._lock:
+            return sorted(self._tensor_table)
+
     def finalize(self, status: Status):
         """Abort ALL pending entries with `status` and latch it as the
         terminal state (ref: tensor_queue.cc FinalizeTensorQueue). Every
@@ -96,6 +113,7 @@ class TensorQueue:
         at once."""
         with self._lock:
             self._final_status = status
+            self._m_aborted.inc(len(self._tensor_table))
             for e in self._tensor_table.values():
                 if e.callback:
                     e.callback(status, None)

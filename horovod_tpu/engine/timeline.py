@@ -18,6 +18,9 @@ import time
 from typing import Dict, Optional
 
 from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+
+logger = get_logger()
 
 # Activity names (ref: horovod/common/common.h:32-62)
 QUEUE = "QUEUE"
@@ -27,7 +30,10 @@ NEGOTIATE = "NEGOTIATE"
 
 
 class Timeline:
-    def __init__(self, filename: Optional[str] = None, use_env: bool = True):
+    def __init__(self, filename: Optional[str] = None, use_env: bool = True,
+                 registry=None, queue_size: int = 1 << 20):
+        from ..common import telemetry
+
         # use_env=False on non-coordinator ranks: only rank 0 writes
         # (ref: operations.cc:416-429).
         if filename is None and use_env:
@@ -35,11 +41,18 @@ class Timeline:
         self.filename = filename
         self.enabled = bool(self.filename)
         self.mark_cycles = env_cfg.get_bool(env_cfg.TIMELINE_MARK_CYCLES, False)
-        self._q: "queue.Queue" = queue.Queue(maxsize=1 << 20)
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._tids: Dict[str, int] = {}
         self._writer: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._t0 = time.monotonic_ns()
+        # A full writer queue drops events (the hot path must never block
+        # on file IO) — but silently losing trace data made every
+        # truncated timeline look complete. Count the drops, shout once.
+        self._m_dropped = (registry or telemetry.default_registry()).counter(
+            "horovod_timeline_events_dropped_total",
+            "Timeline events dropped because the writer queue was full")
+        self._warned_drop = False
         if self.enabled:
             self._writer = threading.Thread(
                 target=self._write_loop, name="hvd-timeline", daemon=True
@@ -60,7 +73,13 @@ class Timeline:
         try:
             self._q.put_nowait(ev)
         except queue.Full:
-            pass
+            self._m_dropped.inc()
+            if not self._warned_drop:
+                self._warned_drop = True
+                logger.warning(
+                    "timeline writer queue is full; dropping events (the "
+                    "trace will have gaps — see "
+                    "horovod_timeline_events_dropped_total)")
 
     # -- per-tensor state machine (ref: timeline.h:81-126) --------------
     def negotiate_start(self, name: str, op_name: str):
@@ -130,6 +149,21 @@ class Timeline:
 
     def shutdown(self):
         if self.enabled and self._writer is not None:
-            self._stop.set()
-            self._writer.join(timeout=5)
+            # Disable BEFORE draining so no new events race the flush,
+            # then give the writer time proportional to the backlog
+            # instead of a flat 5s that abandons buffered events of a
+            # long run mid-file.
             self.enabled = False
+            self._stop.set()
+            deadline = time.monotonic() + 30.0
+            while self._writer.is_alive() and time.monotonic() < deadline:
+                self._writer.join(timeout=1.0)
+            if self._writer.is_alive():
+                logger.warning(
+                    "timeline writer did not drain %d buffered events "
+                    "before shutdown", self._q.qsize())
+            dropped = self._m_dropped.value
+            if dropped:
+                logger.warning(
+                    "timeline dropped %d events during the run (writer "
+                    "queue full); the trace has gaps", dropped)

@@ -25,6 +25,7 @@ from ..common.basics import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    metrics,
     mpi_built,
     gloo_built,
     nccl_built,

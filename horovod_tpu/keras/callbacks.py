@@ -59,6 +59,24 @@ class MetricAverageCallback(keras.callbacks.Callback):
                 )))
 
 
+class MetricsCallback(keras.callbacks.Callback):
+    """One-line telemetry summary (step time, allreduce MB/s, cache
+    hit %) every `interval` batches, from rank 0 only — the Keras
+    spelling of horovod_tpu.callbacks.MetricsCallback
+    (docs/metrics.md)."""
+
+    def __init__(self, interval: int = 100, log_fn=None,
+                 root_only: bool = True, registry=None):
+        super().__init__()
+        from ..common import telemetry
+
+        self._logger = telemetry.StepSummaryLogger(
+            interval, log_fn, root_only, registry)
+
+    def on_batch_end(self, batch, logs=None):
+        self._logger.step()
+
+
 class LearningRateScheduleCallback(keras.callbacks.Callback):
     """Schedule LR as multiplier(epoch) × initial
     (ref: _keras/callbacks.py:90-145)."""

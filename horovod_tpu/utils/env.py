@@ -59,6 +59,24 @@ CONNECT_ATTEMPTS = "HOROVOD_CONNECT_ATTEMPTS"
 CONNECT_BACKOFF = "HOROVOD_CONNECT_BACKOFF_SECONDS"
 CONNECT_BACKOFF_CAP = "HOROVOD_CONNECT_BACKOFF_CAP_SECONDS"
 
+# -- telemetry knobs (docs/metrics.md) ---------------------------------
+# Serve Prometheus text at /metrics and live job state at /status from a
+# daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
+METRICS_PORT = "HOROVOD_METRICS_PORT"
+# Bind address for the metrics endpoint. Default 127.0.0.1: the
+# endpoint is unauthenticated (/status exposes tensor names and
+# per-rank state), so network exposure for remote Prometheus scrapers
+# is the explicit opt-in (HOROVOD_METRICS_ADDR=0.0.0.0).
+METRICS_ADDR = "HOROVOD_METRICS_ADDR"
+# Periodic JSON snapshot dump; `{rank}` in the path expands per rank.
+METRICS_FILE = "HOROVOD_METRICS_FILE"
+METRICS_FILE_INTERVAL = "HOROVOD_METRICS_FILE_INTERVAL"
+# How often each rank piggybacks its scalar snapshot on the coordinator
+# control plane for rank 0's fleet view; 0 disables aggregation.
+METRICS_SYNC_SECONDS = "HOROVOD_METRICS_SYNC_SECONDS"
+
+DEFAULT_METRICS_SYNC_SECONDS = 3.0
+
 DEFAULT_TCP_POLL_SECONDS = 1.0
 DEFAULT_CONNECT_ATTEMPTS = 5
 DEFAULT_CONNECT_BACKOFF_SECONDS = 0.1
@@ -131,6 +149,12 @@ def tcp_poll_seconds() -> float:
         # recv() could overshoot it.
         poll = min(poll, max(timeout / 4.0, 0.01))
     return max(poll, 0.01)
+
+
+def metrics_sync_seconds() -> float:
+    """Interval between per-rank telemetry pushes to rank 0's fleet view;
+    0 disables cross-rank aggregation."""
+    return get_float(METRICS_SYNC_SECONDS, DEFAULT_METRICS_SYNC_SECONDS)
 
 
 def connect_retry_policy() -> "tuple[int, float, float]":

@@ -21,6 +21,25 @@ logger = get_logger()
 T = TypeVar("T")
 
 
+_retry_counter_cache = None
+
+
+def _retry_counter():
+    # Lazy: utils must stay importable without the common package being
+    # initialized first (launcher entry points import utils early).
+    # Cached after first resolution — call_with_retry runs inside 20Hz
+    # bootstrap polling loops and must not pay a registry lookup per call.
+    global _retry_counter_cache
+    if _retry_counter_cache is None:
+        from ..common import telemetry
+
+        _retry_counter_cache = telemetry.counter(
+            "horovod_retry_attempts_total",
+            "Failed attempts absorbed by retry loops (connects, rendezvous KV)",
+        )
+    return _retry_counter_cache
+
+
 def backoff_delays(attempts: int, base: float, cap: float):
     """Yield attempts-1 sleep durations: base doubling per attempt,
     capped, with +/-50% jitter."""
@@ -52,6 +71,7 @@ def call_with_retry(
     cap = env_cap if cap is None else cap
     delays = list(backoff_delays(attempts, base, cap)) + [0.0]
     last: Optional[BaseException] = None
+    counter = _retry_counter()
     for attempt, delay in enumerate(delays, 1):
         try:
             return fn()
@@ -59,11 +79,25 @@ def call_with_retry(
             raise
         except retry_on as exc:
             last = exc
+            counter.inc()
             expired = (deadline is not None
                        and time.monotonic() + delay > deadline)
             if attempt >= attempts or expired:
+                # Final attempt: one WARNING carries the whole story —
+                # what failed, how many attempts it survived, and that
+                # the error is about to propagate.
+                logger.warning(
+                    "%s failed after %d attempt(s): %s; giving up",
+                    what, attempt, exc,
+                )
                 raise
-            logger.debug(
+            # Log the FIRST failure at WARNING so a flapping dependency
+            # is visible, then count the rest silently in
+            # horovod_retry_attempts_total — N workers retrying with
+            # backoff otherwise emit O(attempts × ranks) warning lines
+            # for one transient blip.
+            log = logger.warning if attempt == 1 else logger.debug
+            log(
                 "%s failed (attempt %d/%d): %s; retrying in %.2fs",
                 what, attempt, attempts, exc, delay,
             )

@@ -5,8 +5,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== unit + integration tests ==="
-python -m pytest tests/ -x -q
+echo "=== unit + integration tests (fast tier) ==="
+python -m pytest tests/ -x -q -m 'not slow'
+
+echo "=== slow tier (full adapter / chaos coverage) ==="
+python -m pytest tests/ -x -q -m slow
+
+echo "=== telemetry smoke (metrics endpoint + snapshot) ==="
+python scripts/telemetry_smoke.py
 
 echo "=== multichip sharding dryrun (8 virtual devices) ==="
 python __graft_entry__.py

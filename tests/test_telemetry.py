@@ -1,0 +1,495 @@
+"""Telemetry subsystem tests: registry semantics (concurrent increments,
+log2 histogram bucketing, snapshot/reset), Prometheus/JSON exposition,
+the live HTTP endpoint, and 2-worker cross-rank aggregation over the
+threaded backend (docs/metrics.md)."""
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import metrics_export, telemetry
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+# ---------------------------------------------------------------------------
+# Registry
+
+
+def test_counter_concurrent_increments():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("c_total")
+    n_threads, per_thread = 8, 5000
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+
+
+def test_counter_weighted_and_registry_identity():
+    reg = telemetry.MetricsRegistry()
+    a = reg.counter("bytes_total", "help text")
+    b = reg.counter("bytes_total")
+    assert a is b  # get-or-create returns the same object
+    a.inc(10)
+    b.inc(32)
+    assert a.value == 42
+    with pytest.raises(TypeError):
+        reg.gauge("bytes_total")  # kind mismatch must be loud
+
+
+def test_labels_distinguish_series():
+    reg = telemetry.MetricsRegistry()
+    x = reg.counter("op_total", labels={"op": "allreduce"})
+    y = reg.counter("op_total", labels={"op": "allgather"})
+    assert x is not y
+    x.inc(3)
+    y.inc(4)
+    snap = reg.snapshot()
+    assert snap['op_total{op="allreduce"}'] == 3
+    assert snap['op_total{op="allgather"}'] == 4
+
+
+def test_gauge_set_and_function():
+    reg = telemetry.MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(5)
+    assert g.value == 5
+    g.inc(2)
+    assert g.value == 7
+    pulled = reg.gauge("pulled")
+    pulled.set_function(lambda: 13)
+    assert pulled.value == 13
+    assert reg.snapshot()["pulled"] == 13
+
+
+def test_histogram_log2_bucketing():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("lat", min_exp=-3, max_exp=3)
+    # bounds: 0.125, 0.25, 0.5, 1, 2, 4, 8 (+Inf overflow)
+    assert h.bounds == [0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0]
+    h.observe(0.01)    # underflow -> first bucket (le 0.125)
+    h.observe(0.125)   # exactly a bound -> that bucket
+    h.observe(0.3)     # (0.25, 0.5]
+    h.observe(1.0)     # exactly 1 -> le 1 bucket
+    h.observe(1.5)     # (1, 2]
+    h.observe(100.0)   # overflow -> +Inf
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(0.01 + 0.125 + 0.3 + 1.0 + 1.5 + 100.0)
+    assert snap["counts"] == [2, 0, 1, 1, 1, 0, 0, 1]
+
+
+def test_histogram_concurrent_observes():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("lat")
+
+    def worker():
+        for _ in range(2000):
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 8000
+    assert sum(h.snapshot()["counts"]) == 8000
+
+
+def test_snapshot_and_reset():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("a_total").inc(3)
+    reg.gauge("b").set(4)
+    reg.histogram("c").observe(1.0)
+    snap = reg.snapshot()
+    assert snap["a_total"] == 3 and snap["b"] == 4
+    assert snap["c"]["count"] == 1
+    reg.reset()
+    snap = reg.snapshot()
+    assert snap["a_total"] == 0 and snap["b"] == 0
+    assert snap["c"]["count"] == 0 and snap["c"]["sum"] == 0
+
+
+def test_scalars_flattens_histograms():
+    reg = telemetry.MetricsRegistry()
+    reg.histogram("h").observe(2.0)
+    reg.counter("c_total").inc()
+    s = reg.scalars()
+    assert s["h_count"] == 1
+    assert s["h_sum"] == pytest.approx(2.0)
+    assert s["c_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Exposition formats
+
+
+def _sample_registry():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("horovod_allreduce_bytes_total", "bytes moved").inc(4096)
+    reg.gauge("horovod_tensor_queue_depth", "pending").set(2)
+    h = reg.histogram("horovod_cycle_seconds", "cycle", min_exp=-3, max_exp=1)
+    h.observe(0.2)
+    h.observe(0.7)
+    h.observe(50.0)
+    reg.counter("horovod_op_latency_total",
+                labels={"op": "RING_ALLREDUCE"}).inc(5)
+    return reg
+
+
+def test_prometheus_exposition_format():
+    text = metrics_export.to_prometheus(_sample_registry())
+    lines = text.strip().splitlines()
+    assert "# TYPE horovod_allreduce_bytes_total counter" in lines
+    assert "horovod_allreduce_bytes_total 4096" in lines
+    assert "# TYPE horovod_tensor_queue_depth gauge" in lines
+    assert "horovod_tensor_queue_depth 2" in lines
+    assert "# TYPE horovod_cycle_seconds histogram" in lines
+    assert 'horovod_op_latency_total{op="RING_ALLREDUCE"} 5' in lines
+    # Histogram buckets: cumulative, ending at +Inf == count.
+    buckets = [l for l in lines if l.startswith("horovod_cycle_seconds_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert buckets[-1].startswith('horovod_cycle_seconds_bucket{le="+Inf"}')
+    assert counts[-1] == 3
+    assert "horovod_cycle_seconds_count 3" in lines
+    # le="1" bucket holds the two sub-second observations
+    le1 = [l for l in buckets if 'le="1.0"' in l]
+    assert le1 and int(le1[0].rsplit(" ", 1)[1]) == 2
+
+
+def test_json_export_roundtrip():
+    doc = json.loads(metrics_export.to_json(_sample_registry()))
+    m = doc["metrics"]
+    assert m["horovod_allreduce_bytes_total"] == 4096
+    assert m["horovod_cycle_seconds"]["count"] == 3
+    assert "time" in doc
+
+
+def test_metrics_file_writer(tmp_path):
+    reg = _sample_registry()
+    path = tmp_path / "metrics-{rank}.json"
+    w = metrics_export.MetricsFileWriter(str(path), reg, interval=0.05, rank=3)
+    w.start()
+    target = tmp_path / "metrics-3.json"
+    deadline = time.monotonic() + 10
+    while not target.exists() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    w.stop()
+    doc = json.loads(target.read_text())
+    assert doc["rank"] == 3
+    assert doc["metrics"]["horovod_allreduce_bytes_total"] == 4096
+
+
+def test_http_endpoints():
+    reg = _sample_registry()
+    status = {"rank": 0, "size": 2, "queue_depth": 1,
+              "pending_tensors": ["allreduce.t"]}
+    srv = metrics_export.MetricsHTTPServer(
+        0, registry=reg, status_fn=lambda: status).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "horovod_allreduce_bytes_total 4096" in body
+        conn.request("GET", "/status")
+        st = json.loads(conn.getresponse().read())
+        assert st == status
+        conn.request("GET", "/metrics.json")
+        mj = json.loads(conn.getresponse().read())
+        assert mj["metrics"]["horovod_tensor_queue_depth"] == 2
+        conn.request("GET", "/bogus")
+        assert conn.getresponse().read() and True
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation primitives
+
+
+def test_fleet_view_min_max_sum_tags_ranks():
+    fleet = telemetry.FleetView(3)
+    for r, v in enumerate([10.0, 50.0, 30.0]):
+        fleet.ingest(json.dumps(
+            {"rank": r, "time": time.time(),
+             "metrics": {"horovod_allreduce_bytes_total": v}}).encode())
+    snap = fleet.snapshot()
+    agg = snap["aggregate"]["horovod_allreduce_bytes_total"]
+    assert agg["min"] == 10.0 and agg["min_rank"] == 0
+    assert agg["max"] == 50.0 and agg["max_rank"] == 1
+    assert agg["sum"] == 90.0 and agg["count"] == 3
+    assert sorted(snap["ranks"]) == [0, 1, 2]
+
+
+def test_fleet_view_ignores_garbage():
+    fleet = telemetry.FleetView(2)
+    fleet.ingest(b"\xff\xfenot json")
+    fleet.ingest(b"{}")  # no rank
+    assert fleet.snapshot()["ranks"] == {}
+
+
+# ---------------------------------------------------------------------------
+# 2-worker cross-rank aggregation + exact byte accounting
+
+
+def test_two_worker_aggregation_and_byte_accounting(monkeypatch):
+    from test_engine import run_ranks
+
+    # Push telemetry on (almost) every gather so the short run refreshes
+    # the fleet view after bytes have been counted.
+    monkeypatch.setenv("HOROVOD_METRICS_SYNC_SECONDS", "0.001")
+
+    from horovod_tpu.backend.threaded import ThreadedGroup
+    from horovod_tpu.engine.engine import Engine
+
+    group = ThreadedGroup(2)
+    regs = [telemetry.MetricsRegistry() for _ in range(2)]
+    engines = [
+        Engine(rank=r, size=2, backend=group.backend(r), registry=regs[r])
+        for r in range(2)
+    ]
+    for e in engines:
+        e.cycle_time_s = 0.001
+        e.start()
+    iters, elems = 4, 8
+    expected_bytes = iters * elems * 4  # float32
+
+    def work(r):
+        out = []
+        for i in range(iters):
+            h = engines[r].enqueue_allreduce(
+                np.full(elems, float(r + 1), np.float32), name=f"t{i}")
+            out.append(engines[r].synchronize(h, timeout=30))
+        return out
+
+    errors = [None, None]
+    results = [None, None]
+
+    def runner(r):
+        try:
+            results[r] = work(r)
+        except BaseException as ex:  # noqa: BLE001
+            errors[r] = ex
+
+    threads = [threading.Thread(target=runner, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        for err in errors:
+            if err is not None:
+                raise err
+        for i in range(iters):
+            np.testing.assert_allclose(results[0][i], np.full(elems, 3.0))
+        # Per-rank registries: allreduce_bytes_total counts exactly the
+        # input payload this rank contributed to reduced responses.
+        for r in range(2):
+            snap = regs[r].snapshot()
+            assert snap["horovod_allreduce_bytes_total"] == expected_bytes
+            assert snap["horovod_allreduce_tensors_total"] == iters
+            assert snap["horovod_cycle_seconds"]["count"] > 0
+            assert snap["horovod_responses_total"] >= 1
+        # One more collective forces a fresh telemetry push AFTER the
+        # byte counters above were bumped, so rank 0's fleet view holds
+        # final per-rank numbers.
+        def flush(r):
+            engines[r].synchronize(
+                engines[r].enqueue_allreduce(
+                    np.ones(2, np.float32), name="flush"), timeout=30)
+
+        fthreads = [threading.Thread(target=flush, args=(r,)) for r in range(2)]
+        for t in fthreads:
+            t.start()
+        for t in fthreads:
+            t.join(timeout=60)
+        fleet = engines[0].controller.fleet.snapshot()
+        assert sorted(fleet["ranks"]) == [0, 1]
+        agg = fleet["aggregate"]["horovod_allreduce_bytes_total"]
+        assert agg["count"] == 2
+        assert agg["min"] >= expected_bytes
+        # /status surfaces live queue/negotiation state + the fleet.
+        status = engines[0].status()
+        assert status["queue_depth"] == 0
+        assert status["pending_tensors"] == []
+        assert status["last_cycle_age_seconds"] >= 0
+        assert "fleet" in status
+    finally:
+        stop = [threading.Thread(target=e.shutdown) for e in engines]
+        for t in stop:
+            t.start()
+        for t in stop:
+            t.join(timeout=60)
+
+
+def test_response_cache_hit_metrics(monkeypatch):
+    """Steady-state reduction of one named tensor: first cycle misses,
+    later cycles hit; the counters must reflect it."""
+    monkeypatch.setenv("HOROVOD_METRICS_SYNC_SECONDS", "0")
+
+    from horovod_tpu.backend.threaded import ThreadedGroup
+    from horovod_tpu.engine.engine import Engine
+
+    group = ThreadedGroup(2)
+    regs = [telemetry.MetricsRegistry() for _ in range(2)]
+    engines = [
+        Engine(rank=r, size=2, backend=group.backend(r), registry=regs[r])
+        for r in range(2)
+    ]
+    for e in engines:
+        e.cycle_time_s = 0.001
+        e.start()
+
+    def work(r):
+        for it in range(6):
+            engines[r].synchronize(
+                engines[r].enqueue_allreduce(
+                    np.full(2, float(it), np.float32), name="steady"),
+                timeout=30)
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        snap = regs[0].snapshot()
+        assert snap["horovod_response_cache_misses_total"] >= 1
+        assert snap["horovod_response_cache_hits_total"] >= 1
+    finally:
+        stop = [threading.Thread(target=e.shutdown) for e in engines]
+        for t in stop:
+            t.start()
+        for t in stop:
+            t.join(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# Satellites: timeline drop accounting, retry counters
+
+
+def test_timeline_drop_counting_and_flush(tmp_path):
+    from horovod_tpu.engine.timeline import Timeline
+
+    reg = telemetry.MetricsRegistry()
+    path = tmp_path / "tl.json"
+    tl = Timeline(filename=str(path), registry=reg, queue_size=4)
+    # Saturate the tiny queue faster than the writer can drain: some
+    # events must be counted as dropped, none may raise.
+    for i in range(5000):
+        tl.start(f"t{i % 3}", "ALLREDUCE")
+        tl.end(f"t{i % 3}", "ALLREDUCE")
+    tl.shutdown()
+    dropped = reg.snapshot()["horovod_timeline_events_dropped_total"]
+    written = json.loads(path.read_text())
+    assert dropped > 0
+    # Everything not dropped reached the file: the writer drained the
+    # queue on shutdown instead of abandoning it.
+    assert len(written) + dropped == 10000
+
+
+class _CaptureHandler(__import__("logging").Handler):
+    """The horovod logger sets propagate=False, so caplog (root-handler
+    based) never sees it; capture with a handler attached directly."""
+
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture
+def hvd_log():
+    import logging
+
+    from horovod_tpu.utils.logging import get_logger
+
+    logger = get_logger()
+    h = _CaptureHandler()
+    prev = logger.level
+    logger.addHandler(h)
+    logger.setLevel(logging.DEBUG)
+    yield h
+    logger.removeHandler(h)
+    logger.setLevel(prev)
+
+
+def test_retry_attempts_counted_and_quiet(hvd_log):
+    import logging
+
+    from horovod_tpu.utils.retry import call_with_retry
+
+    c = telemetry.counter("horovod_retry_attempts_total")
+    start = c.value
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError("transient")
+        return "ok"
+
+    out = call_with_retry(flaky, "flaky op", attempts=5, base=0.001,
+                          cap=0.002)
+    assert out == "ok"
+    assert c.value - start == 3
+    warnings = [r for r in hvd_log.records
+                if r.levelno == logging.WARNING
+                and "flaky op" in r.getMessage()]
+    assert len(warnings) == 1  # first failure only; the rest are counted
+
+
+def test_retry_final_failure_logs_warning(hvd_log):
+    from horovod_tpu.utils.retry import call_with_retry
+
+    with pytest.raises(OSError):
+        call_with_retry(lambda: (_ for _ in ()).throw(OSError("down")),
+                        "doomed op", attempts=3, base=0.001, cap=0.002)
+    giving_up = [r for r in hvd_log.records if "giving up" in r.getMessage()]
+    assert len(giving_up) == 1
+
+
+# ---------------------------------------------------------------------------
+# hvd.metrics() surface + MetricsCallback
+
+
+def test_metrics_api_shape(hvd_single):
+    m = hvd_single.metrics()
+    assert m["size"] == 1 and m["mode"] == "mesh"
+    assert isinstance(m["metrics"], dict)
+
+
+def test_metrics_callback_logs_summary():
+    from horovod_tpu.callbacks import MetricsCallback
+
+    reg = telemetry.MetricsRegistry()
+    reg.counter("horovod_allreduce_bytes_total").inc(10 * 1000 * 1000)
+    lines = []
+    cb = MetricsCallback(interval=5, log_fn=lines.append, root_only=False,
+                         registry=reg)
+    ctx = {}
+    for b in range(10):
+        cb.on_batch_end(b, ctx)
+    assert len(lines) == 2
+    assert "allreduce" in lines[0] and "cache hit" in lines[0]
+    with pytest.raises(ValueError):
+        MetricsCallback(interval=0)

@@ -1,7 +1,16 @@
 """horovod_tpu.tensorflow / horovod_tpu.keras adapter tests
 (ref test model: test/test_tensorflow.py op coverage,
 test/test_tensorflow2_keras.py optimizer/callback coverage — under 2
-real ranks via the func-mode runner, like test_torch_adapter.py)."""
+real ranks via the func-mode runner, like test_torch_adapter.py).
+
+Tiering: each 2-rank case spawns TF in two subprocesses (~25-40s
+apiece), and the full file (~360s) blew the tier-1 harness budget. The
+deep-coverage cases are marked `slow`; tier-1 keeps a smoke subset —
+basic collectives (test_tf_collectives_two_ranks), fusion/cache engine
+behavior (test_tf_grads_fuse_in_few_engine_cycles), the keras fit path
+(test_keras_fit_two_ranks_converges_and_syncs) and the cheap
+single-process cases. `pytest -m slow tests/test_tf_adapter.py` runs
+the rest."""
 import numpy as np
 import pytest
 
@@ -81,6 +90,7 @@ def test_tf_collectives_two_ranks():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_tf_tape_and_tf_function_grad():
     def fn():
         import numpy as np
@@ -159,6 +169,7 @@ def test_tf_grads_fuse_in_few_engine_cycles():
     assert all(c <= 5 for c in res), res
 
 
+@pytest.mark.slow
 def test_tf_async_handles_and_tf_function_group():
     def fn():
         import numpy as np
@@ -253,6 +264,7 @@ def test_keras_fit_two_ranks_converges_and_syncs():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_keras_adasum_delta_optimizer_matches_oracle():
     """hvd.DistributedOptimizer(op=Adasum) on the Keras surface must be
     the delta-model optimizer (ref: horovod/tensorflow/__init__.py:
@@ -310,6 +322,7 @@ def test_keras_adasum_delta_optimizer_matches_oracle():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_keras_adasum_fit_and_backward_passes():
     """Adasum wrapper inside model.fit: local steps every batch, deltas
     combined every k-th (ref schedule: tensorflow/__init__.py:356,
@@ -351,6 +364,7 @@ def test_keras_adasum_fit_and_backward_passes():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_v1_adasum_delta_optimizer():
     """The tf.compat.v1 surface dispatches op=Adasum to the delta-model
     wrapper too (ref dispatch: horovod/tensorflow/__init__.py:431-460)."""
@@ -382,6 +396,7 @@ def test_v1_adasum_delta_optimizer():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_keras_state_and_lr_callbacks():
     def fn():
         import numpy as np
@@ -481,6 +496,7 @@ def test_keras_load_model_rewraps_optimizer(tmp_path, hvd_single):
     assert type(loaded.optimizer).__name__.startswith("Distributed")
 
 
+@pytest.mark.slow
 def test_singleton_collectives_in_trace_warn():
     """>=8 singleton collectives traced inside ONE tf.function warn and
     point at grouped_allreduce (each becomes its own stateful
@@ -527,6 +543,7 @@ def test_singleton_collectives_in_trace_warn():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_keras_adasum_fit_traced_k1():
     """Adasum wrapper inside a TRACED model.fit (no run_eagerly): with
     backward_passes_per_step=1 the combine has no schedule to gate, so
@@ -563,6 +580,7 @@ def test_keras_adasum_fit_traced_k1():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_keras_adasum_fit_traced_k2_in_graph_schedule():
     """Traced model.fit at backward_passes_per_step=2: the comm-step
     schedule is in-graph (ref: `_is_comm_step`,
@@ -615,6 +633,7 @@ def test_keras_adasum_fit_traced_k2_in_graph_schedule():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_dynamic_topology_ops():
     """rank_op/size_op read the CURRENT topology at execution time, not
     trace time (ref: tensorflow/mpi_ops.py rank_op/size_op — the

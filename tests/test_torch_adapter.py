@@ -1,6 +1,12 @@
 """horovod_tpu.torch adapter tests (ref test model: test/test_torch.py —
 op coverage + DistributedOptimizer/broadcast-state under 2 real ranks;
-processes launched through the func-mode runner)."""
+processes launched through the func-mode runner).
+
+Tiering: like test_tf_adapter.py, each 2-rank case costs ~20-30s of
+subprocess spin-up, so the deep-coverage cases are marked `slow` and
+tier-1 keeps a smoke subset (test_allreduce_and_inplace,
+test_async_handle_api_single_process). `pytest -m slow` runs the
+rest."""
 import numpy as np
 import pytest
 
@@ -34,6 +40,7 @@ def test_allreduce_and_inplace():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_allgather_broadcast_alltoall():
     def fn():
         import torch
@@ -57,6 +64,7 @@ def test_allgather_broadcast_alltoall():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_distributed_optimizer_converges_and_syncs():
     def fn():
         import torch
@@ -92,6 +100,7 @@ def test_distributed_optimizer_converges_and_syncs():
     assert out[0] == out[1]
 
 
+@pytest.mark.slow
 def test_broadcast_optimizer_state():
     def fn():
         import torch
@@ -117,6 +126,7 @@ def test_broadcast_optimizer_state():
     assert out[0] == out[1]
 
 
+@pytest.mark.slow
 def test_backward_passes_per_step_accumulates():
     def fn():
         import torch
@@ -147,6 +157,7 @@ def test_backward_passes_per_step_accumulates():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_gradient_predivide_factor_splits_average():
     """The reference's `gradient_predivide_factor` kwarg works unchanged:
     the averaging splits into 1/f before the sum and f/size after it,
@@ -216,6 +227,7 @@ def test_gradient_predivide_factor_splits_average():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_join_and_compression():
     def fn():
         import torch
@@ -239,6 +251,7 @@ def test_join_and_compression():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_optimizer_is_real_torch_optimizer_and_scheduler_works():
     def fn():
         import torch
@@ -269,6 +282,7 @@ def test_optimizer_is_real_torch_optimizer_and_scheduler_works():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_torch_state_and_sync_batch_norm():
     def fn():
         import numpy as np
@@ -325,6 +339,7 @@ def test_torch_state_and_sync_batch_norm():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_adasum_delta_optimizer_matches_sequential_oracle():
     """DistributedOptimizer(op=Adasum) must be the delta-model optimizer:
     apply the LOCAL step, then Adasum-combine the weight deltas — not an
@@ -394,6 +409,7 @@ def test_adasum_delta_optimizer_matches_sequential_oracle():
     assert out[0] == out[1]  # Adasum leaves every rank with identical weights
 
 
+@pytest.mark.slow
 def test_adasum_delta_trajectory_differs_from_grad_adasum():
     """Delta-Adasum and gradient-Adasum are different algorithms when
     the local optimizer is nonlinear (Adam): adasum(f(g)) != f(adasum(g))
@@ -453,6 +469,7 @@ def test_adasum_delta_trajectory_differs_from_grad_adasum():
     assert _two(fn) == [True, True]
 
 
+@pytest.mark.slow
 def test_adasum_delta_with_compression_and_accumulation():
     """fp16 compression compresses the DELTA before the Adasum combine
     (ref: optimizer.py:314), and backward_passes_per_step accumulates
