@@ -2,16 +2,24 @@
 
 Measures the process-mode data plane the way the reference community
 benchmarks Gloo vs MPI backends — per-op latency for small tensors and
-achieved bus bandwidth for large ones, for both the ring and star
-algorithms (ref methodology: gloo ring allreduce,
+achieved bus bandwidth for large ones, across the data-plane algorithms
+(ref methodology: gloo ring allreduce,
 horovod/common/ops/gloo_operations.cc:119-166).
 
 Run under the launcher (2-8 processes):
 
-    hvdrun -np 2 python examples/microbench_allreduce.py
-    hvdrun -np 4 python examples/microbench_allreduce.py --algo star
+    hvdrun -np 4 python examples/microbench_allreduce.py
+    hvdrun -np 4 python examples/microbench_allreduce.py --algo ring
+    hvdrun -np 2 python examples/microbench_allreduce.py --sizes 4194304
 
-Rank 0 prints a table and one JSON summary line.
+The default is a SWEEP: star vs single-shot ring vs segmented
+(pipelined) ring — plus hierarchical ring when the launcher assigned a
+multi-host topology — at 64KB / 1MB / 16MB. All the algorithm knobs
+(HOROVOD_CPU_OPERATIONS, HOROVOD_RING_THRESHOLD,
+HOROVOD_RING_SEGMENT_BYTES) are read per call, so one process flips
+them between timed loops; every rank executes the same schedule, so
+the flips stay collectively consistent. Rank 0 prints a table (GB/s)
+and ONE JSON summary line.
 """
 import os
 import sys
@@ -20,59 +28,126 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 import argparse
 import json
-import os
 import time
+
+
+def _set_algo_env(algo, segment_bytes):
+    """Flip the per-call data-plane knobs. Identical on every rank —
+    the launcher gave all workers the same argv — so the ring/star
+    decision stays collectively consistent mid-run."""
+    if algo == "auto":
+        return  # measure exactly the as-launched library defaults
+    os.environ.pop("HOROVOD_CPU_OPERATIONS", None)
+    os.environ["HOROVOD_RING_SEGMENT_BYTES"] = "0"
+    if algo == "star":
+        os.environ["HOROVOD_CPU_OPERATIONS"] = "star"
+    elif algo in ("ring", "hier"):
+        os.environ["HOROVOD_RING_THRESHOLD"] = "0"
+    elif algo == "segring":
+        os.environ["HOROVOD_RING_THRESHOLD"] = "0"
+        os.environ["HOROVOD_RING_SEGMENT_BYTES"] = str(segment_bytes)
+
+
+def _bench_one(hvd, np, algo, count, iters, warmup):
+    x = np.ones(count, np.float32)
+    for i in range(warmup):
+        hvd.allreduce(x, name=f"warm.{algo}.{count}.{i}")
+    hvd.barrier()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hvd.allreduce(x, name=f"bench.{algo}.{count}.{i}")
+    dt = (time.perf_counter() - t0) / iters
+    n = hvd.size()
+    # Bus bandwidth uses the ring-allreduce wire factor 2(n-1)/n
+    # (bytes each rank moves per link), the NCCL-tests convention.
+    busbw = x.nbytes * 2 * (n - 1) / n / dt
+    return {"algo": algo, "bytes": x.nbytes, "lat_us": dt * 1e6,
+            "busbw_GBps": busbw / 1e9}
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--sizes", default="1024,16384,262144,4194304",
-                   help="comma-separated element counts (float32)")
+    p.add_argument("--sizes", default="16384,262144,4194304",
+                   help="comma-separated element counts (float32); the "
+                        "default is 64KB / 1MB / 16MB")
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--algo", choices=["ring", "star"], default=None,
-                   help="force the data-plane algorithm (default: auto)")
+    p.add_argument("--algo",
+                   choices=["sweep", "auto", "ring", "segring", "star",
+                            "hier"],
+                   default="sweep",
+                   help="one data-plane algorithm, or 'sweep' (default) "
+                        "to compare them all in one run")
+    p.add_argument("--segment-bytes", type=int, default=None,
+                   help="HOROVOD_RING_SEGMENT_BYTES for the segmented "
+                        "ring (default: the library default)")
     args = p.parse_args()
-
-    if args.algo == "star":
-        os.environ["HOROVOD_CPU_OPERATIONS"] = "star"
-    elif args.algo == "ring":
-        os.environ["HOROVOD_RING_THRESHOLD"] = "0"
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import numpy as np
 
     import horovod_tpu as hvd
+    from horovod_tpu.backend.ring import (
+        DEFAULT_RING_SEGMENT_BYTES,
+        hierarchy_valid,
+    )
+    from horovod_tpu.common import basics
+
+    seg_bytes = (args.segment_bytes if args.segment_bytes is not None
+                 else DEFAULT_RING_SEGMENT_BYTES)
 
     hvd.init()
     r, n = hvd.rank(), hvd.size()
-    rows = []
-    for count in [int(s) for s in args.sizes.split(",")]:
-        x = np.ones(count, np.float32)
-        for i in range(args.warmup):
-            hvd.allreduce(x, name=f"warm.{count}.{i}")
-        hvd.barrier()
-        t0 = time.perf_counter()
-        for i in range(args.iters):
-            hvd.allreduce(x, name=f"bench.{count}.{i}")
-        dt = (time.perf_counter() - t0) / args.iters
-        # Bus bandwidth uses the ring-allreduce wire factor 2(n-1)/n
-        # (bytes each rank moves per link), the NCCL-tests convention.
-        busbw = x.nbytes * 2 * (n - 1) / n / dt
-        rows.append({"bytes": x.nbytes, "lat_us": dt * 1e6,
-                     "busbw_MBps": busbw / 1e6})
+    backend = basics.engine().backend if basics.engine() else None
+
+    if args.algo in ("sweep",):
+        algos = ["star", "ring", "segring"]
+        hier_ok = backend is not None and hierarchy_valid(backend)
+        if hier_ok:
+            algos.append("hier")
+    else:
+        algos = [args.algo]
+        hier_ok = backend is not None and hierarchy_valid(backend)
+
+    rows, skipped = [], []
+    for algo in algos:
+        if algo == "hier":
+            if not hier_ok:
+                skipped.append({"algo": "hier",
+                                "reason": "topology not hierarchical "
+                                          "(needs local_size>1 and "
+                                          "cross_size>1)"})
+                continue
+            # The hierarchical toggle is normally set at init from
+            # HOROVOD_HIERARCHICAL_ALLREDUCE / autotune; for the sweep
+            # every rank flips it at the same schedule point, which is
+            # exactly the collective-consistency the gate needs.
+            backend.hierarchical = True
+        elif backend is not None and algo != "auto":
+            # 'auto' measures the as-launched config untouched.
+            backend.hierarchical = False
+        _set_algo_env(algo, seg_bytes)
+        for count in [int(s) for s in args.sizes.split(",")]:
+            rows.append(_bench_one(hvd, np, algo, count,
+                                   args.iters, args.warmup))
+
     if r == 0:
-        print(f"{'bytes':>12} {'latency(us)':>14} {'busbw(MB/s)':>14}")
+        print(f"{'algo':>8} {'bytes':>12} {'latency(us)':>14} "
+              f"{'busbw(GB/s)':>12}")
         for row in rows:
-            print(f"{row['bytes']:>12} {row['lat_us']:>14.1f} "
-                  f"{row['busbw_MBps']:>14.1f}")
+            print(f"{row['algo']:>8} {row['bytes']:>12} "
+                  f"{row['lat_us']:>14.1f} {row['busbw_GBps']:>12.3f}")
+        for s in skipped:
+            print(f"{s['algo']:>8} skipped: {s['reason']}")
         print(json.dumps({
             "metric": "eager_allreduce",
             "np": n,
-            "algo": args.algo or "auto",
-            "rows": [{k: round(v, 1) for k, v in row.items()}
-                     for row in rows],
+            "algo": args.algo,
+            "segment_bytes": seg_bytes,
+            "rows": [{k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in row.items()} for row in rows],
+            "skipped": skipped,
         }))
 
 

@@ -16,19 +16,35 @@ HOROVOD_RING_THRESHOLD bytes; smaller tensors stay on the star path
 including joined ranks (which the engine hands full-shape zero
 buffers), holds the same element count, so the decision is local yet
 globally consistent. HOROVOD_CPU_OPERATIONS=star forces the old path.
+
+Byte movement is zero-copy and pipelined: ring steps enqueue their
+send chunk as memoryview segments on the transport's persistent peer
+sender (send_async), receive the incoming chunk segment-by-segment
+straight into a persistent scratch buffer (recv_into_from), and
+reduce in place (np.add(tgt, seg, out=tgt)) — so the send of segment
+k overlaps the recv+reduce of segment k-1 on the wire.
+HOROVOD_RING_SEGMENT_BYTES sets the segment size (must match on every
+rank, like the ring threshold); 0 restores the single-shot
+frame-per-chunk schedule.
 """
 from __future__ import annotations
 
 import os
 import struct
-import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..common.exceptions import HorovodInternalError
 from ..common.types import ReduceOp
 from .base import _reduce
-from .star import StarCollectivesMixin, pack_array, unpack_array
+from .star import (
+    StarCollectivesMixin,
+    as_byte_view,
+    own_array,
+    pack_array,
+    unpack_array,
+)
 
 # Measured crossover on loopback (examples/microbench_allreduce.py,
 # np=3): star wins <=64KB (fewer rounds), parity ~1MB, ring 1.5x at
@@ -36,8 +52,48 @@ from .star import StarCollectivesMixin, pack_array, unpack_array
 # saturates at O(N*bytes)); the env knob tunes it per deployment.
 DEFAULT_RING_THRESHOLD = 262144  # bytes; smaller tensors stay on star
 
+# Pipeline segment size for ring steps: large enough that per-frame
+# overhead (header, queue handoff, telemetry) stays negligible, small
+# enough that multi-MB chunks split into overlapped segments. Measured
+# on loopback (np=4, 16MB): 2MB segments run at single-shot parity
+# (the wire has no latency to hide there) while 256KB segments lose
+# ~2x to frame overhead; real networks reward smaller segments.
+DEFAULT_RING_SEGMENT_BYTES = 2 << 20
+
 _RING_OPS = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
              ReduceOp.PRODUCT)
+
+# In-place reduction kernels for the ring's recv+reduce step: the
+# allocating base._reduce is replaced by ufunc(tgt, seg, out=tgt)
+# (AVERAGE lowers to SUM before the ring phases run).
+_INPLACE_UFUNC = {
+    ReduceOp.SUM: np.add,
+    ReduceOp.AVERAGE: np.add,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.MAX: np.maximum,
+    ReduceOp.PRODUCT: np.multiply,
+}
+
+
+def _reduce_into(op: ReduceOp, tgt: np.ndarray, incoming: np.ndarray):
+    """tgt = tgt ⊕ incoming without allocating."""
+    ufunc = _INPLACE_UFUNC.get(op)
+    if ufunc is None:  # pragma: no cover - _RING_OPS gates dispatch
+        tgt[:] = _reduce(op, [tgt, incoming])
+    else:
+        ufunc(tgt, incoming, out=tgt)
+
+
+class _CompletedTicket:
+    """No-op ticket for transports whose send_to never blocks."""
+
+    __slots__ = ()
+
+    def wait(self):
+        pass
+
+
+_COMPLETED = _CompletedTicket()
 
 
 # -- eligibility predicates -------------------------------------------
@@ -52,6 +108,20 @@ def ring_threshold() -> int:
                                   DEFAULT_RING_THRESHOLD))
     except ValueError:
         return DEFAULT_RING_THRESHOLD
+
+
+def ring_segment_bytes() -> int:
+    """Pipeline segment size for ring steps; 0 = single-shot (one frame
+    per chunk, the pre-pipelining schedule). Read per call so tests and
+    sweeps can flip it; must be identical on every rank — frame counts
+    are derived from it, so a mismatch desyncs the ring (the launcher
+    propagates HOROVOD_* env to all workers, like the threshold)."""
+    try:
+        v = int(os.environ.get("HOROVOD_RING_SEGMENT_BYTES",
+                               DEFAULT_RING_SEGMENT_BYTES))
+    except ValueError:
+        return DEFAULT_RING_SEGMENT_BYTES
+    return max(v, 0)
 
 
 def ring_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
@@ -185,9 +255,13 @@ class RingCollectivesMixin(StarCollectivesMixin):
             if blob[:1] == b"E":
                 raise RuntimeError(
                     "hierarchical allgather failed on host leader: "
-                    + blob[1:].decode(errors="replace")
+                    + bytes(blob[1:]).decode(errors="replace")
                 )
-            return unpack_array(blob[1:]).copy()
+            # memoryview slice (bytearray slicing would copy the whole
+            # payload); recv-into hands us an exclusively owned buffer,
+            # so own_array is zero-copy on the TCP path and only copies
+            # when the transport returned a shared/read-only blob.
+            return own_array(unpack_array(memoryview(blob)[1:]))
 
         try:
             # Leader: gather this host's blocks in local-rank order
@@ -242,8 +316,9 @@ class RingCollectivesMixin(StarCollectivesMixin):
                     pass
             raise
 
-        # Local fan-out of the assembled result.
-        blob = b"O" + pack_array(out)
+        # Local fan-out of the assembled result (scatter-gather: the
+        # status byte, header and payload go out as separate buffers).
+        blob = [b"O"] + pack_array(out)
         for i in range(1, L):
             self.send_to(base + i, blob)
         return out
@@ -278,25 +353,42 @@ class RingCollectivesMixin(StarCollectivesMixin):
             out = np.stack(blocks)
         return out
 
+    # -- p2p transport defaults ----------------------------------------
+    # The TCP backend overrides both with true zero-copy/async versions;
+    # these defaults keep any transport providing only send_to/recv_from
+    # (the in-process ThreadedBackend) ring-capable.
+
+    def send_async(self, peer: int, payload):
+        """Default: synchronous send + completed ticket. Queue-backed
+        transports never block on send_to, so this cannot deadlock the
+        ring; socket transports override with a persistent per-peer
+        sender worker."""
+        self.send_to(peer, payload)
+        return _COMPLETED
+
+    def recv_into_from(self, peer: int, buf) -> int:
+        """Default recv-into: one copy out of recv_from's frame. Socket
+        transports override with a true recv_into."""
+        data = self.recv_from(peer)
+        view = as_byte_view(buf)
+        if len(data) != len(view):
+            raise HorovodInternalError(
+                f"rank {self.rank}: frame length {len(data)} != expected "
+                f"{len(view)} from peer {peer} (desynced peer; check "
+                f"HOROVOD_RING_SEGMENT_BYTES matches on every rank)")
+        if data:
+            view[:] = data
+        return len(data)
+
     # ------------------------------------------------------------------
-    def _sendrecv(self, dest: int, payload: bytes, src: int) -> bytes:
-        """Simultaneous send+recv (MPI_Sendrecv shape): the send runs on
-        a helper thread so a full socket buffer cannot deadlock the ring
-        (every rank sends right while receiving left)."""
-        err: List[BaseException] = []
-
-        def _send():
-            try:
-                self.send_to(dest, payload)
-            except BaseException as e:  # pragma: no cover - network death
-                err.append(e)
-
-        t = threading.Thread(target=_send, daemon=True)
-        t.start()
+    def _sendrecv(self, dest: int, payload, src: int):
+        """Simultaneous send+recv (MPI_Sendrecv shape): the send rides
+        the transport's persistent sender worker (send_async) so a full
+        socket buffer cannot deadlock the ring (every rank sends right
+        while receiving left) — no helper thread per step."""
+        ticket = self.send_async(dest, payload)
         data = self.recv_from(src)
-        t.join()
-        if err:
-            raise err[0]
+        ticket.wait()
         return data
 
     # -- group-parameterized ring phases -------------------------------
@@ -311,15 +403,75 @@ class RingCollectivesMixin(StarCollectivesMixin):
         base = total // n
         return [i * base for i in range(n)] + [total]
 
+    @staticmethod
+    def _segment_bounds(nelems: int, seg_elems: int) -> List[int]:
+        """Split one ring chunk into pipeline segments. A zero-size
+        chunk is one empty segment — the (empty) frame still flows, so
+        ring steps stay aligned even when total < group size. With
+        seg_elems == 0 (single-shot) or >= nelems the chunk is one
+        segment; a non-divisible size leaves the remainder in the last
+        segment. Deterministic from (nelems, seg_elems) only, so the
+        sender's and receiver's frame counts always agree."""
+        if nelems <= 0 or seg_elems <= 0 or seg_elems >= nelems:
+            return [0, max(nelems, 0)]
+        return list(range(0, nelems, seg_elems)) + [nelems]
+
+    @staticmethod
+    def _segment_elems(itemsize: int) -> int:
+        sb = ring_segment_bytes()
+        if sb <= 0:
+            return 0  # single-shot
+        return max(1, sb // itemsize)
+
+    # Persistent recv scratch for the reduce-scatter phase, per dtype,
+    # grown to the largest double-buffer seen. Only the engine's single
+    # background thread runs collectives, so no locking is needed.
+    _ring_scratch_store: Optional[Dict[str, np.ndarray]] = None
+
+    def _ring_scratch(self, dtype: np.dtype, nelems: int) -> np.ndarray:
+        store = self._ring_scratch_store
+        if store is None:
+            store = self._ring_scratch_store = {}
+        key = dtype.str
+        buf = store.get(key)
+        if buf is None or buf.size < nelems:
+            buf = store[key] = np.empty(max(nelems, 1), dtype)
+        return buf
+
+    def _count_segments(self, k: int):
+        m = getattr(self, "_m_ring_segments", None)
+        if m is not None:
+            m.inc(k)
+
     def _ring_reduce_scatter(self, group: List[int], flat: np.ndarray,
                              op: ReduceOp):
-        """In-place ring reduce-scatter over `group`. On return, the rank
-        at position p holds group-chunk (p+1)%n fully reduced (ref: gloo
-        ring reduce-scatter schedule, gloo_operations.cc:119-166)."""
+        """In-place, pipelined ring reduce-scatter over `group`. On
+        return, the rank at position p holds group-chunk (p+1)%n fully
+        reduced (ref: gloo ring reduce-scatter schedule,
+        gloo_operations.cc:119-166).
+
+        Each step queues its send chunk as HOROVOD_RING_SEGMENT_BYTES
+        memoryview segments on the persistent peer sender — zero copies
+        on the send side — while receiving the incoming chunk segment by
+        segment into a double-buffered persistent scratch and reducing
+        in place, so the wire write of segment k overlaps this rank's
+        recv+reduce of segment k-1."""
         n = len(group)
         pos = group.index(self.rank)
         right, left = group[(pos + 1) % n], group[(pos - 1) % n]
         bounds = self._bounds(flat.size, n)
+        seg = self._segment_elems(flat.itemsize)
+        red = op if op != ReduceOp.AVERAGE else ReduceOp.SUM
+        max_chunk = max(bounds[i + 1] - bounds[i] for i in range(n))
+        seg_cap = min(seg, max_chunk) if seg else max_chunk
+        seg_cap = max(seg_cap, 1)
+        # Two alternating scratch halves. Today recv and reduce run
+        # sequentially on this thread (only the SEND side truly
+        # overlaps, via the queued sender), so the second half buys no
+        # wall-clock yet — it exists so segment k's recv target never
+        # aliases segment k-1's reduce source, which is the invariant
+        # an async recv/reduce split will need.
+        scratch = self._ring_scratch(flat.dtype, 2 * seg_cap)
 
         def chunk(i):
             i %= n
@@ -327,21 +479,30 @@ class RingCollectivesMixin(StarCollectivesMixin):
 
         for s in range(n - 1):
             send_c = chunk(pos - s)
-            recv_buf = self._sendrecv(right, send_c.tobytes(), left)
-            incoming = np.frombuffer(recv_buf, dtype=flat.dtype)
             tgt = chunk(pos - s - 1)
-            tgt[:] = _reduce(
-                op if op != ReduceOp.AVERAGE else ReduceOp.SUM,
-                [tgt, incoming],
-            )
+            sb = self._segment_bounds(send_c.size, seg)
+            tickets = [self.send_async(right, send_c[a:b])
+                       for a, b in zip(sb, sb[1:])]
+            self._count_segments(len(tickets))
+            rb = self._segment_bounds(tgt.size, seg)
+            for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                half = scratch[(k % 2) * seg_cap:][: b - a]
+                self.recv_into_from(left, half)
+                if b > a:
+                    _reduce_into(red, tgt[a:b], half)
+            for t in tickets:
+                t.wait()
 
     def _ring_allgather_chunks(self, group: List[int], flat: np.ndarray):
         """Ring allgather of the per-position chunks: position p starts
-        owning chunk (p+1)%n; after n-1 rotations every rank holds all."""
+        owning chunk (p+1)%n; after n-1 rotations every rank holds all.
+        Pipelined like the reduce-scatter, except incoming segments land
+        straight in their final chunk slice — no scratch, no copy."""
         n = len(group)
         pos = group.index(self.rank)
         right, left = group[(pos + 1) % n], group[(pos - 1) % n]
         bounds = self._bounds(flat.size, n)
+        seg = self._segment_elems(flat.itemsize)
 
         def chunk(i):
             i %= n
@@ -349,22 +510,38 @@ class RingCollectivesMixin(StarCollectivesMixin):
 
         for s in range(n - 1):
             send_c = chunk(pos - s + 1)
-            recv_buf = self._sendrecv(right, send_c.tobytes(), left)
-            chunk(pos - s)[:] = np.frombuffer(recv_buf, dtype=flat.dtype)
+            tgt = chunk(pos - s)
+            sb = self._segment_bounds(send_c.size, seg)
+            tickets = [self.send_async(right, send_c[a:b])
+                       for a, b in zip(sb, sb[1:])]
+            self._count_segments(len(tickets))
+            rb = self._segment_bounds(tgt.size, seg)
+            for a, b in zip(rb, rb[1:]):
+                self.recv_into_from(left, tgt[a:b])
+            for t in tickets:
+                t.wait()
 
     def _ring_allreduce_group(self, group: List[int], flat: np.ndarray,
                               op: ReduceOp):
         self._ring_reduce_scatter(group, flat, op)
         self._ring_allgather_chunks(group, flat)
 
-    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
-        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp,
+                        owned: bool = False) -> np.ndarray:
+        """`owned=True` (engine-set for freshly packed/scaled fusion
+        buffers) lets the ring reduce in place without the defensive
+        copy of the input; a caller-owned tensor must never be
+        mutated."""
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        if not owned and np.shares_memory(flat, arr):
+            flat = flat.copy()
         self._ring_allreduce_group(list(range(self.size)), flat, op)
         if op == ReduceOp.AVERAGE:
             flat = (flat / self.size).astype(arr.dtype)
         return flat.reshape(arr.shape)
 
-    def _hierarchical_allreduce(self, arr: np.ndarray, op: ReduceOp) -> np.ndarray:
+    def _hierarchical_allreduce(self, arr: np.ndarray, op: ReduceOp,
+                                owned: bool = False) -> np.ndarray:
         """Local reduce-scatter -> cross allreduce per slice -> local
         allgather (ref: NCCLHierarchicalAllreduce's ReduceScatter /
         cross-MPI_Allreduce / AllGather shape, nccl_operations.cc:190-405;
@@ -374,7 +551,9 @@ class RingCollectivesMixin(StarCollectivesMixin):
         base = self.cross_rank * L
         local_group = list(range(base, base + L))
         cross_group = [self.local_rank + h * L for h in range(self.cross_size)]
-        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        if not owned and np.shares_memory(flat, arr):
+            flat = flat.copy()
 
         # Phase A: local reduce-scatter; position local_rank ends owning
         # local chunk (local_rank+1)%L, reduced across the host.

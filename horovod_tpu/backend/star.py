@@ -20,18 +20,70 @@ from .base import Backend, _reduce
 _LEN = struct.Struct("<Q")
 
 
-def pack_array(arr: np.ndarray) -> bytes:
+def pack_array(arr: np.ndarray) -> list:
+    """Self-describing array frame as a scatter-gather buffer list
+    [header, payload-memoryview]: the transport sendmsg's the pieces to
+    the wire without ever concatenating them, so packing a tensor costs
+    zero copies (unless a non-contiguous input forces one)."""
     # ';' separator: numpy dtype.str can itself contain '|' (e.g. '|u1').
     head = f"{arr.dtype.str};{','.join(map(str, arr.shape))}".encode()
-    return _LEN.pack(len(head)) + head + np.ascontiguousarray(arr).tobytes()
+    # reshape(-1) is a view of the contiguous array; memoryview.cast
+    # refuses multi-dim views with a zero dim, 1-D always works.
+    return [_LEN.pack(len(head)) + head,
+            memoryview(np.ascontiguousarray(arr).reshape(-1)).cast("B")]
 
 
-def unpack_array(buf: bytes) -> np.ndarray:
-    (hn,) = _LEN.unpack(buf[:8])
-    head = buf[8 : 8 + hn].decode()
+def unpack_array(buf) -> np.ndarray:
+    """Decode an array frame zero-copy: the result ALIASES `buf`
+    (writable iff buf is — a recv-into bytearray yields a writable,
+    exclusively owned array; immutable bytes yield a read-only view).
+    Callers that hand the array to user code or must outlive/mutate a
+    shared buffer wrap the result in `own_array`."""
+    view = buf if isinstance(buf, memoryview) else memoryview(buf)
+    (hn,) = _LEN.unpack(view[:8])
+    head = bytes(view[8 : 8 + hn]).decode()
     dtype_str, shape_str = head.split(";")
     shape = tuple(int(s) for s in shape_str.split(",")) if shape_str else ()
-    return np.frombuffer(buf[8 + hn :], dtype=np.dtype(dtype_str)).reshape(shape)
+    return np.frombuffer(view[8 + hn :], dtype=np.dtype(dtype_str)).reshape(shape)
+
+
+def own_array(a: np.ndarray) -> np.ndarray:
+    """Return `a` as an owned, writable array: zero-copy when its
+    backing buffer is already exclusively ours (every TCP recv allocates
+    a fresh writable bytearray per frame), a copy when the transport
+    handed us a shared or read-only blob (the threaded test backend
+    broadcasts one immutable bytes object to every rank)."""
+    return a if a.flags.writeable else a.copy()
+
+
+def as_byte_view(buf) -> memoryview:
+    """Normalize one buffer-protocol object (bytes, bytearray,
+    memoryview, numpy array) to a flat 1-D byte memoryview, zero-copy.
+    memoryview.cast refuses multi-dim views with a zero dim — an empty
+    buffer is an empty buffer."""
+    v = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if v.ndim != 1 or v.format != "B":
+        v = v.cast("B") if v.nbytes else memoryview(b"")
+    return v
+
+
+def join_buffers(payload):
+    """Coalesce a scatter-gather buffer list into one bytes-like blob —
+    the LOCAL-consumption path only (rank 0 decoding its own gathered
+    payload, queue transports); the wire path never joins, the frames go
+    out via sendmsg. Single buffers (and plain bytes) pass through
+    untouched."""
+    if not isinstance(payload, (list, tuple)):
+        return payload
+    views = [as_byte_view(item) for item in payload]
+    if len(views) == 1:
+        return views[0]
+    out = bytearray(sum(len(v) for v in views))
+    off = 0
+    for v in views:
+        out[off : off + len(v)] = v
+        off += len(v)
+    return out
 
 
 class StarCollectivesMixin(Backend):
@@ -49,7 +101,7 @@ class StarCollectivesMixin(Backend):
             out = _reduce(op, nonempty) if nonempty else arrays[0]
             self.bcast_bytes(pack_array(out))
             return out.reshape(arr.shape) if arr.size else out
-        out = unpack_array(self.bcast_bytes(None)).copy()
+        out = own_array(unpack_array(self.bcast_bytes(None)))
         return out.reshape(arr.shape) if arr.size and out.size == arr.size else out
 
     def adasum_allreduce_all(self, arr: np.ndarray) -> np.ndarray:
@@ -80,7 +132,7 @@ class StarCollectivesMixin(Backend):
                 out = arrays[0]
             self.bcast_bytes(pack_array(out))
             return out
-        return unpack_array(self.bcast_bytes(None)).copy()
+        return own_array(unpack_array(self.bcast_bytes(None)))
 
     def allgatherv(self, arr: np.ndarray, first_dims: List[int]) -> np.ndarray:
         if self.size == 1:
@@ -95,7 +147,7 @@ class StarCollectivesMixin(Backend):
             )
             self.bcast_bytes(pack_array(out))
             return out
-        return unpack_array(self.bcast_bytes(None)).copy()
+        return own_array(unpack_array(self.bcast_bytes(None)))
 
     def broadcast(self, arr: Optional[np.ndarray], root: int) -> np.ndarray:
         if self.size == 1:
@@ -107,8 +159,8 @@ class StarCollectivesMixin(Backend):
         if self.rank == 0:
             chosen = gathered[root]
             self.bcast_bytes(chosen)
-            return unpack_array(chosen).copy()
-        return unpack_array(self.bcast_bytes(None)).copy()
+            return own_array(unpack_array(chosen))
+        return own_array(unpack_array(self.bcast_bytes(None)))
 
     def alltoallv(
         self, arr: np.ndarray, splits: List[int]
@@ -118,18 +170,20 @@ class StarCollectivesMixin(Backend):
         # Root-mediated exchange: gather (splits, data), redistribute.
         head = struct.pack(f"<{self.size}q", *splits)
         gathered = self.gather_bytes(
-            _LEN.pack(len(head)) + head + pack_array(arr)
+            [_LEN.pack(len(head)) + head] + pack_array(arr)
         )
         if self.rank == 0:
             all_splits, all_arrays = [], []
             for buf in gathered:
-                (hn,) = _LEN.unpack(buf[:8])
-                all_splits.append(list(struct.unpack(f"<{self.size}q", buf[8 : 8 + hn])))
-                all_arrays.append(unpack_array(buf[8 + hn :]))
+                view = memoryview(buf)
+                (hn,) = _LEN.unpack(view[:8])
+                all_splits.append(list(struct.unpack(
+                    f"<{self.size}q", view[8 : 8 + hn])))
+                all_arrays.append(unpack_array(view[8 + hn :]))
             src_offsets = [
                 np.concatenate([[0], np.cumsum(s)]).astype(int) for s in all_splits
             ]
-            per_dest: List[bytes] = []
+            per_dest: List[list] = []
             recv_splits_all: List[List[int]] = []
             for dest in range(self.size):
                 parts = []
@@ -140,15 +194,17 @@ class StarCollectivesMixin(Backend):
                     rsplits.append(all_splits[src][dest])
                 out = np.concatenate(parts, axis=0)
                 rs_head = struct.pack(f"<{self.size}q", *rsplits)
-                per_dest.append(_LEN.pack(len(rs_head)) + rs_head + pack_array(out))
+                per_dest.append(
+                    [_LEN.pack(len(rs_head)) + rs_head] + pack_array(out))
                 recv_splits_all.append(rsplits)
             self.scatter_bytes(per_dest)
-            buf = per_dest[0]
+            buf = join_buffers(per_dest[0])
         else:
             buf = self.scatter_bytes(None)
-        (hn,) = _LEN.unpack(buf[:8])
-        recv_splits = list(struct.unpack(f"<{self.size}q", buf[8 : 8 + hn]))
-        return unpack_array(buf[8 + hn :]).copy(), recv_splits
+        view = memoryview(buf)
+        (hn,) = _LEN.unpack(view[:8])
+        recv_splits = list(struct.unpack(f"<{self.size}q", view[8 : 8 + hn]))
+        return own_array(unpack_array(view[8 + hn :])), recv_splits
 
     def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
         """Root sends payloads[r] to rank r. Default: r-indexed bcast

@@ -22,12 +22,25 @@ HorovodInternalError subclass the elastic contract keys on — with the
 failed connection hard-closed so later ops fail fast. The
 HOROVOD_FAULT_INJECT chaos harness (common/fault_injection.py) hooks
 the same choke points.
+
+Zero-copy framing: sends are scatter-gather (`sendmsg([header,
+payload...])` — no length-prefix concat copy, numpy chunks go to the
+wire as memoryviews) and receives land via `recv_into` on a byte
+cursor over a caller- or freshly-allocated buffer, so a frame costs
+zero intermediate copies in userspace. Ring data-plane sends ride a
+persistent queue-fed sender thread per peer (created lazily at the
+first p2p send, drained on shutdown/sever) instead of a helper thread
+per ring step; every send to a peer — sync control plane or async
+ring — flows through the same FIFO, so frames can never interleave.
 """
 from __future__ import annotations
 
 import os
+import queue
+import select
 import socket
 import struct
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -38,69 +51,241 @@ from ..utils.logging import get_logger
 from ..utils.retry import call_with_retry
 from .rendezvous import RendezvousClient
 from .ring import RingCollectivesMixin
+from .star import as_byte_view, join_buffers
 
 logger = get_logger()
 
 _LEN = struct.Struct("<Q")
 
+# sendmsg is POSIX; the sequential-sendall fallback keeps exotic
+# platforms working at the cost of one extra syscall per frame.
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
 
-def _send_all(sock: socket.socket, data: bytes):
-    sock.sendall(_LEN.pack(len(data)) + data)
+
+def _as_byte_views(data) -> List[memoryview]:
+    """Normalize bytes | bytearray | memoryview | any buffer-protocol
+    object (numpy arrays included) | a list/tuple of those into flat
+    1-D byte memoryviews — zero-copy; buffers must be C-contiguous."""
+    items = data if isinstance(data, (list, tuple)) else (data,)
+    return [as_byte_view(item) for item in items]
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _send_all(sock: socket.socket, data) -> int:
+    """Frame + send without concatenation: one scatter-gather
+    `sendmsg([length-header, *payload buffers])` in the common case,
+    looping with memoryview cursors on partial sends. Accepts anything
+    `_as_byte_views` does. Returns the payload byte count (header
+    excluded)."""
+    views = _as_byte_views(data)
+    total = sum(len(v) for v in views)
+    pending = [memoryview(_LEN.pack(total))]
+    pending += [v for v in views if len(v)]
+    if not _HAS_SENDMSG:  # pragma: no cover - POSIX always has sendmsg
+        for v in pending:
+            sock.sendall(v)
+        return total
+    while pending:
+        sent = sock.sendmsg(pending)
+        while pending and sent >= len(pending[0]):
+            sent -= len(pending[0])
+            pending.pop(0)
+        if pending and sent:
+            pending[0] = pending[0][sent:]
+    return total
+
+
+def _make_poller(sock: socket.socket):
+    """Readiness poller for the bounded-recv heartbeat, built once per
+    recv (not per chunk — a 16MB transfer drains in hundreds of
+    recv_into chunks). poll() where the platform has it — select()
+    caps out at FD_SETSIZE (1024) and a big training process easily
+    holds more fds than that; a peer socket with a high fileno must
+    not be misdiagnosed as dead."""
+    if hasattr(select, "poll"):
+        p = select.poll()
+        p.register(sock, select.POLLIN)
+        return lambda poll_s: bool(p.poll(poll_s * 1000.0))
+    return lambda poll_s: bool(  # pragma: no cover - POSIX has poll()
+        select.select([sock], [], [], poll_s)[0])
+
+
+def _recv_into(sock: socket.socket, view: memoryview):
+    """Exact recv directly into a writable byte view (no accumulation
+    buffer, no `buf += chunk` reallocation)."""
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed connection")
-        buf.extend(chunk)
-    return bytes(buf)
+        got += r
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> bytearray:
     (n,) = _LEN.unpack(_recv_exact(sock, 8))
     return _recv_exact(sock, n)
 
 
-def _recv_exact_bounded(sock: socket.socket, n: int,
-                        timeout: float, poll: float) -> bytes:
-    """Bounded recv: polls at `poll` granularity instead of blocking
-    forever, so a dead peer is detected within `timeout` seconds of its
-    last byte (or, if timeout == 0, the moment the OS delivers its
-    FIN/RST — a process that dies, even via SIGKILL, still gets its
-    sockets closed by the kernel). The deadline is an IDLE bound that
-    resets on every received chunk, not a total-transfer bound: a live
-    peer legitimately streaming a large payload for longer than the
-    timeout must not be declared dead mid-transfer. This is the
-    heartbeat the reference gets from gloo's timeout-bounded transports
-    (ref: gloo store/ioTimeout)."""
-    buf = bytearray()
+def _recv_into_bounded(sock: socket.socket, view: memoryview,
+                       timeout: float, poll: float):
+    """Bounded recv-into: polls at `poll` granularity instead of
+    blocking forever, so a dead peer is detected within `timeout`
+    seconds of its last byte (or, if timeout == 0, the moment the OS
+    delivers its FIN/RST — a process that dies, even via SIGKILL, still
+    gets its sockets closed by the kernel). The deadline is an IDLE
+    bound that resets on every received chunk, not a total-transfer
+    bound: a live peer legitimately streaming a large payload for
+    longer than the timeout must not be declared dead mid-transfer.
+    This is the heartbeat the reference gets from gloo's
+    timeout-bounded transports (ref: gloo store/ioTimeout).
+
+    The poll uses select(), deliberately NOT settimeout: the socket's
+    timeout is per-socket shared state that the peer's persistent
+    sender worker also manipulates, and in a 2-rank ring the left and
+    right neighbor are the SAME socket — a send completing mid-recv
+    would reset the timeout under us and turn the heartbeat into an
+    indefinite block."""
+    got, n = 0, len(view)
     deadline = time.monotonic() + timeout if timeout > 0 else None
-    prev = sock.gettimeout()
-    sock.settimeout(poll)
-    try:
-        while len(buf) < n:
-            try:
-                chunk = sock.recv(n - len(buf))
-            except (socket.timeout, TimeoutError):
-                if deadline is not None and time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"recv made no progress for {timeout:.1f}s "
-                        f"(HOROVOD_TCP_TIMEOUT_SECONDS)"
-                    ) from None
-                continue
-            if not chunk:
-                raise ConnectionError("peer closed connection")
-            buf.extend(chunk)
-            if deadline is not None:
-                deadline = time.monotonic() + timeout
-        return bytes(buf)
-    finally:
+    if n:
         try:
-            sock.settimeout(prev)
-        except OSError:  # pragma: no cover - socket already dead
-            pass
+            wait_readable = _make_poller(sock)
+        except (OSError, ValueError):
+            # fd hard-closed under us (a concurrent sever): same
+            # contract as a peer death.
+            raise ConnectionError("peer socket closed during recv") \
+                from None
+    while got < n:
+        try:
+            ready = wait_readable(poll)
+        except (OSError, ValueError):
+            raise ConnectionError("peer socket closed during recv") \
+                from None
+        if not ready:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"recv made no progress for {timeout:.1f}s "
+                    f"(HOROVOD_TCP_TIMEOUT_SECONDS)"
+                )
+            continue
+        try:
+            r = sock.recv_into(view[got:])
+        except (socket.timeout, TimeoutError):
+            # A transient socket timeout set by the concurrent send
+            # path tripped an otherwise-ready recv: treat as one poll
+            # tick, the deadline logic above still bounds us.
+            continue
+        if not r:
+            raise ConnectionError("peer closed connection")
+        got += r
+        if deadline is not None:
+            deadline = time.monotonic() + timeout
+
+
+def _recv_exact_bounded(sock: socket.socket, n: int,
+                        timeout: float, poll: float) -> bytearray:
+    """Bounded recv of n fresh bytes; the returned bytearray is owned
+    exclusively by the caller, so unpack_array may alias it zero-copy."""
+    buf = bytearray(n)
+    _recv_into_bounded(sock, memoryview(buf), timeout, poll)
+    return buf
+
+
+class _SendTicket:
+    """Completion handle for one frame queued on a persistent peer
+    sender; `wait()` re-raises the sender thread's TransportError on
+    the caller's thread."""
+
+    __slots__ = ("_event", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def _done(self, error: Optional[BaseException] = None):
+        self._error = error
+        self._event.set()
+
+    def wait(self):
+        self._event.wait()
+        if self._error is not None:
+            raise self._error
+
+
+_SENDER_STOP = object()
+
+
+class _PeerSender:
+    """Persistent queue-fed sender worker for one peer socket. Replaces
+    the thread-per-ring-step `_sendrecv` helper: created lazily at the
+    first p2p send to the peer, reused for the backend's lifetime,
+    drained on shutdown/sever. The queue holds memoryviews — enqueueing
+    a ring segment costs no copy. Fault-injection verdicts (drop/delay/
+    sever) apply inside the worker via `_peer_send_direct`, so a delay
+    rule stalls the queue and a sever fails the ticket exactly like the
+    old inline send path did."""
+
+    def __init__(self, backend: "TcpBackend", peer: int):
+        self._backend = backend
+        self.peer = peer
+        self.queue: "queue.Queue" = queue.Queue()
+        # _closed is flipped under _lock BEFORE the stop sentinel is
+        # queued, and send() checks it under the same lock — so a put
+        # either lands ahead of the sentinel (FIFO: the worker still
+        # processes it) or fails fast. Without this a send racing
+        # stop() could enqueue after the worker's final drain and park
+        # its waiter forever.
+        self._lock = threading.Lock()
+        self._closed = False
+        self.thread = threading.Thread(
+            target=self._loop, name=f"hvd-sender-{peer}", daemon=True)
+        self.thread.start()
+
+    def send(self, payload) -> _SendTicket:
+        ticket = _SendTicket()
+        with self._lock:
+            if self._closed:
+                ticket._done(TransportError(
+                    f"sender for peer {self.peer} shut down"))
+                return ticket
+            self.queue.put((payload, ticket))
+        return ticket
+
+    def stop(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self.queue.put(_SENDER_STOP)
+
+    def _loop(self):
+        while True:
+            item = self.queue.get()
+            if item is _SENDER_STOP:
+                break
+            payload, ticket = item
+            try:
+                self._backend._peer_send_direct(self.peer, payload)
+            except BaseException as e:
+                ticket._done(e)
+            else:
+                ticket._done()
+        # Belt-and-braces drain: _closed guarantees nothing lands after
+        # the sentinel, but fail anything unexpectedly left anyway
+        # rather than leave a waiter parked.
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SENDER_STOP:  # pragma: no cover - _closed gates
+                item[1]._done(TransportError(
+                    f"sender for peer {self.peer} shut down"))
 
 
 class TcpBackend(RingCollectivesMixin):
@@ -130,6 +315,21 @@ class TcpBackend(RingCollectivesMixin):
         self._m_severed = registry.counter(
             "horovod_tcp_peers_severed_total",
             "Peer connections hard-closed after a transport failure")
+        self._m_frames_sent = registry.counter(
+            "horovod_tcp_sendmsg_frames_total",
+            "Framed messages written to peer sockets (scatter-gather "
+            "sendmsg sends)")
+        self._m_ring_segments = registry.counter(
+            "horovod_ring_segments_total",
+            "Pipeline segments moved by ring collectives (send side)")
+        self._m_sender_depth = registry.gauge(
+            "horovod_sender_queue_depth",
+            "Frames queued on persistent peer senders, summed over peers")
+        self._m_sender_depth.set_function(self._sender_queue_depth)
+        # Persistent per-peer sender workers (lazy; _senders_lock guards
+        # the dict — the workers themselves are single-consumer queues).
+        self._senders: Dict[int, _PeerSender] = {}
+        self._senders_lock = threading.Lock()
         self.rank = rank
         self.size = size
         if scope is None:
@@ -307,6 +507,12 @@ class TcpBackend(RingCollectivesMixin):
         return s
 
     def _sever(self, peer: int):
+        with self._senders_lock:
+            snd = self._senders.pop(peer, None)
+        if snd is not None:
+            # stop() only enqueues the sentinel, so this is safe from
+            # the sender's own thread (its error path calls _sever).
+            snd.stop()
         s = self.peers.pop(peer, None)
         if s is not None:
             self._m_severed.inc()
@@ -315,7 +521,38 @@ class TcpBackend(RingCollectivesMixin):
             except OSError:  # pragma: no cover - already dead
                 pass
 
-    def _peer_send(self, peer: int, data: bytes):
+    # -- persistent sender plumbing ------------------------------------
+    def _sender_queue_depth(self) -> float:
+        with self._senders_lock:
+            return float(sum(s.queue.qsize()
+                             for s in self._senders.values()))
+
+    def _sender_for(self, peer: int) -> _PeerSender:
+        with self._senders_lock:
+            snd = self._senders.get(peer)
+            if snd is None:
+                snd = _PeerSender(self, peer)
+                self._senders[peer] = snd
+            return snd
+
+    def send_async(self, peer: int, payload) -> _SendTicket:
+        """Queue a framed send on the peer's persistent sender worker
+        and return a completion ticket (ring data-plane primitive:
+        the send of one segment overlaps the caller's recv+reduce)."""
+        self._peer_sock(peer)  # fail fast on a severed peer
+        return self._sender_for(peer).send(payload)
+
+    def _peer_send(self, peer: int, data):
+        # Once a peer has a sender worker, every send to it must flow
+        # through the same FIFO — a direct socket write could interleave
+        # with a queued ring segment mid-frame.
+        snd = self._senders.get(peer)
+        if snd is not None:
+            snd.send(data).wait()
+            return
+        self._peer_send_direct(peer, data)
+
+    def _peer_send_direct(self, peer: int, data):
         sock = self._peer_sock(peer)
         try:
             if self._injector.active:
@@ -325,8 +562,9 @@ class TcpBackend(RingCollectivesMixin):
             if self._timeout > 0:
                 sock.settimeout(self._timeout)
             try:
-                _send_all(sock, data)
-                self._m_bytes_sent.inc(len(data) + 8)
+                sent = _send_all(sock, data)
+                self._m_bytes_sent.inc(sent + 8)
+                self._m_frames_sent.inc()
             finally:
                 if self._timeout > 0:
                     try:
@@ -341,7 +579,7 @@ class TcpBackend(RingCollectivesMixin):
                 f"rank {self.rank}: send to peer {peer} failed: {exc}"
             ) from exc
 
-    def _peer_recv(self, peer: int) -> bytes:
+    def _peer_recv(self, peer: int) -> bytearray:
         sock = self._peer_sock(peer)
         try:
             if self._injector.active:
@@ -359,20 +597,57 @@ class TcpBackend(RingCollectivesMixin):
                 f"rank {self.rank}: recv from peer {peer} failed: {exc}"
             ) from exc
 
+    def recv_into_from(self, peer: int, buf) -> int:
+        """Receive one p2p frame directly into a writable buffer (numpy
+        slice, bytearray, memoryview) — the zero-copy recv the ring data
+        plane reduces from. The frame length must match len(buf)
+        exactly: the ring protocol is size-deterministic, so a mismatch
+        means a desynced peer (e.g. HOROVOD_RING_SEGMENT_BYTES differing
+        across ranks) and the stream position is unrecoverable."""
+        view = as_byte_view(buf)
+        sock = self._peer_sock(peer)
+        try:
+            if self._injector.active:
+                self._injector.check_io(self.rank, peer, "recv")
+            (n,) = _LEN.unpack(
+                _recv_exact_bounded(sock, 8, self._timeout, self._poll))
+            if n != len(view):
+                raise OSError(
+                    f"frame length {n} != expected {len(view)} "
+                    f"(desynced peer; check HOROVOD_RING_SEGMENT_BYTES "
+                    f"matches on every rank)")
+            _recv_into_bounded(sock, view, self._timeout, self._poll)
+            self._m_bytes_recv.inc(n + 8)
+            return n
+        except (OSError, TimeoutError) as exc:
+            if isinstance(exc, (socket.timeout, TimeoutError)):
+                self._m_timeouts.inc()
+            self._sever(peer)
+            raise TransportError(
+                f"rank {self.rank}: recv from peer {peer} failed: {exc}"
+            ) from exc
+
     # ------------------------------------------------------------------
-    # transport primitives
-    def gather_bytes(self, payload: bytes) -> Optional[List[bytes]]:
+    # transport primitives. Payloads may be scatter-gather buffer lists
+    # (star.pack_array): the wire path sendmsg's them as-is; only a
+    # LOCALLY consumed payload (rank 0's own contribution) is joined.
+    def gather_bytes(self, payload) -> Optional[List[bytes]]:
         if self.size == 1:
-            return [payload]
+            return [join_buffers(payload)]
         if self.rank == 0:
-            out = [payload]
+            out = [join_buffers(payload)]
             for r in range(1, self.size):
                 out.append(self._peer_recv(r))
             return out
         self._peer_send(0, payload)
         return None
 
-    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+    def bcast_bytes(self, payload):
+        # Rank 0 gets its own payload back VERBATIM (possibly still a
+        # buffer list): every current root-side caller either ignores
+        # the return or passed a single blob, and joining eagerly would
+        # cost an O(payload) copy nobody reads. Joined blobs only come
+        # from the recv path.
         if self.size == 1:
             assert payload is not None
             return payload
@@ -383,7 +658,9 @@ class TcpBackend(RingCollectivesMixin):
             return payload
         return self._peer_recv(0)
 
-    def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
+    def scatter_bytes(self, payloads: Optional[List]) -> bytes:
+        # Same verbatim-return contract as bcast_bytes (alltoallv joins
+        # per_dest[0] itself when it actually decodes it).
         if self.size == 1:
             assert payloads is not None
             return payloads[0]
@@ -395,11 +672,13 @@ class TcpBackend(RingCollectivesMixin):
         return self._peer_recv(0)
 
     # ------------------------------------------------------------------
-    def send_to(self, peer: int, payload: bytes):
-        """Point-to-point framed send (ring data plane primitive)."""
+    def send_to(self, peer: int, payload):
+        """Point-to-point framed send (ring data plane primitive).
+        Accepts bytes | memoryview | numpy buffer | list of buffers —
+        scatter-gathered to the wire without concatenation."""
         self._peer_send(peer, payload)
 
-    def recv_from(self, peer: int) -> bytes:
+    def recv_from(self, peer: int) -> bytearray:
         return self._peer_recv(peer)
 
     def _close_all_peers(self):
@@ -411,4 +690,19 @@ class TcpBackend(RingCollectivesMixin):
         self.peers.clear()
 
     def shutdown(self):
+        # Drain the persistent senders first: the stop sentinel ends
+        # each worker after in-flight frames; closing the sockets then
+        # unblocks any worker stuck in a send (its ticket gets the
+        # resulting TransportError instead of hanging a waiter).
+        with self._senders_lock:
+            senders = list(self._senders.values())
+            self._senders.clear()
+        for snd in senders:
+            snd.stop()
         self._close_all_peers()
+        for snd in senders:
+            snd.thread.join(timeout=5)
+        # Detach the pull gauge so a dead backend is not pinned (and
+        # reported as live) by the process-default registry — unless a
+        # sibling backend (subset communicator) already took it over.
+        self._m_sender_depth.clear_function(self._sender_queue_depth)

@@ -15,6 +15,18 @@ import threading
 from typing import List, Optional
 
 from .ring import RingCollectivesMixin
+from .star import join_buffers
+
+
+def _blob(payload) -> bytes:
+    """Queues stand in for the wire here, so scatter-gather buffer
+    lists and memoryviews are flattened to immutable bytes at the
+    'send' boundary — the queue may hand one object to several ranks
+    (bcast), and a memoryview of a sender-side numpy chunk must not
+    alias mutable state across \"ranks\". Read-only-ness downstream is
+    what makes star's own_array copy, exactly as intended."""
+    joined = join_buffers(payload)
+    return joined if isinstance(joined, bytes) else bytes(joined)
 
 
 class ThreadedGroup:
@@ -39,7 +51,8 @@ class ThreadedBackend(RingCollectivesMixin):
         self.rank = rank
         self.size = group.size
 
-    def gather_bytes(self, payload: bytes) -> Optional[List[bytes]]:
+    def gather_bytes(self, payload) -> Optional[List[bytes]]:
+        payload = _blob(payload)
         if self.size == 1:
             return [payload]
         if self.rank == 0:
@@ -50,7 +63,9 @@ class ThreadedBackend(RingCollectivesMixin):
         self.group.up[self.rank].put(payload)
         return None
 
-    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+    def bcast_bytes(self, payload) -> bytes:
+        if payload is not None:
+            payload = _blob(payload)
         if self.size == 1:
             assert payload is not None
             return payload
@@ -61,21 +76,21 @@ class ThreadedBackend(RingCollectivesMixin):
             return payload
         return self.group.down[self.rank].get(timeout=60)
 
-    def scatter_bytes(self, payloads: Optional[List[bytes]]) -> bytes:
+    def scatter_bytes(self, payloads: Optional[List]) -> bytes:
         if self.size == 1:
             assert payloads is not None
-            return payloads[0]
+            return _blob(payloads[0])
         if self.rank == 0:
             assert payloads is not None
             for r in range(1, self.size):
-                self.group.down[r].put(payloads[r])
-            return payloads[0]
+                self.group.down[r].put(_blob(payloads[r]))
+            return _blob(payloads[0])
         return self.group.down[self.rank].get(timeout=60)
 
 
     # -- p2p primitives (ring/hierarchical data planes) ----------------
-    def send_to(self, peer: int, payload: bytes):
-        self.group.p2p[(self.rank, peer)].put(payload)
+    def send_to(self, peer: int, payload):
+        self.group.p2p[(self.rank, peer)].put(_blob(payload))
 
     def recv_from(self, peer: int) -> bytes:
         return self.group.p2p[(peer, self.rank)].get(timeout=60)

@@ -109,8 +109,18 @@ class Gauge:
     def dec(self, n: Union[int, float] = 1):
         self.inc(-n)
 
-    def set_function(self, fn: Callable[[], float]):
+    def set_function(self, fn: Optional[Callable[[], float]]):
         self._fn = fn
+
+    def clear_function(self, fn: Optional[Callable[[], float]] = None):
+        """Detach the pull callback — the public teardown contract for
+        owners going away (a dead Engine/backend must not be pinned by
+        the process-default registry, nor report frozen state as live).
+        Pass the callback you registered to detach only if you are
+        still the current owner (a sibling may have taken the gauge
+        over); None detaches unconditionally."""
+        if fn is None or self._fn == fn:
+            self._fn = None
 
     @property
     def value(self) -> float:

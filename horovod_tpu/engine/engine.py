@@ -134,6 +134,11 @@ class Engine:
             "Payload bytes per fused response", min_exp=0, max_exp=34)
         self._m_op_counters: Dict[str, Tuple] = {}
         self._m_op_latency: Dict[str, telemetry.Histogram] = {}
+        self.tensor_queue = TensorQueue(registry=self.registry)
+        # Pull gauges attach only after their backing state exists: on
+        # the process-default registry a scraper can sample mid-__init__
+        # (elastic shutdown+init window), and a callback hitting a
+        # not-yet-assigned attribute would report NaN instead of 0.
         self.registry.gauge(
             "horovod_tensor_queue_depth",
             "Tensors currently pending in the queue",
@@ -142,7 +147,6 @@ class Engine:
             "horovod_last_cycle_age_seconds",
             "Seconds since the background loop last completed a cycle",
         ).set_function(self._last_cycle_age)
-        self.tensor_queue = TensorQueue(registry=self.registry)
         self.handles = HandleManager()
         self.timeline = (Timeline(registry=self.registry) if rank == 0
                          else Timeline(use_env=False, registry=self.registry))
@@ -515,11 +519,19 @@ class Engine:
                 self.op_manager.select(
                     ResponseType.ADASUM if adasum else ResponseType.ALLREDUCE,
                     nbytes=zeros.nbytes, reduce_op=rop,
-                ).execute(zeros, rop)
+                ).execute(zeros, rop, owned=True)
             return
         name0 = entries[0].tensor_name
+        # `owned` tracks whether buf is a fresh engine-side temporary
+        # (packed by the native fusion memcpy or allocated by prescale):
+        # the ring data plane may then reduce it in place instead of
+        # taking a defensive copy. A user-enqueued tensor (single
+        # unfused entry) and the persistent pure-python fusion storage
+        # (reused next cycle, while results may still alias it) are NOT
+        # owned.
         if len(entries) == 1:
             buf = entries[0].tensor
+            owned = False
             shapes = None
         else:
             # Fusion buffer: flatten + concat (ref: MemcpyInFusionBuffer,
@@ -527,9 +539,10 @@ class Engine:
             # the C++ core is built).
             with self.timeline.activity(name0, MEMCPY_IN_FUSION_BUFFER):
                 shapes = [e.tensor.shape for e in entries]
-                buf = self._pack_fusion(entries)
+                buf, owned = self._pack_fusion(entries)
         if pre != 1.0:
             buf = _scale_np(buf, pre)
+            owned = True
         buf = np.asarray(buf)
         rop = ReduceOp(resp.reduce_op or int(ReduceOp.SUM))
         # First Enabled() implementation wins; the winning op's name is
@@ -541,7 +554,7 @@ class Engine:
         )
         t0 = time.monotonic()
         with self.timeline.activity(name0, op.name):
-            red = op.execute(buf, rop)
+            red = op.execute(buf, rop, owned=owned)
         self._observe_op(op.name, time.monotonic() - t0)
         if post != 1.0:
             red = _scale_np(red, post)
@@ -556,19 +569,23 @@ class Engine:
                                  red[off : off + n].reshape(shape))
                     off += n
 
-    def _pack_fusion(self, entries: List[TensorTableEntry]) -> np.ndarray:
-        """Copy entries into the persistent fusion buffer (one concat
-        target reused across cycles; the native threaded memcpy packs
-        when the C++ core is built)."""
+    def _pack_fusion(
+        self, entries: List[TensorTableEntry]
+    ) -> Tuple[np.ndarray, bool]:
+        """Copy entries into a fusion buffer; returns (buf, owned).
+        The native threaded memcpy packs into a FRESH buffer every
+        cycle (owned=True: the data plane may reduce it in place and
+        results may alias it); the pure-python fallback packs into the
+        persistent per-dtype storage reused across cycles (owned=False
+        — in-place reduction there would let next cycle's pack corrupt
+        results still aliased by callers)."""
         from ..cc import native
 
         dtype = entries[0].tensor.dtype
         total = sum(int(e.tensor.size) for e in entries)
-        # Native threaded memcpy stays the fast path every cycle; the
-        # persistent buffer only backs the pure-python fallback.
         packed = native.pack([e.tensor for e in entries])
         if packed is not None:
-            return packed.view(dtype)[:total]
+            return packed.view(dtype)[:total], True
         key = dtype.str
         storage = self._fusion_storage.get(key)
         if storage is None or storage.size < total:
@@ -579,7 +596,7 @@ class Engine:
             n = int(e.tensor.size)
             storage[off : off + n] = np.ravel(e.tensor)
             off += n
-        return storage[:total]
+        return storage[:total], False
 
     def _finish(self, entry: TensorTableEntry, status: Status, result):
         self.timeline.end(entry.tensor_name, entry.tensor_name.split(".")[0])
@@ -727,5 +744,5 @@ class Engine:
         # registry they would otherwise pin this dead Engine (fusion
         # buffers included) for process lifetime and report its frozen
         # state as live after an elastic shutdown+init cycle.
-        self.registry.gauge("horovod_tensor_queue_depth").set_function(None)
-        self.registry.gauge("horovod_last_cycle_age_seconds").set_function(None)
+        self.registry.gauge("horovod_tensor_queue_depth").clear_function()
+        self.registry.gauge("horovod_last_cycle_age_seconds").clear_function()

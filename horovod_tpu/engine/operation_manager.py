@@ -68,35 +68,40 @@ def build_default(backend) -> OperationManager:
     def _local(nbytes=0, reduce_op=None):
         return backend.size == 1
 
+    # Allreduce executors take `owned=` (engine-set for fresh fusion/
+    # prescale temporaries): the ring planes reduce owned buffers in
+    # place; algorithms without an in-place path just ignore it.
     if backend.size == 1:
         mgr.register(ResponseType.ALLREDUCE, OpEntry(
             "LOCAL_ALLREDUCE", _local,
-            lambda buf, rop: backend.allreduce(buf, rop),
+            lambda buf, rop, owned=False: backend.allreduce(buf, rop),
         ))
     else:
         mgr.register(ResponseType.ALLREDUCE, OpEntry(
             "HIERARCHICAL_RING_ALLREDUCE",
             lambda nbytes, reduce_op: ring_mod.hierarchical_eligible(
                 backend, nbytes, reduce_op),
-            lambda buf, rop: backend._hierarchical_allreduce(buf, rop),
+            lambda buf, rop, owned=False: backend._hierarchical_allreduce(
+                buf, rop, owned=owned),
         ))
         mgr.register(ResponseType.ALLREDUCE, OpEntry(
             "RING_ALLREDUCE",
             lambda nbytes, reduce_op: ring_mod.ring_eligible(
                 backend, nbytes, reduce_op),
-            lambda buf, rop: backend._ring_allreduce(buf, rop),
+            lambda buf, rop, owned=False: backend._ring_allreduce(
+                buf, rop, owned=owned),
         ))
         mgr.register(ResponseType.ALLREDUCE, OpEntry(
             "STAR_ALLREDUCE",
             lambda nbytes, reduce_op: True,
-            lambda buf, rop: StarCollectivesMixin.allreduce(
+            lambda buf, rop, owned=False: StarCollectivesMixin.allreduce(
                 backend, buf, rop),
         ))
 
     mgr.register(ResponseType.ADASUM, OpEntry(
         "ADASUM_VHDD",
         lambda nbytes=0, reduce_op=None: True,
-        lambda buf, rop=None: backend.adasum_allreduce_all(buf),
+        lambda buf, rop=None, owned=False: backend.adasum_allreduce_all(buf),
     ))
     if backend.size > 1 and hasattr(backend, "_ring_allgatherv"):
         mgr.register(ResponseType.ALLGATHER, OpEntry(

@@ -1,9 +1,172 @@
-"""TCP full-mesh bootstrap failure modes.
+"""TCP full-mesh backend: bootstrap failure modes + the zero-copy
+framing layer (scatter-gather sendmsg sends, recv-into receives,
+persistent per-peer senders).
 
 (ref: horovod/common/gloo/gloo_context.cc rendezvous bootstrap — gloo
 bounds its store waits with a timeout; the accept side here needs the
 same bound.)
 """
+import socket
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# framing helpers: scatter-gather send == old concat framing on the wire
+def test_send_all_scatter_gather_framing_roundtrip():
+    from horovod_tpu.backend.tcp import _recv_frame, _send_all
+
+    a, b = socket.socketpair()
+    try:
+        payload = np.arange(1000, dtype=np.float32)
+        header = b"hdr!"
+        sent = _send_all(a, [header, memoryview(payload)])
+        assert sent == 4 + payload.nbytes
+        frame = _recv_frame(b)
+        assert bytes(frame[:4]) == b"hdr!"
+        np.testing.assert_array_equal(
+            np.frombuffer(frame, np.float32, offset=4), payload)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_send_all_accepts_all_buffer_shapes():
+    from horovod_tpu.backend.tcp import _recv_frame, _send_all
+
+    a, b = socket.socketpair()
+    try:
+        for data, expect in [
+            (b"plain", b"plain"),
+            (bytearray(b"ba"), b"ba"),
+            (memoryview(b"mv"), b"mv"),
+            (np.array([1, 2], np.uint8), b"\x01\x02"),
+            ([b"x", b"", b"y"], b"xy"),   # empty buffer in the middle
+            (b"", b""),                    # empty frame
+            ([], b""),                     # empty list -> empty frame
+            (np.zeros((0, 3), np.float32), b""),  # 0-dim'd array
+        ]:
+            _send_all(a, data)
+            assert bytes(_recv_frame(b)) == expect
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_returns_writable_owned_buffer():
+    """unpack_array aliases recv'd frames zero-copy — that is only safe
+    because every recv allocates a fresh writable bytearray."""
+    from horovod_tpu.backend.tcp import _recv_frame, _send_all
+
+    a, b = socket.socketpair()
+    try:
+        _send_all(a, b"abc")
+        f1 = _recv_frame(b)
+        _send_all(a, b"xyz")
+        f2 = _recv_frame(b)
+        assert isinstance(f1, bytearray) and isinstance(f2, bytearray)
+        f1[0] = 0x7A  # writable, and distinct buffers
+        assert bytes(f2) == b"xyz"
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# recv_into_from + persistent senders over a real 2-backend mesh
+def _pair(scope, monkeypatch):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_fault_tolerance import _tcp_pair
+
+    return _tcp_pair(scope, monkeypatch)
+
+
+def test_recv_into_from_exact_and_zero_copy(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_recv_into", monkeypatch)
+    try:
+        src = np.arange(4096, dtype=np.float64)
+        ticket = b0.send_async(1, src)
+        dst = np.zeros(4096, np.float64)
+        n = b1.recv_into_from(0, dst)
+        ticket.wait()
+        assert n == src.nbytes
+        np.testing.assert_array_equal(dst, src)
+        # empty frame into empty view
+        t2 = b0.send_async(1, b"")
+        assert b1.recv_into_from(0, np.zeros(0, np.float32)) == 0
+        t2.wait()
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_recv_into_from_length_mismatch_severs(monkeypatch):
+    """A frame that does not match the expected length is a protocol
+    desync (e.g. HOROVOD_RING_SEGMENT_BYTES differing across ranks):
+    unrecoverable, so the peer is severed with TransportError."""
+    from horovod_tpu.common.exceptions import TransportError
+
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_mismatch", monkeypatch)
+    try:
+        b0.send_to(1, b"12345678")
+        with pytest.raises(TransportError, match="desynced peer"):
+            b1.recv_into_from(0, bytearray(4))
+        # severed: later I/O on that peer fails fast
+        with pytest.raises(TransportError):
+            b1.recv_from(0)
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_sync_sends_route_through_persistent_sender_fifo(monkeypatch):
+    """Once a peer has a sender worker, a plain send_to must flow
+    through the same FIFO — interleaved frames from two paths would
+    corrupt the stream mid-frame."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_fifo", monkeypatch)
+    try:
+        tickets = [b0.send_async(1, f"async{i}".encode()) for i in range(3)]
+        b0.send_to(1, b"sync")  # waits: queued behind the async frames
+        got = [bytes(b1.recv_from(0)) for _ in range(4)]
+        for t in tickets:
+            t.wait()
+        assert got == [b"async0", b"async1", b"async2", b"sync"]
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_sendmsg_frames_and_bytes_counters(monkeypatch):
+    from horovod_tpu.common import telemetry
+
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_counters", monkeypatch)
+    try:
+        reg = telemetry.default_registry()
+        frames0 = reg.counter("horovod_tcp_sendmsg_frames_total").value
+        sent0 = reg.counter("horovod_tcp_bytes_sent_total").value
+        payload = np.arange(256, dtype=np.float32)
+        b0.send_to(1, payload)
+        b1.recv_from(0)
+        assert reg.counter(
+            "horovod_tcp_sendmsg_frames_total").value == frames0 + 1
+        # exact accounting: payload + 8-byte length header
+        assert reg.counter(
+            "horovod_tcp_bytes_sent_total").value == sent0 + payload.nbytes + 8
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
 
 
 def test_mesh_bootstrap_accept_timeout(monkeypatch):

@@ -421,6 +421,184 @@ def test_stall_disabled_never_aborts(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# fault injection x the zero-copy/pipelined I/O paths: sever mid-segment,
+# delay on the persistent sender queue, timeout during recv_into. Every
+# failure must still surface as TransportError (⊂ HorovodInternalError,
+# the class the engine's fail-all-pending path keys on — covered by
+# test_engine_transport_error_fails_all_pending_and_latches above).
+def _ring_pair_allreduce(b0, b1, count=8192):
+    """Drive a 2-rank ring allreduce on real TCP backends; returns
+    (results, errors) without raising so callers can assert on the
+    failure mode."""
+    results, errors = [None, None], [None, None]
+
+    def w(i, b):
+        try:
+            x = np.arange(count, dtype=np.float32) * (i + 1)
+            results[i] = b.allreduce(x)
+        except BaseException as e:  # noqa: BLE001
+            errors[i] = e
+
+    ts = [threading.Thread(target=w, args=(i, b))
+          for i, b in ((0, b0), (1, b1))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    return results, errors
+
+
+def test_sever_mid_segment_raises_transport_error(monkeypatch):
+    """A sever that fires on the Nth frame lands MID-CHUNK on the
+    segmented pipelined path (each ring step is several frames): the
+    persistent sender's ticket must carry it back as TransportError."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    # 8192 floats / 2 ranks = 16KB chunks; 4KB segments -> 4 frames per
+    # step, so after=2 fires mid-chunk.
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "5")
+    server, (b0, b1) = _tcp_pair("t_sever_seg", monkeypatch)
+    try:
+        fault_injection.injector.install(
+            [Rule(action="sever", rank=0, peer=1, op="send", after=2)]
+        )
+        results, errors = _ring_pair_allreduce(b0, b1)
+        # rank 0 fails with TransportError: either the severed send's
+        # ticket surfaces first, or its concurrent recv on the (now
+        # hard-closed) socket does — both translate cleanly.
+        assert isinstance(errors[0], TransportError), errors
+        # rank 0's socket to peer 1 is hard-closed: fail fast afterwards
+        with pytest.raises(TransportError):
+            b0.send_to(1, b"x")
+    finally:
+        fault_injection.injector.clear()
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_sever_mid_segment_fails_the_exact_ticket(monkeypatch):
+    """Driving the segmented send path directly: segment 3 of 4 hits the
+    sever rule, and ITS ticket carries the translated error while the
+    first two segments completed."""
+    import numpy as np_
+
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "5")
+    server, (b0, b1) = _tcp_pair("t_sever_ticket", monkeypatch)
+    try:
+        fault_injection.injector.install(
+            [Rule(action="sever", rank=0, peer=1, op="send", after=2)]
+        )
+        seg = np_.arange(1024, dtype=np_.float32)
+        tickets = [b0.send_async(1, seg) for _ in range(4)]
+        for _ in range(2):  # the two pre-sever segments arrive intact
+            assert len(b1.recv_from(0)) == seg.nbytes
+        tickets[0].wait()
+        tickets[1].wait()
+        with pytest.raises(TransportError, match="severed"):
+            tickets[2].wait()
+        # everything queued behind the sever fails too (peer gone)
+        with pytest.raises(TransportError):
+            tickets[3].wait()
+    finally:
+        fault_injection.injector.clear()
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_delay_on_persistent_sender_queue(monkeypatch):
+    """A delay rule sleeps inside the persistent sender worker: the
+    queued frame is late but correct, and the caller only feels the
+    delay at ticket wait / recv time."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _tcp_pair("t_delay_sender", monkeypatch)
+    try:
+        fault_injection.injector.install(
+            [Rule(action="delay", rank=0, peer=1, op="send", secs=0.3)]
+        )
+        t0 = time.monotonic()
+        ticket = b0.send_async(1, b"payload")  # returns immediately
+        enqueue_dt = time.monotonic() - t0
+        assert enqueue_dt < 0.25, f"send_async blocked {enqueue_dt:.2f}s"
+        data = b1.recv_from(0)
+        ticket.wait()
+        assert bytes(data) == b"payload"
+        assert time.monotonic() - t0 >= 0.3  # the worker slept
+    finally:
+        fault_injection.injector.clear()
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_timeout_during_recv_into(monkeypatch):
+    """A silent peer must trip the bounded recv_into within
+    HOROVOD_TCP_TIMEOUT_SECONDS — the zero-copy path keeps the
+    dead-peer heartbeat."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "0.5")
+    server, (b0, b1) = _tcp_pair("t_silent_into", monkeypatch)
+    try:
+        buf = np.zeros(64, np.float32)
+        t0 = time.monotonic()
+        with pytest.raises(TransportError, match="no progress"):
+            b0.recv_into_from(1, buf)
+        assert time.monotonic() - t0 < 2.0
+        # the timed-out peer is severed: fail fast, same type
+        with pytest.raises(TransportError):
+            b0.recv_into_from(1, buf)
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_timeout_mid_frame_during_recv_into(monkeypatch):
+    """A peer that sends a frame header then goes silent: recv_into is
+    already parked on the payload and must still respect the idle
+    deadline."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "0.5")
+    server, (b0, b1) = _tcp_pair("t_half_frame", monkeypatch)
+    try:
+        import struct as _struct
+
+        # Raw header promising 1024 bytes, then silence.
+        b1.peers[0].sendall(_struct.pack("<Q", 1024))
+        buf = bytearray(1024)
+        with pytest.raises(TransportError, match="no progress"):
+            b0.recv_into_from(1, buf)
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_drop_on_pipelined_send_hangs_peer_into_timeout(monkeypatch):
+    """A dropped segment means the receiver's recv_into starves: it
+    must fail via the bounded timeout, not hang."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "1")
+    server, (b0, b1) = _tcp_pair("t_drop_seg", monkeypatch)
+    try:
+        fault_injection.injector.install(
+            [Rule(action="drop", rank=0, peer=1, op="send", after=1)]
+        )
+        results, errors = _ring_pair_allreduce(b0, b1)
+        assert isinstance(errors[1], TransportError), errors
+        # Either the starved recv's own idle timeout fires, or the
+        # other rank times out first and its sever delivers a FIN —
+        # both are clean bounded TransportError failures.
+        assert ("no progress" in str(errors[1])
+                or "closed connection" in str(errors[1])), errors
+    finally:
+        fault_injection.injector.clear()
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # chaos: kill 1 of 4 real workers mid-step (the acceptance scenario)
 _CHAOS_WORKER = textwrap.dedent("""
     import os, sys

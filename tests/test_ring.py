@@ -2,6 +2,7 @@
 (ref test model: Gloo ring allreduce coverage in test/test_torch.py
 op-variant tests; ring algorithm ref: gloo_operations.cc:119-166)."""
 import numpy as np
+import pytest
 
 from horovod_tpu.runner import run
 
@@ -182,6 +183,89 @@ def test_small_allgather_stays_on_star(monkeypatch):
     assert calls == []
 
 
+# ---------------------------------------------------------------------------
+# _bounds / _segment_bounds degenerate chunking (the pipelined path must
+# handle zero-size chunks, remainder-in-last-chunk and non-divisible
+# segment sizes without desyncing frame counts)
+def test_bounds_total_smaller_than_group():
+    from horovod_tpu.backend.ring import RingCollectivesMixin
+
+    # total < n: base chunk is 0 elements, the whole payload lands in
+    # the last chunk; every earlier chunk is zero-size.
+    b = RingCollectivesMixin._bounds(2, 4)
+    assert b == [0, 0, 0, 0, 2]
+    sizes = [b[i + 1] - b[i] for i in range(4)]
+    assert sizes == [0, 0, 0, 2]
+
+
+def test_bounds_remainder_in_last_chunk():
+    from horovod_tpu.backend.ring import RingCollectivesMixin
+
+    b = RingCollectivesMixin._bounds(10, 3)
+    assert b == [0, 3, 6, 10]
+    assert b[-1] - b[-2] == 4  # remainder rides the last chunk
+
+
+def test_segment_bounds_degenerate_cases():
+    from horovod_tpu.backend.ring import RingCollectivesMixin
+
+    seg = RingCollectivesMixin._segment_bounds
+    # zero-size chunk: exactly ONE empty segment (the frame still flows
+    # so ring steps stay aligned)
+    assert seg(0, 4) == [0, 0]
+    # single-shot (seg_elems=0) and seg >= chunk: one segment
+    assert seg(10, 0) == [0, 10]
+    assert seg(10, 100) == [0, 10]
+    # non-divisible: remainder in the last segment
+    assert seg(10, 4) == [0, 4, 8, 10]
+    # exact division
+    assert seg(8, 4) == [0, 4, 8]
+
+
+@pytest.mark.parametrize("total,seg_bytes", [
+    (2, 0),      # total < n: zero-size chunks, empty frames
+    (10001, 0),  # remainder-in-last-chunk, single-shot
+    (10001, 52), # non-divisible segment size on the pipelined path
+    (3, 8),      # total < n AND segmentation armed
+])
+def test_ring_allreduce_degenerate_chunking(monkeypatch, total, seg_bytes):
+    """4-rank ring allreduce across the degenerate chunk geometries:
+    zero-size chunks must send/recv empty frames cleanly and
+    non-divisible segment sizes must not desync the pipelined path."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", str(seg_bytes))
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+
+    def fn(b, r):
+        x = np.arange(total, dtype=np.float32) * (r + 1)
+        return b.allreduce(x)
+
+    out = _run_ring_backends(4, fn)
+    expect = np.arange(total, dtype=np.float32) * 10.0  # 1+2+3+4
+    for o in out:
+        np.testing.assert_allclose(o, expect)
+
+
+def test_ring_allgatherv_segmented(monkeypatch):
+    """The segmented path covers the allgather phase too (chunks land
+    straight in their final slice, segment by segment)."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "64")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    dims = [7, 0, 3]
+
+    def fn(b, r):
+        arr = np.full((dims[r], 5), float(r), np.float32)
+        return b.allgatherv(arr, list(dims))
+
+    out = _run_ring_backends(3, fn)
+    expect = np.concatenate(
+        [np.full((dims[r], 5), float(r), np.float32) for r in range(3)]
+    )
+    for o in out:
+        np.testing.assert_allclose(o, expect)
+
+
 def test_engine_ring_allgather_end_to_end(monkeypatch, tmp_path):
     """Engine-level: a large allgather rides the ring (timeline shows
     RING_ALLGATHER) and returns correct variable-dim output."""
@@ -210,3 +294,90 @@ def test_engine_ring_allgather_end_to_end(monkeypatch, tmp_path):
     run_ranks(2, fn)
     events = json.loads(path.read_text())
     assert "RING_ALLGATHER" in {e.get("name") for e in events}
+
+
+# ---------------------------------------------------------------------------
+# structural guarantee behind the thread-per-step fix: persistent peer
+# senders are created once at warm-up and REUSED — a full ring allreduce
+# must not create any new thread afterwards.
+def test_ring_allreduce_no_new_threads_after_warmup(monkeypatch):
+    import os
+    import sys
+    import threading
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_fault_tolerance import _tcp_pair
+
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "30")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    server, (b0, b1) = _tcp_pair("t_threads", monkeypatch)
+    try:
+        def both(fn):
+            res = [None, None]
+            errs = []
+
+            def w(i, b):
+                try:
+                    res[i] = fn(b, i)
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=w, args=(i, b))
+                  for i, b in ((0, b0), (1, b1))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert not errs, errs
+            return res
+
+        x = np.arange(5000, dtype=np.float32)
+        both(lambda b, i: b.allreduce(x * (i + 1)))  # warm-up: senders spawn
+        threads_after_warmup = set(threading.enumerate())
+        for _ in range(3):
+            out = both(lambda b, i: b.allreduce(x * (i + 1)))
+        for o in out:
+            np.testing.assert_allclose(o, x * 3)
+        new = set(threading.enumerate()) - threads_after_warmup
+        assert not new, f"ring steps spawned new threads: {new}"
+        # ...and the warm-up created exactly one persistent sender per
+        # live peer on each backend.
+        assert set(b0._senders) == {1} and set(b1._senders) == {0}
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_shutdown_stops_persistent_senders(monkeypatch):
+    import os
+    import sys
+    import time as _time
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_fault_tolerance import _tcp_pair
+
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "30")
+    monkeypatch.delenv("HOROVOD_CPU_OPERATIONS", raising=False)
+    server, (b0, b1) = _tcp_pair("t_sender_shutdown", monkeypatch)
+    try:
+        t0 = b0.send_async(1, b"x")
+        data = b1.recv_from(0)
+        t0.wait()
+        assert bytes(data) == b"x"
+        sender_threads = [s.thread for s in b0._senders.values()]
+        assert sender_threads and all(t.is_alive() for t in sender_threads)
+        b0.shutdown()
+        deadline = _time.monotonic() + 10
+        while (any(t.is_alive() for t in sender_threads)
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        assert not any(t.is_alive() for t in sender_threads), (
+            "sender workers survived shutdown")
+        assert not b0._senders
+    finally:
+        b1.shutdown()
+        server.stop()
