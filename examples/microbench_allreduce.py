@@ -65,6 +65,96 @@ def _bench_one(hvd, np, algo, count, iters, warmup):
             "busbw_GBps": busbw / 1e9}
 
 
+def _percentile(sorted_vals, q):
+    return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+
+def _bench_latency(hvd, np, basics, args):
+    """Small-op enqueue-to-complete latency (p50/p99), swept over
+    channel counts. Measures the engine path directly (enqueue +
+    synchronize) so the number is the engine's latency, not the
+    framework wrapper's. Compare a second launch with
+    HOROVOD_CYCLE_EVENT_DRIVEN=0 to see the fixed-sleep floor this
+    mode exists to demonstrate."""
+    import os as _os
+    import time as _time
+
+    eng = basics.engine()
+    x = np.ones(args.lat_count, np.float32)
+    rows = []
+    for nch in [1, args.channels]:
+        _os.environ["HOROVOD_NUM_CHANNELS"] = str(nch)
+        # Per-arm tensor name: a cached response replays the channel it
+        # was negotiated with, so reusing one name across arms would
+        # keep the second arm on the first arm's channel schedule.
+        name = f"lat.c{nch}"
+        for i in range(args.warmup):
+            eng.synchronize(eng.enqueue_allreduce(x, name=name),
+                            timeout=120)
+        hvd.barrier()
+        lats = []
+        for i in range(args.iters):
+            t0 = _time.perf_counter()
+            eng.synchronize(eng.enqueue_allreduce(x, name=name),
+                            timeout=120)
+            lats.append(_time.perf_counter() - t0)
+        hvd.barrier()
+        lats.sort()
+        rows.append({"channels": nch, "bytes": x.nbytes,
+                     "p50_us": _percentile(lats, 0.5) * 1e6,
+                     "p99_us": _percentile(lats, 0.99) * 1e6})
+    return rows
+
+
+def _bench_pipeline(hvd, np, basics, args):
+    """Mixed-size pipelined workload: an async window of big allreduces
+    with small allreduces interleaved (the gradient + metrics/sync-BN
+    shape), channels=1 vs channels=N interleaved per round so the two
+    arms see the same machine state. Fusion is disabled for the loop so
+    each op is its own response and the channel schedule is what's
+    measured."""
+    import os as _os
+    import time as _time
+
+    eng = basics.engine()
+    prev_fusion = eng.controller.fusion_threshold
+    eng.controller.fusion_threshold = 1
+    stream = []
+    per_big = max(args.pipe_smalls // max(args.pipe_bigs, 1), 0)
+    for _ in range(args.pipe_bigs):
+        stream.append(args.pipe_big_count)
+        stream.extend([args.pipe_small_count] * per_big)
+    bufs = {n: np.ones(n, np.float32) for n in set(stream)}
+
+    def one_round(nch, tag):
+        _os.environ["HOROVOD_NUM_CHANNELS"] = str(nch)
+        hvd.barrier()
+        t0 = _time.perf_counter()
+        handles = [
+            eng.enqueue_allreduce(bufs[n], name=f"pipe.{tag}.{i}")
+            for i, n in enumerate(stream)
+        ]
+        for h in handles:
+            eng.synchronize(h, timeout=300)
+        dt = _time.perf_counter() - t0
+        hvd.barrier()
+        return dt
+
+    one_round(1, "w1")
+    one_round(args.channels, "w2")
+    pairs = [(one_round(1, f"a{r}"), one_round(args.channels, f"b{r}"))
+             for r in range(args.pipe_rounds)]
+    eng.controller.fusion_threshold = prev_fusion
+    ratios = sorted(a / b for a, b in pairs)
+    return {
+        "stream_bytes": [n * 4 for n in stream],
+        "channels": args.channels,
+        "pairs_s": [[round(a, 4), round(b, 4)] for a, b in pairs],
+        "ratios": [round(x, 3) for x in ratios],
+        "median_speedup": round(_percentile(ratios, 0.5), 3),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="16384,262144,4194304",
@@ -81,6 +171,23 @@ def main():
     p.add_argument("--segment-bytes", type=int, default=None,
                    help="HOROVOD_RING_SEGMENT_BYTES for the segmented "
                         "ring (default: the library default)")
+    p.add_argument("--mode", choices=["bw", "latency", "pipeline"],
+                   default="bw",
+                   help="bw: the throughput sweep (default); latency: "
+                        "small-op p50/p99 enqueue-to-complete, 1-vs-N "
+                        "channels; pipeline: mixed-size async window, "
+                        "channels=1 vs N paired rounds")
+    p.add_argument("--channels", type=int, default=2,
+                   help="the N in the 1-vs-N channel comparisons")
+    p.add_argument("--lat-count", type=int, default=16384,
+                   help="latency-mode element count (default 64KB)")
+    p.add_argument("--pipe-rounds", type=int, default=5)
+    p.add_argument("--pipe-bigs", type=int, default=2)
+    p.add_argument("--pipe-smalls", type=int, default=48)
+    p.add_argument("--pipe-big-count", type=int, default=2097152,
+                   help="big-op element count (default 8MB)")
+    p.add_argument("--pipe-small-count", type=int, default=16384,
+                   help="small-op element count (default 64KB)")
     args = p.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -99,6 +206,34 @@ def main():
 
     hvd.init()
     r, n = hvd.rank(), hvd.size()
+
+    if args.mode == "latency":
+        rows = _bench_latency(hvd, np, basics, args)
+        if r == 0:
+            print(f"{'channels':>8} {'bytes':>10} {'p50(us)':>12} "
+                  f"{'p99(us)':>12}")
+            for row in rows:
+                print(f"{row['channels']:>8} {row['bytes']:>10} "
+                      f"{row['p50_us']:>12.1f} {row['p99_us']:>12.1f}")
+            print(json.dumps({
+                "metric": "eager_allreduce_latency", "np": n,
+                "event_driven": os.environ.get(
+                    "HOROVOD_CYCLE_EVENT_DRIVEN", "1"),
+                "rows": [{k: (round(v, 1) if isinstance(v, float) else v)
+                          for k, v in row.items()} for row in rows]}))
+        return
+
+    if args.mode == "pipeline":
+        summary = _bench_pipeline(hvd, np, basics, args)
+        if r == 0:
+            print(f"pipeline rounds (s): {summary['pairs_s']}")
+            print(f"median speedup channels={summary['channels']} vs 1: "
+                  f"{summary['median_speedup']}x")
+            print(json.dumps(dict(
+                {"metric": "eager_allreduce_pipeline", "np": n},
+                **summary)))
+        return
+
     backend = basics.engine().backend if basics.engine() else None
 
     if args.algo in ("sweep",):

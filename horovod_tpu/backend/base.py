@@ -14,12 +14,47 @@ transport use.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from ..common.types import ReduceOp
 from ..engine.controller import ControllerTransport
+
+# Reserved frame tag for control-plane traffic (negotiation gathers,
+# cache bitvector passes, fenced barriers — everything issued from the
+# engine's background thread outside a channel scope). Data channels are
+# 0..MAX_CHANNELS-1 and can never collide with it.
+CTRL_CHANNEL = 0xFF
+
+# The active executor channel is thread-scoped, not call-threaded: one
+# thread runs one response at a time, so a thread-local avoids plumbing
+# a channel argument through every collective signature (engine op
+# registry -> mixin -> transport primitive). Module-level because
+# backends don't share an __init__ to hang per-instance state on; a
+# thread only ever executes for one backend inside a scope.
+_channel_ctx = threading.local()
+
+
+def current_channel() -> int:
+    """Channel tag for data-plane frames issued by the calling thread;
+    CTRL_CHANNEL outside any scope (control plane, direct backend use)."""
+    return getattr(_channel_ctx, "channel", CTRL_CHANNEL)
+
+
+@contextlib.contextmanager
+def channel_scope(channel: int):
+    prev = getattr(_channel_ctx, "channel", None)
+    _channel_ctx.channel = channel
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _channel_ctx.channel
+        else:
+            _channel_ctx.channel = prev
 
 
 class Backend(ControllerTransport):
@@ -43,6 +78,16 @@ class Backend(ControllerTransport):
     # MPIHierarchicalAllgather) — set by the engine from the collectively
     # agreed topology validity.
     hier_allgather: bool = False
+
+    def channel_scope(self, channel: int):
+        """Context manager tagging this thread's data-plane traffic with
+        an executor channel (engine sets it around each response). The
+        tag rides the TCP frame header so two in-flight collectives on
+        one socket demultiplex instead of interleaving payloads."""
+        return channel_scope(channel)
+
+    def current_channel(self) -> int:
+        return current_channel()
 
     def set_topology(self, local_rank: int, local_size: int,
                      cross_rank: int, cross_size: int):

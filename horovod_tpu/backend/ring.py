@@ -423,16 +423,21 @@ class RingCollectivesMixin(StarCollectivesMixin):
             return 0  # single-shot
         return max(1, sb // itemsize)
 
-    # Persistent recv scratch for the reduce-scatter phase, per dtype,
-    # grown to the largest double-buffer seen. Only the engine's single
-    # background thread runs collectives, so no locking is needed.
-    _ring_scratch_store: Optional[Dict[str, np.ndarray]] = None
+    # Persistent recv scratch for the reduce-scatter phase, keyed
+    # (executor channel, dtype), grown to the largest double-buffer
+    # seen. Channel executors run collectives concurrently, so each
+    # channel owns its scratch; within a channel execution is serial
+    # (per-channel FIFO), so no further locking is needed — dict
+    # insertion itself is GIL-atomic and the keys are disjoint.
+    _ring_scratch_store: Optional[Dict[tuple, np.ndarray]] = None
 
     def _ring_scratch(self, dtype: np.dtype, nelems: int) -> np.ndarray:
         store = self._ring_scratch_store
         if store is None:
             store = self._ring_scratch_store = {}
-        key = dtype.str
+        from .base import current_channel
+
+        key = (current_channel(), dtype.str)
         buf = store.get(key)
         if buf is None or buf.size < nelems:
             buf = store[key] = np.empty(max(nelems, 1), dtype)

@@ -28,13 +28,23 @@ payload...])` — no length-prefix concat copy, numpy chunks go to the
 wire as memoryviews) and receives land via `recv_into` on a byte
 cursor over a caller- or freshly-allocated buffer, so a frame costs
 zero intermediate copies in userspace. Ring data-plane sends ride a
-persistent queue-fed sender thread per peer (created lazily at the
-first p2p send, drained on shutdown/sever) instead of a helper thread
-per ring step; every send to a peer — sync control plane or async
-ring — flows through the same FIFO, so frames can never interleave.
+persistent queue-fed sender thread per peer (created at the first send
+to that peer, drained on shutdown/sever); EVERY send to a peer — sync
+control plane, async ring, any executor channel — flows through that
+FIFO, so frames can never interleave mid-frame even with concurrent
+channel executors.
+
+Channel-tagged frames: the header carries a 1-byte channel tag
+(executor channel for pipelined data-plane ops, CTRL_CHANNEL for
+control-plane traffic). A per-peer receive demultiplexer routes frames
+to per-channel inboxes, so two in-flight collectives sharing one
+socket can never steal each other's payloads: whichever thread is
+reading the socket delivers frames for other channels into their
+inboxes and keeps its zero-copy recv-into only for its own.
 """
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import select
@@ -49,13 +59,17 @@ from ..common.exceptions import HorovodInternalError, TransportError
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from ..utils.retry import call_with_retry
+from .base import CTRL_CHANNEL, current_channel
 from .rendezvous import RendezvousClient
 from .ring import RingCollectivesMixin
 from .star import as_byte_view, join_buffers
 
 logger = get_logger()
 
-_LEN = struct.Struct("<Q")
+# Frame header: u64 payload length + u8 channel tag. The tag is what
+# lets concurrent executor channels share one peer socket safely.
+_HDR = struct.Struct("<QB")
+_HDR_LEN = _HDR.size
 
 # sendmsg is POSIX; the sequential-sendall fallback keeps exotic
 # platforms working at the cost of one extra syscall per frame.
@@ -70,15 +84,15 @@ def _as_byte_views(data) -> List[memoryview]:
     return [as_byte_view(item) for item in items]
 
 
-def _send_all(sock: socket.socket, data) -> int:
+def _send_all(sock: socket.socket, data, channel: int = CTRL_CHANNEL) -> int:
     """Frame + send without concatenation: one scatter-gather
-    `sendmsg([length-header, *payload buffers])` in the common case,
-    looping with memoryview cursors on partial sends. Accepts anything
+    `sendmsg([header, *payload buffers])` in the common case, looping
+    with memoryview cursors on partial sends. Accepts anything
     `_as_byte_views` does. Returns the payload byte count (header
     excluded)."""
     views = _as_byte_views(data)
     total = sum(len(v) for v in views)
-    pending = [memoryview(_LEN.pack(total))]
+    pending = [memoryview(_HDR.pack(total, channel))]
     pending += [v for v in views if len(v)]
     if not _HAS_SENDMSG:  # pragma: no cover - POSIX always has sendmsg
         for v in pending:
@@ -127,7 +141,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 
 
 def _recv_frame(sock: socket.socket) -> bytearray:
-    (n,) = _LEN.unpack(_recv_exact(sock, 8))
+    """Direct (pre-demux) frame read — bootstrap identification and
+    framing tests only; the mesh's steady-state recvs go through the
+    per-peer demultiplexer."""
+    n, _ = _HDR.unpack(_recv_exact(sock, _HDR_LEN))
     return _recv_exact(sock, n)
 
 
@@ -242,19 +259,38 @@ class _PeerSender:
         # its waiter forever.
         self._lock = threading.Lock()
         self._closed = False
+        # Frames accepted but not yet fully written, per channel tag.
+        # The synchronous-send fast path may write the socket directly
+        # (skipping two thread hops) only while ITS channel has nothing
+        # pending here — same-channel order is the only order the
+        # receive demultiplexer cannot restore.
+        self.pending: Dict[int, int] = {}
         self.thread = threading.Thread(
             target=self._loop, name=f"hvd-sender-{peer}", daemon=True)
         self.thread.start()
 
-    def send(self, payload) -> _SendTicket:
+    def send(self, payload, channel: int = CTRL_CHANNEL) -> _SendTicket:
         ticket = _SendTicket()
         with self._lock:
             if self._closed:
                 ticket._done(TransportError(
                     f"sender for peer {self.peer} shut down"))
                 return ticket
-            self.queue.put((payload, ticket))
+            self.pending[channel] = self.pending.get(channel, 0) + 1
+            self.queue.put((payload, channel, ticket))
         return ticket
+
+    def channel_idle(self, channel: int) -> bool:
+        with self._lock:
+            return not self._closed and self.pending.get(channel, 0) == 0
+
+    def _frame_done(self, channel: int):
+        with self._lock:
+            n = self.pending.get(channel, 1) - 1
+            if n <= 0:
+                self.pending.pop(channel, None)
+            else:
+                self.pending[channel] = n
 
     def stop(self):
         with self._lock:
@@ -268,12 +304,18 @@ class _PeerSender:
             item = self.queue.get()
             if item is _SENDER_STOP:
                 break
-            payload, ticket = item
+            payload, channel, ticket = item
             try:
-                self._backend._peer_send_direct(self.peer, payload)
+                self._backend._peer_send_direct(self.peer, payload, channel)
             except BaseException as e:
+                self._frame_done(channel)
                 ticket._done(e)
             else:
+                # Decrement strictly AFTER the frame hit the wire (the
+                # write ran under the peer's wire mutex): a fast-path
+                # sender that then observes pending == 0 can only order
+                # itself after this frame.
+                self._frame_done(channel)
                 ticket._done()
         # Belt-and-braces drain: _closed guarantees nothing lands after
         # the sentinel, but fail anything unexpectedly left anyway
@@ -284,8 +326,29 @@ class _PeerSender:
             except queue.Empty:
                 break
             if item is not _SENDER_STOP:  # pragma: no cover - _closed gates
-                item[1]._done(TransportError(
+                item[2]._done(TransportError(
                     f"sender for peer {self.peer} shut down"))
+
+
+class _PeerDemux:
+    """Receive demultiplexer state for one peer socket. Exactly one
+    thread at a time reads the socket (`reading` flag under `cond`);
+    frames tagged for other channels are deposited into their per-
+    channel inboxes and waiters are notified. Consumers: one thread per
+    channel by construction (each channel has one executor; the control
+    plane is the single background thread), but the structure doesn't
+    rely on it."""
+
+    __slots__ = ("cond", "inbox", "reading")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.inbox: Dict[int, "collections.deque"] = {}
+        self.reading = False
+
+    def take(self, channel: int) -> Optional[bytearray]:
+        q = self.inbox.get(channel)
+        return q.popleft() if q else None
 
 
 class TcpBackend(RingCollectivesMixin):
@@ -326,10 +389,22 @@ class TcpBackend(RingCollectivesMixin):
             "horovod_sender_queue_depth",
             "Frames queued on persistent peer senders, summed over peers")
         self._m_sender_depth.set_function(self._sender_queue_depth)
+        # Per-channel frame accounting (recv side, where the demux sees
+        # every frame exactly once) — lazy per channel tag.
+        self._registry = registry
+        self._m_channel_frames: Dict[int, object] = {}
         # Persistent per-peer sender workers (lazy; _senders_lock guards
         # the dict — the workers themselves are single-consumer queues).
         self._senders: Dict[int, _PeerSender] = {}
         self._senders_lock = threading.Lock()
+        # Per-peer wire mutex: every frame write (worker or sync fast
+        # path) runs under it, so two threads can never interleave a
+        # frame mid-write even when the fast path bypasses the worker.
+        self._wire_locks: Dict[int, threading.Lock] = {}
+        # Per-peer receive demultiplexers (lazy; _demux_lock guards the
+        # dict only — routing runs under each demux's own condition).
+        self._demux: Dict[int, _PeerDemux] = {}
+        self._demux_lock = threading.Lock()
         self.rank = rank
         self.size = size
         if scope is None:
@@ -520,6 +595,14 @@ class TcpBackend(RingCollectivesMixin):
                 s.close()
             except OSError:  # pragma: no cover - already dead
                 pass
+        # Wake demux waiters parked on other channels' inboxes: their
+        # next read attempt hits the severed-peer fast path instead of
+        # polling out the remainder of a cond timeout.
+        with self._demux_lock:
+            d = self._demux.get(peer)
+        if d is not None:
+            with d.cond:
+                d.cond.notify_all()
 
     # -- persistent sender plumbing ------------------------------------
     def _sender_queue_depth(self) -> float:
@@ -535,42 +618,67 @@ class TcpBackend(RingCollectivesMixin):
                 self._senders[peer] = snd
             return snd
 
-    def send_async(self, peer: int, payload) -> _SendTicket:
+    def send_async(self, peer: int, payload, channel: Optional[int] = None
+                   ) -> _SendTicket:
         """Queue a framed send on the peer's persistent sender worker
         and return a completion ticket (ring data-plane primitive:
-        the send of one segment overlaps the caller's recv+reduce)."""
+        the send of one segment overlaps the caller's recv+reduce).
+        The channel tag is captured on the CALLER's thread — the sender
+        worker has no channel scope of its own."""
         self._peer_sock(peer)  # fail fast on a severed peer
-        return self._sender_for(peer).send(payload)
+        if channel is None:
+            channel = current_channel()
+        return self._sender_for(peer).send(payload, channel)
+
+    def _wire_lock(self, peer: int) -> threading.Lock:
+        with self._senders_lock:
+            lk = self._wire_locks.get(peer)
+            if lk is None:
+                lk = self._wire_locks[peer] = threading.Lock()
+            return lk
 
     def _peer_send(self, peer: int, data):
-        # Once a peer has a sender worker, every send to it must flow
-        # through the same FIFO — a direct socket write could interleave
-        # with a queued ring segment mid-frame.
+        """Synchronous framed send. Fast path: when this channel has no
+        frames pending on the peer's sender worker, write the socket
+        directly under the wire mutex — two thread hops cheaper, which
+        is most of a control round's latency on an idle mesh. Frames of
+        OTHER channels may be overtaken; the receive demultiplexer
+        exists to make that safe. With same-channel frames pending, the
+        send queues behind them (FIFO within a channel is the ordering
+        contract)."""
+        self._peer_sock(peer)  # fail fast on a severed peer
+        channel = current_channel()
+        # No sender worker for this peer yet ⇒ nothing can be pending:
+        # write directly (under the wire mutex) without spawning one —
+        # a pure control-plane mesh stays thread-free.
         snd = self._senders.get(peer)
-        if snd is not None:
-            snd.send(data).wait()
+        if snd is None or snd.channel_idle(channel):
+            self._peer_send_direct(peer, data, channel)
             return
-        self._peer_send_direct(peer, data)
+        snd.send(data, channel).wait()
 
-    def _peer_send_direct(self, peer: int, data):
+    def _peer_send_direct(self, peer: int, data, channel: int = CTRL_CHANNEL):
         sock = self._peer_sock(peer)
         try:
             if self._injector.active:
                 if (self._injector.check_io(self.rank, peer, "send")
                         == fault_injection.DROP):
                     return
-            if self._timeout > 0:
-                sock.settimeout(self._timeout)
-            try:
-                sent = _send_all(sock, data)
-                self._m_bytes_sent.inc(sent + 8)
-                self._m_frames_sent.inc()
-            finally:
+            # Wire mutex: the sender worker and the sync fast path must
+            # never interleave a frame mid-write on one socket.
+            with self._wire_lock(peer):
                 if self._timeout > 0:
-                    try:
-                        sock.settimeout(None)
-                    except OSError:
-                        pass
+                    sock.settimeout(self._timeout)
+                try:
+                    sent = _send_all(sock, data, channel)
+                    self._m_bytes_sent.inc(sent + _HDR_LEN)
+                    self._m_frames_sent.inc()
+                finally:
+                    if self._timeout > 0:
+                        try:
+                            sock.settimeout(None)
+                        except OSError:
+                            pass
         except (OSError, TimeoutError) as exc:
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()
@@ -579,16 +687,103 @@ class TcpBackend(RingCollectivesMixin):
                 f"rank {self.rank}: send to peer {peer} failed: {exc}"
             ) from exc
 
+    # -- receive demultiplexer -----------------------------------------
+    def _demux_for(self, peer: int) -> _PeerDemux:
+        with self._demux_lock:
+            d = self._demux.get(peer)
+            if d is None:
+                d = self._demux[peer] = _PeerDemux()
+            return d
+
+    def _count_frame(self, channel: int, nbytes: int):
+        self._m_bytes_recv.inc(nbytes + _HDR_LEN)
+        m = self._m_channel_frames.get(channel)
+        if m is None:
+            label = "ctrl" if channel == CTRL_CHANNEL else str(channel)
+            m = self._registry.counter(
+                "horovod_tcp_channel_frames_total",
+                "Frames received per channel tag (ctrl = control plane)",
+                labels={"channel": label})
+            self._m_channel_frames[channel] = m
+        m.inc()
+
+    def _demux_recv(self, peer: int, channel: int,
+                    view: Optional[memoryview]) -> Optional[bytearray]:
+        """Receive the next frame tagged `channel` from `peer`. With
+        `view` set, the payload lands in it zero-copy when this thread
+        reads its own frame off the socket (one copy when another
+        channel's reader deposited it); returns the owned bytearray
+        otherwise. Exactly one thread reads the socket at a time; frames
+        for other channels are deposited into their inboxes. A frame-
+        length/`view`-length mismatch is a desynced peer: OSError, which
+        the caller translates to sever + TransportError."""
+        d = self._demux_for(peer)
+        while True:
+            with d.cond:
+                while True:
+                    buf = d.take(channel)
+                    if buf is not None:
+                        if view is None:
+                            return buf
+                        if len(buf) != len(view):
+                            raise OSError(
+                                f"frame length {len(buf)} != expected "
+                                f"{len(view)} (desynced peer; check "
+                                f"HOROVOD_RING_SEGMENT_BYTES matches on "
+                                f"every rank)")
+                        view[:] = buf
+                        return None
+                    if not d.reading:
+                        d.reading = True
+                        break
+                    # Another thread owns the socket; its own idle
+                    # deadline bounds the wait. Wake on deposit/sever.
+                    if not self.peers.get(peer):
+                        raise ConnectionError(
+                            "peer severed while awaiting demuxed frame")
+                    d.cond.wait(self._poll)
+            deposit = None
+            got_mine = False
+            try:
+                sock = self._peer_sock(peer)
+                n, ch = _HDR.unpack(_recv_exact_bounded(
+                    sock, _HDR_LEN, self._timeout, self._poll))
+                if ch == channel:
+                    if view is not None:
+                        if n != len(view):
+                            raise OSError(
+                                f"frame length {n} != expected {len(view)} "
+                                f"(desynced peer; check "
+                                f"HOROVOD_RING_SEGMENT_BYTES matches on "
+                                f"every rank)")
+                        _recv_into_bounded(sock, view, self._timeout,
+                                           self._poll)
+                        result = None
+                    else:
+                        result = _recv_exact_bounded(
+                            sock, n, self._timeout, self._poll)
+                    got_mine = True
+                else:
+                    deposit = (ch, _recv_exact_bounded(
+                        sock, n, self._timeout, self._poll))
+                self._count_frame(ch, n)
+            finally:
+                with d.cond:
+                    d.reading = False
+                    if deposit is not None:
+                        d.inbox.setdefault(
+                            deposit[0], collections.deque()
+                        ).append(deposit[1])
+                    d.cond.notify_all()
+            if got_mine:
+                return result
+
     def _peer_recv(self, peer: int) -> bytearray:
-        sock = self._peer_sock(peer)
         try:
             if self._injector.active:
                 self._injector.check_io(self.rank, peer, "recv")
-            (n,) = _LEN.unpack(
-                _recv_exact_bounded(sock, 8, self._timeout, self._poll))
-            data = _recv_exact_bounded(sock, n, self._timeout, self._poll)
-            self._m_bytes_recv.inc(n + 8)
-            return data
+            self._peer_sock(peer)  # fail fast on a severed peer
+            return self._demux_recv(peer, current_channel(), None)
         except (OSError, TimeoutError) as exc:
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()
@@ -605,20 +800,12 @@ class TcpBackend(RingCollectivesMixin):
         means a desynced peer (e.g. HOROVOD_RING_SEGMENT_BYTES differing
         across ranks) and the stream position is unrecoverable."""
         view = as_byte_view(buf)
-        sock = self._peer_sock(peer)
         try:
             if self._injector.active:
                 self._injector.check_io(self.rank, peer, "recv")
-            (n,) = _LEN.unpack(
-                _recv_exact_bounded(sock, 8, self._timeout, self._poll))
-            if n != len(view):
-                raise OSError(
-                    f"frame length {n} != expected {len(view)} "
-                    f"(desynced peer; check HOROVOD_RING_SEGMENT_BYTES "
-                    f"matches on every rank)")
-            _recv_into_bounded(sock, view, self._timeout, self._poll)
-            self._m_bytes_recv.inc(n + 8)
-            return n
+            self._peer_sock(peer)  # fail fast on a severed peer
+            self._demux_recv(peer, current_channel(), view)
+            return len(view)
         except (OSError, TimeoutError) as exc:
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()

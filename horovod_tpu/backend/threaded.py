@@ -6,14 +6,21 @@ This lets the full negotiation/fusion/cache/join machinery run cross-
 "rank" in a single pytest process (the reference's analogue is running
 its test matrix under `horovodrun -np 2` on localhost; with one CPU core
 in CI, threads are the cheaper spelling).
+
+Channel isolation: every queue set is keyed by the caller's executor
+channel (backend/base.py thread-local scope; CTRL_CHANNEL outside any
+scope), mirroring the TCP backend's channel-tagged frame demultiplexer —
+two in-flight collectives on different channels exchange through
+disjoint queues and can never steal each other's payloads.
 """
 from __future__ import annotations
 
 import queue
 import struct
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from .base import current_channel
 from .ring import RingCollectivesMixin
 from .star import join_buffers
 
@@ -29,9 +36,10 @@ def _blob(payload) -> bytes:
     return joined if isinstance(joined, bytes) else bytes(joined)
 
 
-class ThreadedGroup:
+class _ChannelQueues:
+    """One channel's worth of exchange queues for the whole group."""
+
     def __init__(self, size: int):
-        self.size = size
         self.up = [queue.Queue() for _ in range(size)]    # rank -> root
         self.down = [queue.Queue() for _ in range(size)]  # root -> rank
         # Point-to-point channels keyed (src, dst) — the queue analogue
@@ -40,6 +48,20 @@ class ThreadedGroup:
             (s_, d): queue.Queue()
             for s_ in range(size) for d in range(size) if s_ != d
         }
+
+
+class ThreadedGroup:
+    def __init__(self, size: int):
+        self.size = size
+        self._lock = threading.Lock()
+        self._channels: Dict[int, _ChannelQueues] = {}
+
+    def chan(self, channel: int) -> _ChannelQueues:
+        with self._lock:
+            c = self._channels.get(channel)
+            if c is None:
+                c = self._channels[channel] = _ChannelQueues(self.size)
+            return c
 
     def backend(self, rank: int) -> "ThreadedBackend":
         return ThreadedBackend(self, rank)
@@ -55,12 +77,13 @@ class ThreadedBackend(RingCollectivesMixin):
         payload = _blob(payload)
         if self.size == 1:
             return [payload]
+        ch = self.group.chan(current_channel())
         if self.rank == 0:
             out = [payload]
             for r in range(1, self.size):
-                out.append(self.group.up[r].get(timeout=60))
+                out.append(ch.up[r].get(timeout=60))
             return out
-        self.group.up[self.rank].put(payload)
+        ch.up[self.rank].put(payload)
         return None
 
     def bcast_bytes(self, payload) -> bytes:
@@ -69,28 +92,31 @@ class ThreadedBackend(RingCollectivesMixin):
         if self.size == 1:
             assert payload is not None
             return payload
+        ch = self.group.chan(current_channel())
         if self.rank == 0:
             assert payload is not None
             for r in range(1, self.size):
-                self.group.down[r].put(payload)
+                ch.down[r].put(payload)
             return payload
-        return self.group.down[self.rank].get(timeout=60)
+        return ch.down[self.rank].get(timeout=60)
 
     def scatter_bytes(self, payloads: Optional[List]) -> bytes:
         if self.size == 1:
             assert payloads is not None
             return _blob(payloads[0])
+        ch = self.group.chan(current_channel())
         if self.rank == 0:
             assert payloads is not None
             for r in range(1, self.size):
-                self.group.down[r].put(_blob(payloads[r]))
+                ch.down[r].put(_blob(payloads[r]))
             return _blob(payloads[0])
-        return self.group.down[self.rank].get(timeout=60)
-
+        return ch.down[self.rank].get(timeout=60)
 
     # -- p2p primitives (ring/hierarchical data planes) ----------------
     def send_to(self, peer: int, payload):
-        self.group.p2p[(self.rank, peer)].put(_blob(payload))
+        self.group.chan(current_channel()).p2p[(self.rank, peer)].put(
+            _blob(payload))
 
     def recv_from(self, peer: int) -> bytes:
-        return self.group.p2p[(peer, self.rank)].get(timeout=60)
+        return self.group.chan(current_channel()).p2p[(peer, self.rank)].get(
+            timeout=60)

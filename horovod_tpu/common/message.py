@@ -175,16 +175,24 @@ class Response:
     # rank-consistent (ref: response_cache.cc put-from-response).
     tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
     reduce_op: int = 0
+    # Executor channel the coordinator assigned (round-robin over
+    # HOROVOD_NUM_CHANNELS for non-fence responses; fences stay 0).
+    # Wire-carried so every rank — workers and joined ranks replaying
+    # cached responses alike — executes the same response on the same
+    # channel in the same per-channel FIFO order, the ordering invariant
+    # that keeps concurrent collectives from deadlocking.
+    channel: int = 0
 
     def serialize(self) -> bytes:
         out = struct.pack(
-            "<iiddii",
+            "<iiddiii",
             int(self.response_type),
             int(self.tensor_type),
             self.prescale_factor,
             self.postscale_factor,
             self.last_joined_rank,
             self.reduce_op,
+            self.channel,
         )
         out += struct.pack("<I", len(self.tensor_names))
         for n in self.tensor_names:
@@ -199,8 +207,9 @@ class Response:
 
     @staticmethod
     def deserialize(buf: bytes, off: int = 0) -> Tuple["Response", int]:
-        rt, tt, pre, post, ljr, rop = struct.unpack_from("<iiddii", buf, off)
-        off += struct.calcsize("<iiddii")
+        rt, tt, pre, post, ljr, rop, chan = struct.unpack_from(
+            "<iiddiii", buf, off)
+        off += struct.calcsize("<iiddiii")
         (n,) = struct.unpack_from("<I", buf, off)
         off += 4
         names = []
@@ -218,7 +227,7 @@ class Response:
             shapes.append(tuple(int(d) for d in shp))
         return (
             Response(ResponseType(rt), names, err, [int(d) for d in devices],
-                     sizes, DataType(tt), pre, post, ljr, shapes, rop),
+                     sizes, DataType(tt), pre, post, ljr, shapes, rop, chan),
             off,
         )
 

@@ -22,6 +22,7 @@ MPIController does with MPI_Gather/Bcast (ref: mpi_controller.cc:88-199).
 """
 from __future__ import annotations
 
+import struct
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -44,10 +45,27 @@ from .stall import StallInspector
 
 logger = get_logger()
 
-# Flag bits carried in the first word of the cache-coordination bitvector
+# Flag bits carried in the cache-coordination exchange
 # (ref: response_cache.h CacheCoordinator flags).
 _FLAG_HAS_UNCACHED = 1 << 0
 _FLAG_SHUTDOWN = 1 << 1
+# This rank has joined: the coordinator substitutes an all-ones hit
+# vector for it in the AND pass (a joined rank participates in every
+# cached collective with zeros, so it must not veto the intersection).
+_FLAG_JOINED = 1 << 2
+
+_ALL_ONES = 0xFFFFFFFFFFFFFFFF
+
+# Response types eligible for a pipelined executor channel. Everything
+# else (JOIN / BARRIER / ERROR) is a fence: the engine drains all
+# channels before running it, so it keeps channel 0.
+_CHANNELED_TYPES = frozenset((
+    ResponseType.ALLREDUCE,
+    ResponseType.ADASUM,
+    ResponseType.ALLGATHER,
+    ResponseType.BROADCAST,
+    ResponseType.ALLTOALL,
+))
 
 
 class ControllerTransport:
@@ -118,6 +136,14 @@ class Controller:
         self._pending_cached: Dict[int, Request] = {}
         # Tensor metadata cache for fusion byte accounting
         self._sizes_by_name: Dict[str, int] = {}
+        # Round-robin executor-channel cursor (coordinator only). The
+        # assigned id rides the Response wire message, so workers follow
+        # rank 0's HOROVOD_NUM_CHANNELS — read per cycle, so flipping it
+        # between benchmark loops takes effect without a re-init. Cached
+        # responses replay the channel they were negotiated with (it is
+        # part of the cached Response on every rank), which keeps the
+        # per-channel FIFO identical everywhere.
+        self._next_channel = 0
 
     # ------------------------------------------------------------------
     def compute_response_list(
@@ -159,58 +185,49 @@ class Controller:
 
         responses: List[Response] = []
 
-        # --- cache coordination: two bitvector passes ------------------
+        # --- cache coordination: ONE fused control round ---------------
+        # Each rank gathers [flags, pending-hit bits, invalid bits] to
+        # rank 0, which computes the AND-intersection, the OR of flags
+        # and invalid bits, AND the requeue-induced HAS_UNCACHED (a
+        # pending bit outside the final intersection means its owner
+        # re-negotiates) in one shot, then broadcasts the verdict. The
+        # reference — and this engine until the pipelined-execution PR —
+        # spends two sequential word-allreduce rounds on this (AND pass,
+        # then OR pass); since a fully cached steady-state cycle is
+        # nothing BUT cache coordination, that second round was most of
+        # a small op's enqueue-to-complete latency.
         if self.cache_enabled:
             nwords = (max(self.response_cache.num_bits(), 1) + 63) // 64
-            if self.joined:
-                # A joined rank participates in every cached collective
-                # with zeros, so it must not veto the AND — mark all bits
-                # (ref: CacheCoordinator joined handling, response_cache.cc).
-                hit_words = [~0 & 0xFFFFFFFFFFFFFFFF] * nwords
-            else:
-                hit_words = self.response_cache.bits_to_vector(
-                    set(self._pending_cached), nwords
-                )
-            # Pass 1: AND of (cached ∧ pending) bits. A bit survives only
-            # when every rank has that tensor queued and cached this cycle.
-            and_words = self.transport.allreduce_words(hit_words, "and")
-            common_bits = ResponseCache.vector_to_bits(and_words)
-
-            # Hits that did not intersect go back to full negotiation
-            # (the cache entry stays; peers simply weren't ready).
-            for bit in sorted(set(self._pending_cached) - common_bits):
-                uncached.append(self._pending_cached.pop(bit))
-
-            # Pass 2: OR of status flags + invalid bits, computed *after*
-            # the requeue so HAS_UNCACHED reflects it. A rank overdue for
-            # a telemetry push raises the flag too: in a fully-cached
-            # steady state no gather would otherwise run, and the fleet
-            # view would go stale exactly when the job is busiest. The
-            # cost is one ordinary (empty) negotiation round per sync
-            # interval.
             flags = 0
+            # HAS_UNCACHED: a rank overdue for a telemetry push raises
+            # the flag too — in a fully-cached steady state no gather
+            # would otherwise run, and the fleet view would go stale
+            # exactly when the job is busiest. The cost is one ordinary
+            # (empty) negotiation round per sync interval.
             if uncached or self._telemetry_due():
                 flags |= _FLAG_HAS_UNCACHED
             if shutdown:
                 flags |= _FLAG_SHUTDOWN
-            or_words = self.transport.allreduce_words(
-                [flags] + self.response_cache.bits_to_vector(
-                    local_invalid_bits, nwords
-                ),
-                "or",
-            )
-            flags = or_words[0]
-            global_invalid = ResponseCache.vector_to_bits(or_words[1:])
+            if self.joined:
+                flags |= _FLAG_JOINED
+            pending_words = self.response_cache.bits_to_vector(
+                set(self._pending_cached), nwords)
+            invalid_words = self.response_cache.bits_to_vector(
+                local_invalid_bits, nwords)
+            flags, common_bits, global_invalid = self._coordinate_cache(
+                flags, pending_words, invalid_words)
             shutdown = bool(flags & _FLAG_SHUTDOWN)
             any_uncached = bool(flags & _FLAG_HAS_UNCACHED)
 
-            # Drop globally-invalidated entries everywhere; a parked hit
-            # on an invalidated bit re-negotiates instead.
+            # Hits outside the (invalid-pruned) intersection go back to
+            # full negotiation — peers weren't ready, or the entry was
+            # invalidated somewhere. The cache entry itself stays unless
+            # globally invalidated below.
+            for bit in sorted(set(self._pending_cached) - common_bits):
+                uncached.append(self._pending_cached.pop(bit))
+
+            # Drop globally-invalidated entries everywhere.
             for bit in global_invalid:
-                common_bits.discard(bit)
-                if bit in self._pending_cached:
-                    uncached.append(self._pending_cached.pop(bit))
-                    any_uncached = True
                 if self.response_cache.has_bit(bit):
                     self.response_cache.erase_bit(bit)
 
@@ -265,14 +282,24 @@ class Controller:
                         if n not in ready_names and len(rec.ranks) >= need:
                             ready_names.append(n)
                 # All ranks joined → emit JOIN response resetting state
-                # (ref: controller.cc:263-308).
+                # (ref: controller.cc:263-308). Appended AFTER this
+                # cycle's data responses: JOIN is an engine fence, and
+                # placing it last means the drain it triggers covers the
+                # final collectives negotiated in the same cycle — a
+                # completed join handle guarantees every earlier op of
+                # that rank has finished.
+                join_resp = None
                 if self.joined_ranks and len(self.joined_ranks) == self.size:
-                    negotiated.append(
-                        Response(ResponseType.JOIN, last_joined_rank=max(self.joined_ranks))
-                    )
+                    join_resp = Response(
+                        ResponseType.JOIN,
+                        last_joined_rank=max(self.joined_ranks))
                     self.joined_ranks.clear()
                 new_responses = [self._construct_response(n) for n in ready_names]
-                negotiated.extend(self._fuse_responses(new_responses))
+                fused = self._fuse_responses(new_responses)
+                self._assign_channels(fused)
+                negotiated.extend(fused)
+                if join_resp is not None:
+                    negotiated.append(join_resp)
                 stall_reason = self.stall_inspector.check()
                 if stall_reason:
                     shutdown = True
@@ -304,6 +331,97 @@ class Controller:
             return resp_list, resp_list.shutdown
 
         return ResponseList(responses, shutdown=shutdown), shutdown
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _pack_coord(flags: int, a: Sequence[int], b: Sequence[int]) -> bytes:
+        return struct.pack(f"<QII{len(a)}Q{len(b)}Q",
+                           flags, len(a), len(b), *a, *b)
+
+    @staticmethod
+    def _unpack_coord(buf) -> Tuple[int, List[int], List[int]]:
+        flags, na, nb = struct.unpack_from("<QII", buf, 0)
+        off = struct.calcsize("<QII")
+        words = struct.unpack_from(f"<{na + nb}Q", buf, off)
+        return flags, list(words[:na]), list(words[na:])
+
+    def _coordinate_cache(
+        self, flags: int, pending_words: List[int],
+        invalid_words: List[int],
+    ) -> Tuple[int, Set[int], Set[int]]:
+        """Fused cache-coordination round: one gather + one broadcast.
+        Returns (global flags, common bit set, globally-invalid bit
+        set). Vector lengths may differ across ranks while cache sizes
+        converge — rank 0 zero-extends (and extends a joined rank's
+        implicit all-ones hit vector to the full width, so a joined
+        rank can never veto bits its own cache hasn't grown to)."""
+        payload = self._pack_coord(flags, pending_words, invalid_words)
+        gathered = self.transport.gather_bytes(payload)
+        if self.is_coordinator:
+            decoded = [self._unpack_coord(b) for b in gathered]
+            nw = max(1, max(len(p) for _, p, _ in decoded),
+                     max(len(i) for _, _, i in decoded))
+            out_flags = 0
+            common = [_ALL_ONES] * nw
+            or_pending = [0] * nw
+            or_invalid = [0] * nw
+            for fl, pend, inv in decoded:
+                out_flags |= fl & (_FLAG_HAS_UNCACHED | _FLAG_SHUTDOWN)
+                joined = bool(fl & _FLAG_JOINED)
+                for w in range(nw):
+                    p = pend[w] if w < len(pend) else 0
+                    hit = _ALL_ONES if joined else p
+                    common[w] &= hit
+                    or_pending[w] |= p
+                    if w < len(inv):
+                        or_invalid[w] |= inv[w]
+            # Invalidated bits leave the intersection; any pending bit
+            # outside the final intersection means its rank requeues it
+            # into full negotiation, so the negotiation gather must run.
+            requeue = 0
+            for w in range(nw):
+                common[w] &= ~or_invalid[w] & _ALL_ONES
+                requeue |= or_pending[w] & ~common[w]
+            if requeue:
+                out_flags |= _FLAG_HAS_UNCACHED
+            verdict = self._pack_coord(out_flags, common, or_invalid)
+            self.transport.bcast_bytes(verdict)
+        else:
+            verdict = self.transport.bcast_bytes(None)
+        out_flags, common, or_invalid = self._unpack_coord(verdict)
+        return (out_flags, ResponseCache.vector_to_bits(common),
+                ResponseCache.vector_to_bits(or_invalid))
+
+    # ------------------------------------------------------------------
+    def _assign_channels(self, responses: List[Response]):
+        """Executor-channel assignment (coordinator side; the id rides
+        the Response wire message so every rank follows it). Under the
+        default "size" policy the highest channel is a latency lane:
+        small responses (<= HOROVOD_LATENCY_CHANNEL_BYTES) go there and
+        bulk responses round-robin over the remaining channels — a
+        blind round-robin would park every other small op behind a
+        streaming multi-MB collective and re-create the head-of-line
+        blocking the channels exist to remove. "rr" round-robins
+        everything (all inputs are negotiated, so either policy is
+        identical on every rank)."""
+        nchan = env_cfg.num_channels()
+        if nchan <= 1:
+            return
+        size_policy = env_cfg.channel_policy() == "size"
+        small = env_cfg.latency_channel_bytes()
+        bulk = nchan - 1 if size_policy else nchan
+        for resp in responses:
+            if resp.response_type not in _CHANNELED_TYPES:
+                continue
+            if size_policy and sum(
+                self._byte_size(resp, n) for n in resp.tensor_names
+            ) <= small:
+                resp.channel = nchan - 1
+                continue
+            if self._next_channel >= bulk:
+                self._next_channel = 0
+            resp.channel = self._next_channel
+            self._next_channel = (self._next_channel + 1) % bulk
 
     # ------------------------------------------------------------------
     def _telemetry_elapsed(self) -> float:

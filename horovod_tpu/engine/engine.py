@@ -13,9 +13,22 @@ HandleManager, horovod/torch/handle_manager.h).
 On TPU this engine serves the *eager* path (process mode). The traced
 path (ops/traced.py) needs none of it: under jit, XLA plays the role of
 the background thread, the fusion buffer and the response cache at once.
+
+Pipelined execution (docs/running.md "Pipelined execution"): the
+background loop no longer executes responses inline. Each non-fence
+response carries a coordinator-assigned channel id; the loop hands it to
+that channel's executor thread (per-channel FIFO — the cross-rank
+ordering invariant that keeps concurrent collectives from deadlocking)
+and immediately re-enters negotiation, so the control plane overlaps the
+data plane. JOIN/BARRIER/ERROR/shutdown and autotune parameter-sync are
+fences that drain every channel first; an executor HorovodInternalError
+kills the whole engine and finalizes every pending handle. Cycles are
+event-driven: an enqueue wakes the loop immediately, making
+HOROVOD_CYCLE_TIME a max-coalescing delay instead of a latency floor.
 """
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -81,11 +94,81 @@ class HandleManager:
         if ev is not None and not ev.wait(timeout):
             raise TimeoutError(f"handle {handle} did not complete")
         with self._lock:
+            if handle not in self._results:
+                # Never allocated (or already waited on): a clear error
+                # instead of the bare KeyError `_results.pop` used to
+                # throw from deep inside the manager.
+                raise ValueError(f"unknown handle {handle}")
             status, result = self._results.pop(handle)
             self._events.pop(handle, None)
         if not status.ok():
             raise HorovodInternalError(status.reason)
         return result
+
+
+# Fence response types: executed inline on the background thread after
+# every channel drains. JOIN resets controller join state, BARRIER is a
+# control-plane collective, ERROR must observe a settled engine so the
+# failure it reports is attributable.
+_FENCE_TYPES = frozenset((
+    ResponseType.JOIN,
+    ResponseType.BARRIER,
+    ResponseType.ERROR,
+))
+
+_EXEC_STOP = object()
+
+
+class _ChannelExecutor:
+    """Per-channel response executor: a worker thread draining a FIFO
+    queue. Every rank dispatches the same responses to the same channel
+    in the same order (the coordinator-assigned channel id rides the
+    Response wire message), so matching collectives always pair up
+    across ranks even with several channels in flight at once."""
+
+    def __init__(self, engine: "Engine", channel: int):
+        self.engine = engine
+        self.channel = channel
+        self.queue: "queue_mod.Queue" = queue_mod.Queue()
+        # Tensor names of the response being executed right now (surfaced
+        # by /status as the per-channel in-flight view).
+        self.current: Optional[List[str]] = None
+        self.gauge = engine.registry.gauge(
+            "horovod_executor_queue_depth",
+            "Responses queued on a channel executor",
+            labels={"channel": str(channel)})
+        self.gauge.set_function(self.depth)
+        self.thread = threading.Thread(
+            target=self._loop, name=f"hvd-exec-{channel}", daemon=True)
+        self.thread.start()
+
+    def depth(self) -> int:
+        return self.queue.qsize()
+
+    def _loop(self):
+        eng = self.engine
+        while True:
+            resp = self.queue.get()
+            if resp is _EXEC_STOP:
+                break
+            try:
+                # After a fatal error, drain without executing: the
+                # queued responses' entries are finalized by the dying
+                # background loop, and a broken mesh can't serve them.
+                if eng._fatal_error is None:
+                    self.current = list(resp.tensor_names)
+                    eng._perform_operation(resp)
+            except HorovodInternalError as exc:
+                # _perform_operation already failed THIS response's
+                # entries; latch the error so the background loop dies
+                # and finalizes every other pending handle on every
+                # channel.
+                eng._latch_fatal(exc)
+            except BaseException as exc:  # pragma: no cover - defensive
+                eng._latch_fatal(HorovodInternalError(str(exc)))
+            finally:
+                self.current = None
+                eng._response_done()
 
 
 class Engine:
@@ -155,6 +238,37 @@ class Engine:
         self._shutdown_requested = threading.Event()
         self._initialized = threading.Event()
         self._init_error: Optional[BaseException] = None
+        # -- pipelined execution state ---------------------------------
+        # Channel executors, created for the local HOROVOD_NUM_CHANNELS
+        # at loop start and lazily for any higher channel id the
+        # coordinator assigns (its env wins — the id rides the wire).
+        # Only the background thread creates/dispatches; other threads
+        # just snapshot the dict for /status.
+        self._executors: Dict[int, _ChannelExecutor] = {}
+        # Dispatched-but-unfinished responses across all channels; the
+        # condition gates the backpressure window and fence drains.
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._max_inflight = env_cfg.max_inflight_responses()
+        # First executor HorovodInternalError; latched once, kills the
+        # whole engine (read without the lock on hot paths — benign).
+        self._fatal_error: Optional[HorovodInternalError] = None
+        # Event-driven cycles: enqueues (and shutdown) set the event so
+        # HOROVOD_CYCLE_TIME is a max-coalescing delay, not a floor.
+        self._wake = threading.Event()
+        self._event_cycles = env_cfg.cycle_event_driven()
+        self.tensor_queue.set_wakeup(self._wake.set)
+        self._m_wake = {
+            reason: self.registry.counter(
+                "horovod_cycle_wakeups_total",
+                "Background-loop cycle starts by wake reason",
+                labels={"reason": reason})
+            for reason in ("enqueue", "timeout", "spin", "shutdown")
+        }
+        self.registry.gauge(
+            "horovod_inflight_responses",
+            "Responses dispatched to channel executors and not yet done",
+        ).set_function(lambda: self._inflight)
         self._op_counter: Dict[str, int] = {}
         self._counter_lock = threading.Lock()
         # Cycles that carried at least one negotiated response — the
@@ -162,11 +276,11 @@ class Engine:
         # of requests take" (a fused batch costs ~1; a serialized stream
         # of N requests costs N). Bindings' fusion tests assert on it.
         self.response_cycles = 0
-        # Persistent fusion buffer, one per dtype, grown to the largest
-        # fused payload seen (ref: FusionBufferManager's per-device
-        # persistent buffer, fusion_buffer_manager.h:30-56). Only the
-        # background thread touches it.
-        self._fusion_storage: Dict[str, np.ndarray] = {}
+        # Persistent fusion buffer, one per (channel, dtype), grown to
+        # the largest fused payload seen (ref: FusionBufferManager's
+        # per-device persistent buffer, fusion_buffer_manager.h:30-56).
+        # Each channel executor touches only its own keys.
+        self._fusion_storage: Dict[Tuple[int, str], np.ndarray] = {}
 
     # ------------------------------------------------------------------
     def tensor_queue_depth(self) -> int:
@@ -217,7 +331,18 @@ class Engine:
             "pending_tensors": self.tensor_queue.pending_names(),
             "last_cycle_age_seconds": self._last_cycle_age(),
             "response_cycles": self.response_cycles,
+            "inflight_responses": self._inflight,
         }
+        channels = {}
+        # list() snapshot: the background thread may lazily insert an
+        # executor while an exporter thread renders /status.
+        for ch, ex in sorted(list(self._executors.items())):
+            cur = ex.current  # snapshot: executor may finish mid-read
+            channels[str(ch)] = {
+                "queue_depth": ex.depth(),
+                "executing": list(cur) if cur else [],
+            }
+        st["channels"] = channels
         ctrl = self.controller
         if ctrl is not None and ctrl.is_coordinator:
             now = time.monotonic()
@@ -323,6 +448,11 @@ class Engine:
             from .operation_manager import build_default
 
             self.op_manager = build_default(self.backend)
+            # Channel executors for the locally configured width; any
+            # higher channel id the coordinator assigns is created
+            # lazily at first dispatch.
+            for ch in range(env_cfg.num_channels()):
+                self._executor_for(ch)
             while self._run_loop_once():
                 pass
         except HorovodInternalError as e:
@@ -338,14 +468,94 @@ class Engine:
             logger.error("background loop failed: %s", e)
             self.tensor_queue.finalize(Status.UnknownError(str(e)))
         finally:
-            self.timeline.shutdown()
+            # Stop order matters: queue the stop sentinels, then shut the
+            # backend (severing sockets unblocks any executor parked in a
+            # recv — its op fails with TransportError and its entries are
+            # finished by the executor's own error path), then join.
+            for ex in list(self._executors.values()):
+                ex.queue.put(_EXEC_STOP)
             if self.backend is not None:
                 self.backend.shutdown()
+            for ex in list(self._executors.values()):
+                ex.thread.join(timeout=10)
+                ex.gauge.clear_function(ex.depth)
+                if ex.thread.is_alive():  # pragma: no cover - wedged op
+                    logger.warning(
+                        "channel %d executor did not exit cleanly",
+                        ex.channel)
+            self.timeline.shutdown()
+
+    # ------------------------------------------------------------------
+    # pipelined-execution plumbing
+    def _executor_for(self, channel: int) -> _ChannelExecutor:
+        ex = self._executors.get(channel)
+        if ex is None:
+            ex = self._executors[channel] = _ChannelExecutor(self, channel)
+        return ex
+
+    def _latch_fatal(self, exc: HorovodInternalError):
+        with self._inflight_cond:
+            if self._fatal_error is None:
+                self._fatal_error = exc
+            self._inflight_cond.notify_all()
+        self._wake.set()
+
+    def _check_fatal(self):
+        if self._fatal_error is not None:
+            raise self._fatal_error
+
+    def _response_done(self):
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    def _dispatch(self, resp: Response):
+        """Hand a response to its channel executor, blocking while the
+        in-flight window is full (backpressure: negotiation must not
+        race arbitrarily far ahead of execution)."""
+        ex = self._executor_for(resp.channel)
+        with self._inflight_cond:
+            while (self._inflight >= self._max_inflight
+                   and self._fatal_error is None):
+                self._inflight_cond.wait(0.1)
+            # On a fatal error the window opens unconditionally: the
+            # executor discards the response and the dying loop's
+            # finalize fails its entries, so accounting stays straight.
+            self._inflight += 1
+        ex.queue.put(resp)
+
+    def _drain_channels(self):
+        """Fence: wait until every dispatched response on every channel
+        has finished (or the engine died trying)."""
+        with self._inflight_cond:
+            while self._inflight > 0 and self._fatal_error is None:
+                self._inflight_cond.wait(0.1)
+        self._check_fatal()
+
+    def _cycle_wait(self) -> str:
+        """Coalescing wait before a cycle; returns the wake reason."""
+        if self._shutdown_requested.is_set():
+            return "shutdown"
+        if self.cycle_time_s <= 0:
+            return "spin"
+        if not self._event_cycles:
+            # Fixed-sleep baseline (HOROVOD_CYCLE_EVENT_DRIVEN=0): the
+            # pre-pipelining schedule, kept for A/B latency measurement.
+            time.sleep(self.cycle_time_s)
+            return "timeout"
+        woke = self._wake.wait(self.cycle_time_s)
+        # Clear BEFORE popping messages: an enqueue landing after the
+        # pop re-sets it, so the next cycle wakes immediately; one
+        # landing in between is popped now and costs one spurious wake.
+        self._wake.clear()
+        return "enqueue" if woke else "timeout"
 
     # ------------------------------------------------------------------
     def _run_loop_once(self) -> bool:
         """(ref: RunLoopOnce, operations.cc:566-616)"""
-        time.sleep(self.cycle_time_s)
+        reason = self._cycle_wait()
+        self._m_wake[reason].inc()
+        self._check_fatal()
         cycle_t0 = time.monotonic()
         self.timeline.mark_cycle()
         messages = self.tensor_queue.pop_messages_from_queue()
@@ -371,6 +581,13 @@ class Engine:
                 for n in resp.tensor_names
             )
             if self.param_manager.update(nbytes):
+                # Parameter-sync fence: every rank reaches this point at
+                # the same response-cycle count and drains its channels
+                # before the sync, so categorical toggles (hierarchical,
+                # cache) can never flip under an op still in flight on
+                # one rank but not another — that divergence would pick
+                # mismatched data-plane algorithms and deadlock.
+                self._drain_channels()
                 payload = self.controller.synchronize_parameters(
                     self.param_manager.serialize()
                 )
@@ -388,13 +605,24 @@ class Engine:
                     self._hier_valid and self.param_manager.hierarchical
                 )
         for resp in resp_list.responses:
-            self._perform_operation(resp)
-        # Cycle work duration (sleep excluded) + liveness stamp: the
+            if resp.response_type in _FENCE_TYPES:
+                # Fences preserve program order relative to the response
+                # stream: everything dispatched before them finishes
+                # first, and they run inline so nothing overlaps them.
+                self._drain_channels()
+                self._perform_operation(resp)
+            else:
+                self._dispatch(resp)
+        # Cycle work duration (waits excluded) + liveness stamp: the
         # last-cycle age gauge is how /status distinguishes "idle" from
         # "background loop wedged".
         self._last_cycle_ts = time.monotonic()
         self._m_cycle.observe(self._last_cycle_ts - cycle_t0)
         if should_shutdown:
+            # Shutdown is a fence too: in-flight collectives complete
+            # (every rank agreed to shut down, so their peers are still
+            # executing them) before pending handles are finalized.
+            self._drain_channels()
             # A stall-inspector abort rides the shutdown broadcast as a
             # tensor-less ERROR response; its diagnosis becomes the
             # failure reason every pending handle sees (on every rank,
@@ -410,7 +638,19 @@ class Engine:
 
     # ------------------------------------------------------------------
     def _perform_operation(self, resp: Response):
-        """(ref: PerformOperation, operations.cc:253-330)"""
+        """(ref: PerformOperation, operations.cc:253-330). Runs on a
+        channel executor thread for non-fence responses — inside the
+        response's channel scope, so every data-plane frame it moves is
+        tagged with the channel and demultiplexes cleanly from
+        concurrent collectives — and inline on the background thread
+        for fences (control-plane tagged)."""
+        scope = getattr(self.backend, "channel_scope", None)
+        if scope is None or resp.response_type in _FENCE_TYPES:
+            return self._execute_response(resp)
+        with scope(resp.channel):
+            return self._execute_response(resp)
+
+    def _execute_response(self, resp: Response):
         entries = self.tensor_queue.get_tensor_entries(resp.tensor_names)
         if resp.response_type != ResponseType.ERROR:
             self._record_response(
@@ -539,7 +779,7 @@ class Engine:
             # the C++ core is built).
             with self.timeline.activity(name0, MEMCPY_IN_FUSION_BUFFER):
                 shapes = [e.tensor.shape for e in entries]
-                buf, owned = self._pack_fusion(entries)
+                buf, owned = self._pack_fusion(entries, resp.channel)
         if pre != 1.0:
             buf = _scale_np(buf, pre)
             owned = True
@@ -570,15 +810,17 @@ class Engine:
                     off += n
 
     def _pack_fusion(
-        self, entries: List[TensorTableEntry]
+        self, entries: List[TensorTableEntry], channel: int = 0
     ) -> Tuple[np.ndarray, bool]:
         """Copy entries into a fusion buffer; returns (buf, owned).
         The native threaded memcpy packs into a FRESH buffer every
         cycle (owned=True: the data plane may reduce it in place and
         results may alias it); the pure-python fallback packs into the
-        persistent per-dtype storage reused across cycles (owned=False
-        — in-place reduction there would let next cycle's pack corrupt
-        results still aliased by callers)."""
+        persistent per-(channel, dtype) storage reused across cycles
+        (owned=False — in-place reduction there would let next cycle's
+        pack corrupt results still aliased by callers). Keyed by channel
+        because executors pack concurrently; within a channel execution
+        is serial, so the reuse stays race-free."""
         from ..cc import native
 
         dtype = entries[0].tensor.dtype
@@ -586,7 +828,7 @@ class Engine:
         packed = native.pack([e.tensor for e in entries])
         if packed is not None:
             return packed.view(dtype)[:total], True
-        key = dtype.str
+        key = (channel, dtype.str)
         storage = self._fusion_storage.get(key)
         if storage is None or storage.size < total:
             storage = np.empty(max(total, 1), dtype)
@@ -732,6 +974,7 @@ class Engine:
         if self._thread is None:
             return
         self._shutdown_requested.set()
+        self._wake.set()  # end any coalescing wait immediately
         self._thread.join(timeout=60)
         self._thread = None
         for exp in self._exporters:
@@ -746,3 +989,4 @@ class Engine:
         # state as live after an elastic shutdown+init cycle.
         self.registry.gauge("horovod_tensor_queue_depth").clear_function()
         self.registry.gauge("horovod_last_cycle_age_seconds").clear_function()
+        self.registry.gauge("horovod_inflight_responses").clear_function()

@@ -49,6 +49,10 @@ class TensorQueue:
         self._lock = threading.Lock()
         self._tensor_table: Dict[str, TensorTableEntry] = {}
         self._message_queue: List[Request] = []
+        # Event-driven cycles: the engine registers its wake event here
+        # so an enqueue ends the background loop's coalescing wait
+        # immediately instead of paying the full HOROVOD_CYCLE_TIME.
+        self._wakeup: Optional[Callable[[], None]] = None
         # Set by finalize(): the engine died (transport failure, stall
         # abort, shutdown). Enqueues after that point fail IMMEDIATELY
         # with the terminal status instead of parking an entry no
@@ -56,6 +60,9 @@ class TensorQueue:
         # collective after a worker death hangs forever even though the
         # failure was already detected.
         self._final_status: Optional[Status] = None
+
+    def set_wakeup(self, fn: Optional[Callable[[], None]]):
+        self._wakeup = fn
 
     def add_to_tensor_queue(self, entry: TensorTableEntry, request: Request) -> Status:
         with self._lock:
@@ -66,7 +73,12 @@ class TensorQueue:
                 return Status.InvalidArgument(DUPLICATE_NAME_ERROR)
             self._tensor_table[entry.tensor_name] = entry
             self._message_queue.append(request)
-            return Status.OK()
+        # Outside the lock: the wake target (an Event.set) never blocks,
+        # but keeping callbacks out of the critical section is free.
+        wake = self._wakeup
+        if wake is not None:
+            wake()
+        return Status.OK()
 
     def pop_messages_from_queue(self) -> List[Request]:
         with self._lock:

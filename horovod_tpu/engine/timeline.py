@@ -42,7 +42,11 @@ class Timeline:
         self.enabled = bool(self.filename)
         self.mark_cycles = env_cfg.get_bool(env_cfg.TIMELINE_MARK_CYCLES, False)
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        # Multi-writer: the background loop (negotiation phases) and the
+        # channel executors (op phases) emit concurrently; lane-id
+        # allocation is the only read-modify-write and takes the lock.
         self._tids: Dict[str, int] = {}
+        self._tid_lock = threading.Lock()
         self._writer: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._t0 = time.monotonic_ns()
@@ -63,9 +67,11 @@ class Timeline:
         return (time.monotonic_ns() - self._t0) / 1e3  # microseconds
 
     def _tid(self, tensor_name: str) -> int:
-        if tensor_name not in self._tids:
-            self._tids[tensor_name] = len(self._tids) + 1
-        return self._tids[tensor_name]
+        with self._tid_lock:
+            tid = self._tids.get(tensor_name)
+            if tid is None:
+                tid = self._tids[tensor_name] = len(self._tids) + 1
+            return tid
 
     def _emit(self, ev: dict):
         if not self.enabled:

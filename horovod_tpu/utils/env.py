@@ -59,6 +59,38 @@ CONNECT_ATTEMPTS = "HOROVOD_CONNECT_ATTEMPTS"
 CONNECT_BACKOFF = "HOROVOD_CONNECT_BACKOFF_SECONDS"
 CONNECT_BACKOFF_CAP = "HOROVOD_CONNECT_BACKOFF_CAP_SECONDS"
 
+# -- pipelined execution knobs (docs/running.md) -----------------------
+# Number of concurrent executor channels the coordinator round-robins
+# non-fence responses over. Each rank executes a channel's responses in
+# FIFO order on a dedicated worker thread, so independent collectives
+# overlap while same-channel ordering (the anti-deadlock invariant)
+# holds. 1 = fully serial execution (still overlapped with negotiation).
+# Only the coordinator's value matters for assignment — workers follow
+# the channel id carried in the Response wire message.
+NUM_CHANNELS = "HOROVOD_NUM_CHANNELS"
+# Backpressure bound: at most this many responses may be dispatched-but-
+# unfinished across all channels before the background loop stops
+# handing out more (and thus stops pulling new negotiation rounds).
+MAX_INFLIGHT = "HOROVOD_MAX_INFLIGHT_RESPONSES"
+# Channel assignment policy: "size" (default) reserves the highest
+# channel as a latency lane for small responses (<= LATENCY_CHANNEL
+# bytes) and round-robins bulk responses over the rest, so a metrics/
+# loss scalar is never head-of-line blocked behind a streaming gradient
+# (the multi-stream split Horovod and PyTorch DDP both converge on);
+# "rr" round-robins everything blindly.
+CHANNEL_POLICY = "HOROVOD_CHANNEL_POLICY"
+LATENCY_CHANNEL_BYTES = "HOROVOD_LATENCY_CHANNEL_BYTES"
+# Event-driven cycles: 1 (default) replaces the unconditional cycle
+# sleep with a wait that wakes the moment a tensor is enqueued, turning
+# HOROVOD_CYCLE_TIME into a max-coalescing delay instead of a latency
+# floor. 0 restores the fixed-sleep schedule (the pre-pipelining
+# baseline, kept for A/B latency measurement).
+CYCLE_EVENT = "HOROVOD_CYCLE_EVENT_DRIVEN"
+
+DEFAULT_NUM_CHANNELS = 2
+MAX_CHANNELS = 16
+DEFAULT_LATENCY_CHANNEL_BYTES = 65536
+
 # -- telemetry knobs (docs/metrics.md) ---------------------------------
 # Serve Prometheus text at /metrics and live job state at /status from a
 # daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
@@ -149,6 +181,38 @@ def tcp_poll_seconds() -> float:
         # recv() could overshoot it.
         poll = min(poll, max(timeout / 4.0, 0.01))
     return max(poll, 0.01)
+
+
+def num_channels() -> int:
+    """Executor channels the coordinator round-robins responses over;
+    clamped to [1, MAX_CHANNELS] (channel ids must stay below the
+    reserved control-plane tag). Read per negotiation cycle on the
+    coordinator so benchmarks can flip it between timed loops."""
+    n = get_int(NUM_CHANNELS, DEFAULT_NUM_CHANNELS)
+    return max(1, min(n, MAX_CHANNELS))
+
+
+def max_inflight_responses() -> int:
+    """Dispatched-but-unfinished response bound (backpressure window);
+    defaults to 2 per channel. Always >= 1 or nothing would ever run."""
+    return max(get_int(MAX_INFLIGHT, 2 * num_channels()), 1)
+
+
+def channel_policy() -> str:
+    """"size" (default) or "rr" — see CHANNEL_POLICY above. Coordinator-
+    side only, like num_channels."""
+    v = get_str(CHANNEL_POLICY, "size").lower()
+    return v if v in ("size", "rr") else "size"
+
+
+def latency_channel_bytes() -> int:
+    """Responses at or below this byte count ride the latency lane
+    under the size policy."""
+    return get_int(LATENCY_CHANNEL_BYTES, DEFAULT_LATENCY_CHANNEL_BYTES)
+
+
+def cycle_event_driven() -> bool:
+    return get_bool(CYCLE_EVENT, True)
 
 
 def metrics_sync_seconds() -> float:

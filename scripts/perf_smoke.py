@@ -17,7 +17,11 @@ What it pins down:
 * the new transport counters moved: `horovod_tcp_sendmsg_frames_total`
   > 0 on every rank and `horovod_ring_segments_total` > 0 wherever a
   ring schedule ran (and the segmented run produced strictly more
-  segments than chunks).
+  segments than chunks);
+* a 2-channel pipelined window (ring bigs on the bulk lane, star
+  smalls on the latency lane, fusion off) still accounts every byte
+  exactly and moves frames on channel tags 0, 1 and ctrl
+  (`horovod_tcp_channel_frames_total`).
 
 Run by scripts/ci.sh; also a manual repro tool:
 
@@ -64,12 +68,47 @@ def worker():
         seg_counts[name] = (hvd.metrics()["metrics"].get(
             "horovod_ring_segments_total", 0) - before)
 
+    # 2-channel pipelined run: an async window of ring bigs (bulk lane)
+    # + star smalls (latency lane), fusion off so every op is its own
+    # response. Byte accounting must stay EXACT with two channels in
+    # flight, and the channel-tagged frame counters must show traffic on
+    # both data lanes plus the control lane.
+    from horovod_tpu.common import basics
+
+    eng = basics.engine()
+    prev_fusion = eng.controller.fusion_threshold
+    eng.controller.fusion_threshold = 1
+    os.environ.update({"HOROVOD_RING_THRESHOLD": "0",
+                       "HOROVOD_RING_SEGMENT_BYTES": str(1 << 16),
+                       "HOROVOD_NUM_CHANNELS": "2"})
+    handles = []
+    for i in range(ITERS):
+        big = np.full(COUNT, float(hvd.rank() + 1), np.float32)
+        small = np.full(1024, float(hvd.rank() + 1), np.float32)
+        handles.append((eng.enqueue_allreduce(big, name=f"pc.big.{i}"),
+                        COUNT, big.nbytes))
+        handles.append((eng.enqueue_allreduce(small, name=f"pc.small.{i}"),
+                        1024, small.nbytes))
+        expect_bytes += big.nbytes + small.nbytes
+    for h, count, _ in handles:
+        out = np.asarray(eng.synchronize(h, timeout=120))
+        assert out.shape == (count,), out.shape
+        assert float(out[0]) == sum(range(1, n + 1)), out[0]
+    hvd.barrier()
+    eng.controller.fusion_threshold = prev_fusion
+
     snap = hvd.metrics()["metrics"]
     got = snap["horovod_allreduce_bytes_total"]
     assert got == expect_bytes, (
         f"allreduce_bytes_total accounting drifted: got {got}, "
         f"expected exactly {expect_bytes}")
     assert snap.get("horovod_tcp_sendmsg_frames_total", 0) > 0, snap
+    # Channel-tag counters: bulk lane 0 (ring bigs), latency lane 1
+    # (star smalls), and the control plane all moved frames.
+    for label in ("0", "1", "ctrl"):
+        key = f'horovod_tcp_channel_frames_total{{channel="{label}"}}'
+        assert snap.get(key, 0) > 0, (label, sorted(
+            k for k in snap if "channel_frames" in k))
     # Ring chunks: n per allreduce move as >=1 segment each on the send
     # side; the 64KB-segment run must split chunks further.
     assert seg_counts["star"] == 0, seg_counts

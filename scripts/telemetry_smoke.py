@@ -62,6 +62,12 @@ def worker():
         status = json.loads(conn.getresponse().read())
         assert status["rank"] == 0 and status["size"] == 2, status
         assert "fleet" in status, status
+        # Pipelined-execution view: per-channel executor state + the
+        # in-flight total the backpressure window bounds.
+        assert "inflight_responses" in status, status
+        assert status["channels"], status
+        for ch in status["channels"].values():
+            assert "queue_depth" in ch and "executing" in ch, status
         checks["status_ranks"] = sorted(int(r) for r in
                                         status["fleet"]["ranks"])
     hvd.shutdown()

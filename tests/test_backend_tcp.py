@@ -160,9 +160,125 @@ def test_sendmsg_frames_and_bytes_counters(monkeypatch):
         b1.recv_from(0)
         assert reg.counter(
             "horovod_tcp_sendmsg_frames_total").value == frames0 + 1
-        # exact accounting: payload + 8-byte length header
+        # exact accounting: payload + length+channel header
+        from horovod_tpu.backend.tcp import _HDR_LEN
+
         assert reg.counter(
-            "horovod_tcp_bytes_sent_total").value == sent0 + payload.nbytes + 8
+            "horovod_tcp_bytes_sent_total").value == (
+                sent0 + payload.nbytes + _HDR_LEN)
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# channel-tagged frames + per-peer receive demultiplexer
+def test_channel_demux_routes_interleaved_frames(monkeypatch):
+    """Frames for two channels interleaved on one socket must reach the
+    right recv calls with intra-channel order preserved — the invariant
+    that lets two in-flight collectives share a peer socket."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_demux", monkeypatch)
+    try:
+        tickets = [
+            b0.send_async(1, b"ch0-first", channel=0),
+            b0.send_async(1, b"ch1-first", channel=1),
+            b0.send_async(1, b"ch0-second", channel=0),
+            b0.send_async(1, b"ch1-second", channel=1),
+        ]
+        # Receive channel 1 first: the demux must read past (and park)
+        # the channel-0 frames without consuming them.
+        with b1.channel_scope(1):
+            assert bytes(b1.recv_from(0)) == b"ch1-first"
+            assert bytes(b1.recv_from(0)) == b"ch1-second"
+        with b1.channel_scope(0):
+            assert bytes(b1.recv_from(0)) == b"ch0-first"
+            assert bytes(b1.recv_from(0)) == b"ch0-second"
+        for t in tickets:
+            t.wait()
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_channel_demux_concurrent_recvs(monkeypatch):
+    """Two threads blocked on different channels of the same peer: each
+    gets its own payload regardless of arrival order."""
+    import threading
+
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_demux_threads", monkeypatch)
+    try:
+        got = {}
+
+        def recv(ch):
+            with b1.channel_scope(ch):
+                got[ch] = bytes(b1.recv_from(0))
+
+        ts = [threading.Thread(target=recv, args=(c,)) for c in (0, 1)]
+        for t in ts:
+            t.start()
+        import time
+
+        time.sleep(0.1)  # both receivers parked before anything arrives
+        b0.send_async(1, b"one", channel=1).wait()
+        b0.send_async(1, b"zero", channel=0).wait()
+        for t in ts:
+            t.join(timeout=30)
+        assert got == {0: b"zero", 1: b"one"}
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_channel_recv_into_from_cross_channel_deposit(monkeypatch):
+    """recv_into on channel 0 that encounters a channel-1 frame first
+    parks it for channel 1 and still lands its own payload (one copy on
+    the deposited path, zero on its own)."""
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_demux_into", monkeypatch)
+    try:
+        other = np.arange(64, dtype=np.float32)
+        mine = np.arange(128, dtype=np.float64)
+        b0.send_async(1, other, channel=1).wait()
+        b0.send_async(1, mine, channel=0).wait()
+        dst = np.zeros(128, np.float64)
+        with b1.channel_scope(0):
+            assert b1.recv_into_from(0, dst) == mine.nbytes
+        np.testing.assert_array_equal(dst, mine)
+        dst1 = np.zeros(64, np.float32)
+        with b1.channel_scope(1):
+            assert b1.recv_into_from(0, dst1) == other.nbytes
+        np.testing.assert_array_equal(dst1, other)
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+        server.stop()
+
+
+def test_channel_frame_counters(monkeypatch):
+    from horovod_tpu.common import telemetry
+
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    server, (b0, b1) = _pair("t_chan_counters", monkeypatch)
+    try:
+        reg = telemetry.default_registry()
+
+        def val(label):
+            return reg.counter("horovod_tcp_channel_frames_total",
+                               labels={"channel": label}).value
+
+        c0, cc = val("0"), val("ctrl")
+        b0.send_async(1, b"data", channel=0).wait()
+        with b1.channel_scope(0):
+            b1.recv_from(0)
+        b0.send_to(1, b"ctrl-plane")  # no scope -> control channel
+        b1.recv_from(0)
+        assert val("0") == c0 + 1
+        assert val("ctrl") == cc + 1
     finally:
         b0.shutdown()
         b1.shutdown()

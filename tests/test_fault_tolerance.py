@@ -599,6 +599,180 @@ def test_drop_on_pipelined_send_hangs_peer_into_timeout(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# fault injection x the pipelined executor path: a transport death on one
+# channel while another channel is mid-collective must fail EVERY pending
+# handle on EVERY channel with the transport reason, kill the executors,
+# and leave no thread hung (ISSUE 4 satellite).
+def _tcp_engines(scope, monkeypatch, nranks=2):
+    """Two real Engines over a TCP mesh in one process (the executor
+    pool + channel-tagged data plane end to end)."""
+    from horovod_tpu.engine.engine import Engine
+
+    server, backends = _tcp_pair(scope, monkeypatch)
+    engines = [Engine(rank=r, size=nranks, backend=backends[r])
+               for r in range(nranks)]
+    for e in engines:
+        e.cycle_time_s = 0.001
+    start_errs = []
+
+    def _start(e):
+        try:
+            e.start()
+        except BaseException as exc:  # pragma: no cover - init bug
+            start_errs.append(exc)
+
+    ts = [threading.Thread(target=_start, args=(e,)) for e in engines]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not start_errs, start_errs
+    return server, engines
+
+
+def _run_pipelined_workload(engines, count=1 << 14, ops=2):
+    """Each rank enqueues `ops` allreduces (one response per op with
+    fusion disabled -> round-robin over both channels), then waits.
+    Returns per-rank lists of results-or-exceptions."""
+    out = [[None] * ops for _ in engines]
+
+    def w(i, eng):
+        handles = [
+            eng.enqueue_allreduce(
+                np.full(count, float(i + 1), np.float32), name=f"c{k}")
+            for k in range(ops)
+        ]
+        for k, h in enumerate(handles):
+            try:
+                out[i][k] = eng.synchronize(h, timeout=60)
+            except BaseException as e:  # noqa: BLE001
+                out[i][k] = e
+    ts = [threading.Thread(target=w, args=(i, e))
+          for i, e in enumerate(engines)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    return out
+
+
+def _shutdown_engines(engines):
+    ts = [threading.Thread(target=e.shutdown) for e in engines]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+
+
+def test_sever_on_one_channel_fails_every_channel(monkeypatch):
+    """Sever mid-stream with two channels in flight: all pending handles
+    on both ranks fail with the transport reason, post-death enqueues
+    fail fast, and the executor threads exit — no hang."""
+    monkeypatch.setenv("HOROVOD_CHANNEL_POLICY", "rr")
+    monkeypatch.setenv("HOROVOD_NUM_CHANNELS", "2")
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1")
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "5")
+    server, engines = _tcp_engines("t_exec_sever", monkeypatch)
+    try:
+        # The sever lands partway into the segmented data stream (the
+        # delay keeps rank 1's contributions slow enough that both
+        # channels are still mid-collective when it fires).
+        fault_injection.injector.install([
+            Rule(action="delay", rank=1, peer=0, op="send", secs=0.02),
+            Rule(action="sever", rank=0, peer=1, op="send", after=15),
+        ])
+        out = _run_pipelined_workload(engines)
+        # Every handle either completed BEFORE the fault landed or
+        # failed with the transport reason — never a hang (None /
+        # TimeoutError), and the fault must have hit someone.
+        failures = 0
+        for r, per_rank in enumerate(out):
+            for k, res in enumerate(per_rank):
+                assert res is not None, (r, k, "synchronize hung")
+                assert not isinstance(res, TimeoutError), (r, k, res)
+                if isinstance(res, HorovodInternalError):
+                    failures += 1
+                    assert ("peer" in str(res) or "severed" in str(res)
+                            or "shut down" in str(res)), (r, k, res)
+                else:
+                    assert isinstance(res, np.ndarray), (r, k, res)
+        assert failures > 0, out
+        # Terminal status latched: a post-death enqueue fails immediately.
+        h = engines[0].enqueue_allreduce(
+            np.ones(8, np.float32), name="after_death")
+        with pytest.raises(HorovodInternalError):
+            engines[0].synchronize(h, timeout=30)
+    finally:
+        fault_injection.injector.clear()
+        _shutdown_engines(engines)
+        server.stop()
+    for eng in engines:
+        for ex in eng._executors.values():
+            assert not ex.thread.is_alive(), (
+                f"rank {eng.rank} channel {ex.channel} executor leaked")
+
+
+def test_timeout_on_one_channel_fails_every_channel(monkeypatch):
+    """A dropped segment starves one channel's recv into the bounded
+    timeout; the resulting TransportError must still take down every
+    channel's pending handles on both ranks within the bound."""
+    monkeypatch.setenv("HOROVOD_CHANNEL_POLICY", "rr")
+    monkeypatch.setenv("HOROVOD_NUM_CHANNELS", "2")
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1")
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "1")
+    server, engines = _tcp_engines("t_exec_drop", monkeypatch)
+    try:
+        fault_injection.injector.install([
+            Rule(action="drop", rank=0, peer=1, op="send", after=15),
+        ])
+        t0 = time.monotonic()
+        out = _run_pipelined_workload(engines)
+        assert time.monotonic() - t0 < 60, "not bounded"
+        failures = 0
+        for r, per_rank in enumerate(out):
+            for k, res in enumerate(per_rank):
+                assert res is not None, (r, k, "synchronize hung")
+                assert not isinstance(res, TimeoutError), (r, k, res)
+                if isinstance(res, HorovodInternalError):
+                    failures += 1
+                else:
+                    assert isinstance(res, np.ndarray), (r, k, res)
+        assert failures > 0, out
+    finally:
+        fault_injection.injector.clear()
+        _shutdown_engines(engines)
+        server.stop()
+    for eng in engines:
+        for ex in eng._executors.values():
+            assert not ex.thread.is_alive()
+
+
+def test_pipelined_engines_healthy_path_correctness(monkeypatch):
+    """Control experiment for the two tests above: the same 2-channel
+    TCP engine pair with no fault injected completes correctly."""
+    monkeypatch.setenv("HOROVOD_CHANNEL_POLICY", "rr")
+    monkeypatch.setenv("HOROVOD_NUM_CHANNELS", "2")
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1")
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "30")
+    server, engines = _tcp_engines("t_exec_ok", monkeypatch)
+    try:
+        out = _run_pipelined_workload(engines, ops=4)
+        for per_rank in out:
+            for res in per_rank:
+                assert isinstance(res, np.ndarray), res
+                np.testing.assert_allclose(res[:4], np.full(4, 3.0))
+    finally:
+        _shutdown_engines(engines)
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # chaos: kill 1 of 4 real workers mid-step (the acceptance scenario)
 _CHAOS_WORKER = textwrap.dedent("""
     import os, sys

@@ -57,6 +57,22 @@ def test_response_roundtrip():
     assert r2 == resp
 
 
+def test_response_channel_rides_the_wire():
+    """The executor-channel id must survive serialization — workers
+    follow the coordinator's assignment through it."""
+    resp = Response(
+        response_type=ResponseType.ALLREDUCE,
+        tensor_names=["t"],
+        tensor_shapes=[(2, 3)],
+        channel=3,
+    )
+    r2, _ = Response.deserialize(resp.serialize())
+    assert r2.channel == 3
+    assert r2 == resp
+    # default stays 0 (fences, pre-channel payloads)
+    assert Response.deserialize(Response().serialize())[0].channel == 0
+
+
 def test_response_list_roundtrip():
     rl = ResponseList([Response(tensor_names=["x"]), Response(tensor_names=["y"])])
     rl2 = ResponseList.deserialize(rl.serialize())
