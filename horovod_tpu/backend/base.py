@@ -29,6 +29,12 @@ from ..engine.controller import ControllerTransport
 # 0..MAX_CHANNELS-1 and can never collide with it.
 CTRL_CHANNEL = 0xFF
 
+# Reserved frame tag for the liveness plane (common/health.py):
+# heartbeat/ack frames ride the existing peer sockets but are consumed
+# by whichever thread happens to be reading — they are never deposited
+# into a demux inbox, never awaited, and never block a collective.
+HEALTH_CHANNEL = 0xFE
+
 # The active executor channel is thread-scoped, not call-threaded: one
 # thread runs one response at a time, so a thread-local avoids plumbing
 # a channel argument through every collective signature (engine op

@@ -53,12 +53,16 @@ def _current_epoch() -> Optional[int]:
     return None
 
 
-def refresh_topology_from_rendezvous(timeout: float = 600.0):
+def refresh_topology_from_rendezvous(timeout: Optional[float] = None):
     """Update HOROVOD_RANK/SIZE/... env from the driver's next epoch
     assignment (ref: gloo_context.cc:157-200; epoch protocol documented
     in runner/elastic/driver.py). Announces readiness, waits for an epoch
     newer than the one this worker was last in, then reads its row; an
-    INVALID row (rank -1) means this worker lost its slot and exits."""
+    INVALID row (rank -1) means this worker lost its slot and exits.
+    The wait is bounded by HOROVOD_ELASTIC_RESET_TIMEOUT (default 600s)
+    unless an explicit `timeout` is passed."""
+    if timeout is None:
+        timeout = env_cfg.elastic_reset_timeout()
     rdv = _rendezvous()
     if rdv is None:
         return
@@ -121,6 +125,9 @@ class WorkerNotificationManager:
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._initialized = False
+        self._stop = threading.Event()
+        self._server_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
 
     def init(self):
         with self._lock:
@@ -130,11 +137,13 @@ class WorkerNotificationManager:
             if rdv is None or not env_cfg.get_bool(env_cfg.ELASTIC, False):
                 self._initialized = True
                 return
+            self._stop = threading.Event()
             self._httpd = ThreadingHTTPServer(("0.0.0.0", 0), _NotifyHandler)
             self._httpd.manager = self  # type: ignore
             t = threading.Thread(target=self._httpd.serve_forever,
                                  name="hvd-notify", daemon=True)
             t.start()
+            self._server_thread = t
             port = self._httpd.server_address[1]
             # Register by stable spawn identity (ranks change per epoch).
             hostname = env_cfg.get_str(env_cfg.HOSTNAME, "localhost")
@@ -152,16 +161,39 @@ class WorkerNotificationManager:
             # changes). The epoch watcher guarantees delivery: any
             # epoch newer than the one this worker is meshed into
             # synthesizes the same notification at the next poll.
-            tw = threading.Thread(target=self._epoch_watch, args=(rdv,),
+            tw = threading.Thread(target=self._epoch_watch,
+                                  args=(rdv, self._stop),
                                   name="hvd-epoch-watch", daemon=True)
             tw.start()
+            self._watch_thread = tw
             self._initialized = True
 
-    def _epoch_watch(self, rdv: RendezvousClient):
+    def shutdown(self):
+        """Stop the notify HTTP server and the epoch-watch thread
+        (wired into basics.shutdown()): without this they survive —
+        and accumulate across — init/shutdown cycles, each leaked
+        server still registered in the rendezvous KV. Listeners are
+        kept: the elastic run loop re-inits the manager after a reset
+        and its State must stay subscribed."""
+        with self._lock:
+            if not self._initialized:
+                return
+            self._stop.set()
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+                self._httpd = None
+            server_t, watch_t = self._server_thread, self._watch_thread
+            self._server_thread = self._watch_thread = None
+            self._initialized = False
+        for t in (server_t, watch_t):
+            if t is not None:
+                t.join(timeout=10)
+
+    def _epoch_watch(self, rdv: RendezvousClient, stop: threading.Event):
         interval = env_cfg.get_float("HOROVOD_ELASTIC_EPOCH_POLL", 0.5)
         notified_epoch: Optional[int] = None
-        while True:
-            time.sleep(interval)
+        while not stop.wait(interval):
             try:
                 raw = rdv.get("meta", "epoch")
             except OSError:

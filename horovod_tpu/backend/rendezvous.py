@@ -139,11 +139,18 @@ class RendezvousClient:
             time.sleep(0.05)
 
     def delete(self, scope: str):
-        c = self._conn()
-        path = f"/{scope}"
-        try:
-            c.request("DELETE", path,
-                      headers=self._headers("DELETE", path))
-            c.getresponse().read()
-        finally:
-            c.close()
+        # Routed through the same retry/backoff path as put/get: this
+        # was the one KV op that bypassed _retry, so a single refused
+        # connection during elastic reset churn raised raw OSError
+        # through the public API instead of being absorbed.
+        def _delete():
+            c = self._conn()
+            path = f"/{scope}"
+            try:
+                c.request("DELETE", path,
+                          headers=self._headers("DELETE", path))
+                c.getresponse().read()
+            finally:
+                c.close()
+
+        self._retry(_delete, f"rendezvous DELETE {scope}")

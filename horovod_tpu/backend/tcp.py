@@ -41,6 +41,14 @@ to per-channel inboxes, so two in-flight collectives sharing one
 socket can never steal each other's payloads: whichever thread is
 reading the socket delivers frames for other channels into their
 inboxes and keeps its zero-copy recv-into only for its own.
+
+Liveness plane (common/health.py, docs/fault_tolerance.md): heartbeat
+frames ride the same sockets under HEALTH_CHANNEL — consumed by
+whichever thread reads them (plus an idle drain for sockets nobody is
+reading), never deposited, never awaited. Every received frame stamps
+per-peer activity, `declare_dead(peer, reason)` latches a liveness
+verdict as the peer's root cause and severs it, and every
+TransportError carries peer/reporter/root-cause attribution.
 """
 from __future__ import annotations
 
@@ -52,14 +60,14 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..common import fault_injection
 from ..common.exceptions import HorovodInternalError, TransportError
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from ..utils.retry import call_with_retry
-from .base import CTRL_CHANNEL, current_channel
+from .base import CTRL_CHANNEL, HEALTH_CHANNEL, current_channel
 from .rendezvous import RendezvousClient
 from .ring import RingCollectivesMixin
 from .star import as_byte_view, join_buffers
@@ -70,6 +78,11 @@ logger = get_logger()
 # lets concurrent executor channels share one peer socket safely.
 _HDR = struct.Struct("<QB")
 _HDR_LEN = _HDR.size
+# try_drain_idle reads already-buffered bytes in chunks of this size,
+# and consumes at most _DRAIN_MAX_BYTES per call — liveness evidence,
+# not throughput: a huge parked stream resumes at the next tick.
+_DRAIN_CHUNK = 1 << 16
+_DRAIN_MAX_BYTES = 4 << 20
 
 # sendmsg is POSIX; the sequential-sendall fallback keeps exotic
 # platforms working at the cost of one extra syscall per frame.
@@ -339,12 +352,18 @@ class _PeerDemux:
     plane is the single background thread), but the structure doesn't
     rely on it."""
 
-    __slots__ = ("cond", "inbox", "reading")
+    __slots__ = ("cond", "inbox", "reading", "partial")
 
     def __init__(self):
         self.cond = threading.Condition()
         self.inbox: Dict[int, "collections.deque"] = {}
         self.reading = False
+        # Raw stream bytes (header first) of a frame the idle drain
+        # started consuming but could not finish without blocking;
+        # resumed by the next drain, or completed by whichever normal
+        # reader takes the socket first. Only touched while holding the
+        # `reading` flag.
+        self.partial = bytearray()
 
     def take(self, channel: int) -> Optional[bytearray]:
         q = self.inbox.get(channel)
@@ -393,6 +412,16 @@ class TcpBackend(RingCollectivesMixin):
         # every frame exactly once) — lazy per channel tag.
         self._registry = registry
         self._m_channel_frames: Dict[int, object] = {}
+        # Liveness plane (common/health.py): per-peer root-cause death
+        # verdicts (a declared-dead peer's TransportErrors carry the
+        # verdict instead of a bare socket error), the health-frame
+        # callback, and per-peer last-received-frame stamps (ANY frame
+        # from a peer is liveness evidence, so a streaming collective
+        # never reads as silence).
+        self._death_lock = threading.Lock()
+        self._death_reasons: Dict[int, str] = {}
+        self._health_cb = None
+        self._last_activity: Dict[int, float] = {}
         # Persistent per-peer sender workers (lazy; _senders_lock guards
         # the dict — the workers themselves are single-consumer queues).
         self._senders: Dict[int, _PeerSender] = {}
@@ -575,11 +604,31 @@ class TcpBackend(RingCollectivesMixin):
     def _peer_sock(self, peer: int) -> socket.socket:
         s = self.peers.get(peer)
         if s is None:
+            cause = self.death_reason(peer)
+            if cause is not None:
+                raise TransportError(cause, peer=peer, reporter=self.rank,
+                                     root_cause=cause)
             raise TransportError(
                 f"rank {self.rank}: connection to peer {peer} is down "
-                f"(severed by an earlier transport failure)"
+                f"(severed by an earlier transport failure)",
+                peer=peer, reporter=self.rank,
             )
         return s
+
+    def _transport_error(self, peer: int, what: str, exc) -> TransportError:
+        """Translate a socket-level failure with `peer` into the
+        attributed TransportError contract: when the liveness plane has
+        already declared the peer dead, the verdict IS the message
+        ("rank 2 (host X) declared dead: ..."), not the incidental
+        socket error its sever produced."""
+        cause = self.death_reason(peer)
+        if cause is not None:
+            return TransportError(cause, peer=peer, reporter=self.rank,
+                                  root_cause=cause)
+        return TransportError(
+            f"rank {self.rank}: {what} peer {peer} failed: {exc}",
+            peer=peer, reporter=self.rank,
+        )
 
     def _sever(self, peer: int):
         with self._senders_lock:
@@ -603,6 +652,154 @@ class TcpBackend(RingCollectivesMixin):
         if d is not None:
             with d.cond:
                 d.cond.notify_all()
+
+    # -- liveness plane (common/health.py) -----------------------------
+    def set_health_callback(self, cb) -> None:
+        """cb(peer, payload) is invoked for every HEALTH_CHANNEL frame,
+        from whichever thread happened to read it off the socket."""
+        self._health_cb = cb
+
+    def declare_dead(self, peer: int, reason: str) -> None:
+        """Liveness verdict: latch `reason` as the peer's root cause —
+        every subsequent TransportError involving it carries the verdict
+        instead of a bare socket error — and hard-close the connection
+        so any I/O parked on it (unbounded recvs included) unblocks
+        NOW. This is what makes detection bounded even with
+        HOROVOD_TCP_TIMEOUT_SECONDS=0."""
+        with self._death_lock:
+            self._death_reasons.setdefault(peer, reason)
+        self._sever(peer)
+
+    def death_reason(self, peer: int):
+        with self._death_lock:
+            return self._death_reasons.get(peer)
+
+    def _note_activity(self, peer: int) -> None:
+        self._last_activity[peer] = time.monotonic()
+
+    def peer_activity(self, peer: int):
+        """Monotonic timestamp of the last complete frame received from
+        `peer` (None before the first)."""
+        return self._last_activity.get(peer)
+
+    def _route_health(self, peer: int, payload) -> None:
+        self._note_activity(peer)
+        cb = self._health_cb
+        if cb is not None:
+            try:
+                cb(peer, bytes(payload))
+            except Exception:  # pragma: no cover - monitor must not kill I/O
+                logger.exception("health callback failed")
+
+    def try_drain_idle(self, peer: int, max_frames: int = 64) -> int:
+        """Opportunistically consume frames parked in `peer`'s kernel
+        buffer while NO other thread is reading its socket. The control
+        plane's sequential gather parks on one rank while the other
+        ranks' frames — heartbeats included — sit unread; without this
+        those ranks would read as silent. Health frames are consumed;
+        anything else is deposited into its channel inbox exactly as a
+        foreign-channel read would be, so no payload is ever lost.
+
+        Never blocks: only bytes already in the kernel buffer are read
+        (poll(0)-guarded chunk reads), accumulating into a resumable
+        per-peer stash (`_PeerDemux.partial`) that the next drain — or
+        whichever normal reader takes the socket first — completes.
+        EVERY byte consumed counts as progress evidence, and consuming
+        frees rcvbuf so a flow-control-blocked peer keeps making
+        progress: a peer mid-write of an arbitrarily large frame keeps
+        proving life, while one genuinely stalled mid-frame accrues
+        silence until the miss window declares it with full attribution
+        (severing on a stalled read here would contradict the
+        documented miss_limit x interval tolerance). Work per call is
+        bounded by `max_frames` and _DRAIN_MAX_BYTES. Returns complete
+        frames drained."""
+        d = self._demux_for(peer)
+        sock = self.peers.get(peer)
+        if sock is None:
+            return 0
+        with d.cond:
+            if d.reading:
+                # The active reader routes health frames itself.
+                return 0
+            d.reading = True
+        drained = 0
+        consumed_bytes = 0
+        progressed = False
+        deposits: List[Tuple[int, bytearray]] = []
+        try:
+            poller = _make_poller(sock)
+            while drained < max_frames and consumed_bytes < _DRAIN_MAX_BYTES:
+                if len(d.partial) < _HDR_LEN:
+                    need = _HDR_LEN - len(d.partial)
+                else:
+                    n, ch = _HDR.unpack_from(d.partial)
+                    need = _HDR_LEN + n - len(d.partial)
+                if not poller(0):
+                    break
+                try:
+                    chunk = sock.recv(min(need, _DRAIN_CHUNK))
+                except OSError:
+                    # Reset under us: any stash died with the stream.
+                    if d.partial:
+                        self._sever(peer)
+                    break
+                if not chunk:
+                    # Orderly FIN. Mid-frame it is a desynced stream;
+                    # otherwise leave the close to the normal paths so
+                    # attribution flows through them.
+                    if d.partial:
+                        self._sever(peer)
+                    break
+                d.partial += chunk
+                consumed_bytes += len(chunk)
+                progressed = True
+                if len(d.partial) >= _HDR_LEN:
+                    n, ch = _HDR.unpack_from(d.partial)
+                    if len(d.partial) == _HDR_LEN + n:
+                        payload = d.partial[_HDR_LEN:]
+                        d.partial = bytearray()
+                        self._count_frame(ch, n)
+                        if ch == HEALTH_CHANNEL:
+                            self._route_health(peer, payload)
+                        else:
+                            deposits.append((ch, payload))
+                        drained += 1
+            if progressed:
+                self._note_activity(peer)
+        finally:
+            with d.cond:
+                d.reading = False
+                for ch, payload in deposits:
+                    d.inbox.setdefault(
+                        ch, collections.deque()).append(payload)
+                d.cond.notify_all()
+        return drained
+
+    def _finish_partial(self, d: "_PeerDemux", sock, peer: int) -> None:
+        """Complete a frame the idle drain started consuming (caller
+        holds the `reading` flag). Bounded like any normal read; the
+        completed frame is deposited exactly as a foreign-channel read
+        would deposit it — even when it is tagged for the caller's own
+        channel, the caller re-checks its inbox and takes it from
+        there."""
+        if len(d.partial) < _HDR_LEN:
+            d.partial += _recv_exact_bounded(
+                sock, _HDR_LEN - len(d.partial), self._timeout, self._poll)
+        n, ch = _HDR.unpack_from(d.partial)
+        need = _HDR_LEN + n - len(d.partial)
+        if need > 0:
+            d.partial += _recv_exact_bounded(
+                sock, need, self._timeout, self._poll)
+        payload = d.partial[_HDR_LEN:]
+        d.partial = bytearray()
+        self._count_frame(ch, n)
+        self._note_activity(peer)
+        if ch == HEALTH_CHANNEL:
+            self._route_health(peer, payload)
+        else:
+            with d.cond:
+                d.inbox.setdefault(
+                    ch, collections.deque()).append(payload)
 
     # -- persistent sender plumbing ------------------------------------
     def _sender_queue_depth(self) -> float:
@@ -683,9 +880,7 @@ class TcpBackend(RingCollectivesMixin):
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()
             self._sever(peer)
-            raise TransportError(
-                f"rank {self.rank}: send to peer {peer} failed: {exc}"
-            ) from exc
+            raise self._transport_error(peer, "send to", exc) from exc
 
     # -- receive demultiplexer -----------------------------------------
     def _demux_for(self, peer: int) -> _PeerDemux:
@@ -699,7 +894,9 @@ class TcpBackend(RingCollectivesMixin):
         self._m_bytes_recv.inc(nbytes + _HDR_LEN)
         m = self._m_channel_frames.get(channel)
         if m is None:
-            label = "ctrl" if channel == CTRL_CHANNEL else str(channel)
+            label = ("ctrl" if channel == CTRL_CHANNEL
+                     else "health" if channel == HEALTH_CHANNEL
+                     else str(channel))
             m = self._registry.counter(
                 "horovod_tcp_channel_frames_total",
                 "Frames received per channel tag (ctrl = control plane)",
@@ -742,6 +939,17 @@ class TcpBackend(RingCollectivesMixin):
                         raise ConnectionError(
                             "peer severed while awaiting demuxed frame")
                     d.cond.wait(self._poll)
+            if d.partial:
+                # The idle drain left a frame mid-consume: finish and
+                # route it first (it may even be ours — the inbox
+                # re-check on the next loop iteration picks it up).
+                try:
+                    self._finish_partial(d, self._peer_sock(peer), peer)
+                finally:
+                    with d.cond:
+                        d.reading = False
+                        d.cond.notify_all()
+                continue
             deposit = None
             got_mine = False
             try:
@@ -763,10 +971,16 @@ class TcpBackend(RingCollectivesMixin):
                         result = _recv_exact_bounded(
                             sock, n, self._timeout, self._poll)
                     got_mine = True
+                elif ch == HEALTH_CHANNEL:
+                    # Heartbeats are consumed by whoever reads them —
+                    # never deposited, never awaited.
+                    self._route_health(peer, _recv_exact_bounded(
+                        sock, n, self._timeout, self._poll))
                 else:
                     deposit = (ch, _recv_exact_bounded(
                         sock, n, self._timeout, self._poll))
                 self._count_frame(ch, n)
+                self._note_activity(peer)
             finally:
                 with d.cond:
                     d.reading = False
@@ -788,9 +1002,7 @@ class TcpBackend(RingCollectivesMixin):
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()
             self._sever(peer)
-            raise TransportError(
-                f"rank {self.rank}: recv from peer {peer} failed: {exc}"
-            ) from exc
+            raise self._transport_error(peer, "recv from", exc) from exc
 
     def recv_into_from(self, peer: int, buf) -> int:
         """Receive one p2p frame directly into a writable buffer (numpy
@@ -810,9 +1022,7 @@ class TcpBackend(RingCollectivesMixin):
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()
             self._sever(peer)
-            raise TransportError(
-                f"rank {self.rank}: recv from peer {peer} failed: {exc}"
-            ) from exc
+            raise self._transport_error(peer, "recv from", exc) from exc
 
     # ------------------------------------------------------------------
     # transport primitives. Payloads may be scatter-gather buffer lists
@@ -835,15 +1045,45 @@ class TcpBackend(RingCollectivesMixin):
         # the return or passed a single blob, and joining eagerly would
         # cost an O(payload) copy nobody reads. Joined blobs only come
         # from the recv path.
+        #
+        # The root side attempts EVERY peer before raising: a death in
+        # the middle of the send loop must not leave the peers after it
+        # one round behind the survivors before it — the failed peer is
+        # severed (all later I/O to it fails fast) and the first error
+        # is raised once the round is consistent for everyone else.
         if self.size == 1:
             assert payload is not None
             return payload
         if self.rank == 0:
             assert payload is not None
+            first_error: Optional[TransportError] = None
             for r in range(1, self.size):
-                self._peer_send(r, payload)
+                try:
+                    self._peer_send(r, payload)
+                except TransportError as exc:
+                    if first_error is None:
+                        first_error = exc
+            if first_error is not None:
+                raise first_error
             return payload
         return self._peer_recv(0)
+
+    def bcast_bytes_lossy(self, payload) -> int:
+        """Coordinator-only best-effort broadcast for terminal abort
+        verdicts: deliver to every still-connected peer, swallowing
+        per-peer transport failures — a dead peer must not stop the
+        verdict from reaching the survivors. Returns how many peers
+        were reached."""
+        delivered = 0
+        for r in range(1, self.size):
+            if r not in self.peers:
+                continue
+            try:
+                self._peer_send(r, payload)
+                delivered += 1
+            except HorovodInternalError:
+                continue
+        return delivered
 
     def scatter_bytes(self, payloads: Optional[List]) -> bytes:
         # Same verbatim-return contract as bcast_bytes (alltoallv joins

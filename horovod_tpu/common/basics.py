@@ -187,6 +187,12 @@ def shutdown():
         if _state.engine is not None:
             _state.engine.shutdown()
             _state.engine = None
+        # The elastic notify server + epoch watcher would otherwise
+        # survive (and accumulate across) init/shutdown cycles; the
+        # elastic run loop re-inits the manager after each reset.
+        from ..backend.elastic_env import notification_manager
+
+        notification_manager.shutdown()
         for exp in _state.exporters:
             try:
                 exp.stop()

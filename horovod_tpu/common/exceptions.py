@@ -14,7 +14,29 @@ class TransportError(HorovodInternalError):
     HorovodInternalError so the elastic run loop's catch — and every
     public API contract — sees exactly the collective-failure signal;
     the distinct type lets tests and tooling assert the *transport*
-    layer did the translating (no raw ConnectionError may escape)."""
+    layer did the translating (no raw ConnectionError may escape).
+
+    Attribution fields (docs/fault_tolerance.md "Root-cause
+    attribution"): `peer` is the rank whose link failed, `reporter` the
+    rank that observed it, `phase` the collective being executed when it
+    surfaced (set by the engine), and `root_cause` the liveness
+    verdict when the failure was a heartbeat-detector declaration rather
+    than a socket-level event. Together they turn "connection reset"
+    into "rank 2 (host X) died in allreduce"."""
+
+    def __init__(self, message: str, peer=None, reporter=None,
+                 phase=None, root_cause=None):
+        super().__init__(message)
+        self.peer = peer
+        self.reporter = reporter
+        self.phase = phase
+        self.root_cause = root_cause
+
+    def __str__(self):
+        base = super().__str__()
+        if self.phase:
+            return f"{base} (during {self.phase})"
+        return base
 
 
 class HostsUpdatedInterrupt(RuntimeError):

@@ -29,6 +29,18 @@ Rule actions:
 ``drop``    silently swallow sends to ``peer=P`` (the peer then hangs
             until its recv timeout — exercises bounded-time detection).
 ``delay``   sleep ``secs=S`` before I/O with ``peer=P``.
+``wedge``   at ``step=N`` the process freezes without dying: the
+            training thread parks forever inside ``advance_step``,
+            every subsequent transport I/O parks forever inside its
+            injector hook, and the heartbeat monitor (common/health.py
+            checks ``injector.wedged``) stops beating — while the
+            process stays alive and its sockets stay open, so the
+            kernel keeps ACKing and no FIN ever arrives. The closest
+            analogue of a live-locked / GC-frozen / NFS-stuck worker,
+            and the scenario only heartbeat detection can bound.
+``hang``    the matching I/O (``peer=P``, ``after=K``, ``op=...``)
+            parks forever — a single stuck network operation, with the
+            rest of the process (heartbeats included) still running.
 
 Every rule may carry ``rank=R`` so one job-wide env var can target a
 single rank, and ``op=connect|send|recv`` to confine it to one hook
@@ -45,6 +57,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 
 logger = get_logger()
@@ -73,7 +86,7 @@ class InjectedFault(ConnectionError):
 
 @dataclass
 class Rule:
-    action: str                       # kill | sever | drop | delay
+    action: str                       # kill | sever | drop | delay | wedge | hang
     peer: Optional[int] = None        # None = any peer
     rank: Optional[int] = None        # None = any rank
     op: Optional[str] = None          # connect | send | recv | None=both
@@ -93,7 +106,7 @@ def parse_spec(spec: str) -> List[Rule]:
             continue
         fields = part.split(":")
         action = fields[0].strip().lower()
-        if action not in ("kill", "sever", "drop", "delay"):
+        if action not in ("kill", "sever", "drop", "delay", "wedge", "hang"):
             raise ValueError(f"unknown fault action {action!r} in {part!r}")
         kw: Dict[str, str] = {}
         for f in fields[1:]:
@@ -122,8 +135,8 @@ def parse_spec(spec: str) -> List[Rule]:
             rule.step = int(kw["step"])
         if "secs" in kw:
             rule.secs = float(kw["secs"])
-        if rule.action == "kill" and rule.step is None:
-            raise ValueError(f"kill rule needs step=N: {part!r}")
+        if rule.action in ("kill", "wedge") and rule.step is None:
+            raise ValueError(f"{rule.action} rule needs step=N: {part!r}")
         if rule.action == "delay" and rule.secs <= 0:
             raise ValueError(f"delay rule needs secs=S: {part!r}")
         rules.append(rule)
@@ -140,6 +153,19 @@ class FaultInjector:
         self._env_loaded = False
         # Fast-path flag: hooks bail on a single read when inactive.
         self.active = False
+        # Set when a wedge rule fires: the process is frozen-but-alive.
+        # Threads that consult it (I/O hooks, the heartbeat monitor)
+        # park on the event, which is never set free again for the
+        # process's lifetime — exactly a wedge.
+        self._wedge_fired = threading.Event()
+
+    @property
+    def wedged(self) -> bool:
+        return self._wedge_fired.is_set()
+
+    @staticmethod
+    def _park_forever():  # pragma: no cover - by construction never returns
+        threading.Event().wait()
 
     # -- configuration -------------------------------------------------
     def _load_env(self):
@@ -171,6 +197,10 @@ class FaultInjector:
             self._step = 0
             self._env_loaded = True
             self.active = False
+            # Future I/O proceeds again; threads already parked by a
+            # fired wedge stay parked (each holds its own private event
+            # — a wedge is forever for the threads it caught).
+            self._wedge_fired = threading.Event()
 
     def reload_env(self):
         """Re-read HOROVOD_FAULT_INJECT (tests mutate the env)."""
@@ -188,18 +218,39 @@ class FaultInjector:
         batch so worker death is deterministic in *steps*, not seconds."""
         if not self.active:
             return 0
+        wedge = False
         with self._lock:
             self._load_env()
             self._step += 1
             step = self._step
+            own_rank = env_cfg.get_int(env_cfg.RANK, -1)
             for r in self._rules:
-                if r.action == "kill" and r.step is not None and step >= r.step:
+                if r.step is None:
+                    continue
+                # rank= targeting works here too: the job-wide env var
+                # contract (module docstring) — only the named rank's
+                # process dies/wedges, everyone else keeps stepping.
+                if r.rank is not None and r.rank != own_rank:
+                    continue
+                if r.action == "kill" and step >= r.step:
                     logger.error("fault injection: killing worker at step %d",
                                  step)
                     # os._exit: no atexit/finally — the closest analogue
                     # of a SIGKILLed or OOM-killed worker that still lets
                     # the OS send FIN on its sockets.
                     os._exit(1)
+                if r.action == "wedge" and step >= r.step \
+                        and not self._wedge_fired.is_set():
+                    logger.error("fault injection: wedging worker at step %d "
+                                 "(alive, sockets open, heartbeats stop)",
+                                 step)
+                    _fault_counter("wedge").inc()
+                    self._wedge_fired.set()
+                    wedge = True
+        if wedge or self._wedge_fired.is_set():
+            # Park OUTSIDE the lock (other threads must still reach
+            # their own hooks to park themselves).
+            self._park_forever()
         return step
 
     @property
@@ -212,11 +263,16 @@ class FaultInjector:
         sever (the caller hard-closes the connection and translates)."""
         if not self.active:
             return PASS
+        if self._wedge_fired.is_set():
+            # A wedged process's I/O never completes and never errors:
+            # the socket stays open, the bytes just stop.
+            self._park_forever()
+        hang = False
         with self._lock:
             self._load_env()
             verdict = PASS
             for r in self._rules:
-                if r.action == "kill":
+                if r.action in ("kill", "wedge"):
                     continue
                 if r.rank is not None and r.rank != rank:
                     continue
@@ -249,7 +305,16 @@ class FaultInjector:
                         f"fault injection severed rank {rank} <-> peer "
                         f"{peer} ({op})"
                     )
-            return verdict
+                elif r.action == "hang":
+                    _fault_counter("hang").inc()
+                    hang = True
+        if hang:
+            # Park outside the lock: only the MATCHING I/O freezes;
+            # everything else (heartbeats included) keeps flowing.
+            logger.error("fault injection: hanging rank %d %s with peer %d",
+                         rank, op, peer)
+            self._park_forever()
+        return verdict
 
 
 # The process-wide singleton the transports consult.

@@ -1,0 +1,404 @@
+"""Liveness plane: heartbeat failure detection with root-cause
+attribution (docs/fault_tolerance.md "The liveness plane").
+
+The fault-tolerance contract turns failures into HorovodInternalError —
+but only when a data-plane I/O actually touches the dead peer. With the
+default ``HOROVOD_TCP_TIMEOUT_SECONDS=0`` (unbounded) a *silently
+wedged* rank — process alive, sockets open, kernel still ACKing, no
+FIN ever — hangs the job forever. Production elastic systems bound this
+with an always-on liveness layer (TorchElastic's agent heartbeats; the
+φ-accrual failure detector of Hayashibara et al., SRDS 2004, is the
+general shape — we use its degenerate fixed-threshold form, which is
+what both TorchElastic and gloo's store timeouts implement in
+practice).
+
+Mechanics:
+
+* every worker heartbeats the coordinator (and the coordinator acks
+  every worker) on a ``HOROVOD_HEARTBEAT_INTERVAL_SECONDS`` cadence,
+  over the existing mesh sockets with a dedicated frame tag
+  (``HEALTH_CHANNEL``) — heartbeats are consumed by whichever thread
+  happens to be reading a socket and are never awaited, so they cost
+  nothing on the data path;
+* ANY complete frame from a peer counts as liveness evidence (a rank
+  mid-stream in a 100ms collective must not need a separate heartbeat
+  to prove it is alive), and the monitor opportunistically drains
+  sockets nobody is actively reading (the coordinator's sequential
+  gather parks on one rank while the others' frames sit unread — they
+  must not read as silence);
+* a rank silent for more than ``HOROVOD_HEARTBEAT_MISS_LIMIT`` ×
+  interval is **declared dead**: the verdict is latched as the peer's
+  root cause on the transport (every later TransportError carries
+  "rank 2 (host X) declared dead...", not "connection reset"), the
+  socket is hard-closed so unbounded recvs parked on it unblock NOW,
+  and the coordinator's next negotiation round broadcasts a tensor-less
+  ERROR response naming the dead rank (the stall-abort path) so every
+  survivor's pending handles fail with the same attributed reason;
+* workers symmetrically declare the *coordinator* dead on missing acks
+  and latch their engine's first-cause error directly;
+* in elastic mode the coordinator also writes the verdict to the
+  rendezvous KV (``health/verdict_e<epoch>``) so the driver evicts and
+  blacklists the host that *failed*, not the one that reported.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+# Heartbeat frame payload: <i rank> <B kind> <hostname utf-8...>
+_BEAT = struct.Struct("<iB")
+KIND_BEAT = 0   # worker -> coordinator
+KIND_ACK = 1    # coordinator -> worker
+
+# KV scope the coordinator publishes verdicts under (consumed by
+# runner/elastic/driver.py). The full key as the driver's put hook sees
+# it is VERDICT_KEY_PREFIX + "<epoch>"; the value is encode_verdict().
+VERDICT_SCOPE = "health"
+VERDICT_KEY_PREFIX = VERDICT_SCOPE + "/verdict_e"
+
+
+def encode_verdict(peer: int, host: str, reason: str) -> bytes:
+    return f"{peer}|{host}|{reason}".encode()
+
+
+def decode_verdict(value: bytes) -> Optional[Tuple[int, str, str]]:
+    """(dead_rank, host, reason), or None for a malformed blob."""
+    try:
+        rank_s, host, reason = value.decode().split("|", 2)
+        return int(rank_s), host, reason
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def encode_beat(rank: int, kind: int, hostname: str) -> bytes:
+    return _BEAT.pack(rank, kind) + hostname.encode("utf-8", "replace")
+
+
+def decode_beat(payload: bytes) -> Tuple[int, int, str]:
+    rank, kind = _BEAT.unpack_from(payload, 0)
+    return rank, kind, payload[_BEAT.size:].decode("utf-8", "replace")
+
+
+class FailureDetector:
+    """Pure miss-limit math, separately testable: a peer whose last
+    evidence of life is older than ``miss_limit × interval`` is dead.
+    Declarations latch — a peer is declared at most once."""
+
+    def __init__(self, peers, interval: float, miss_limit: int,
+                 now: Optional[float] = None):
+        self.interval = interval
+        self.miss_limit = miss_limit
+        self.window = interval * miss_limit
+        now = time.monotonic() if now is None else now
+        # Armed at construction: a peer that NEVER sends anything is
+        # declared window seconds after the mesh came up, not never.
+        self._last: Dict[int, float] = {p: now for p in peers}
+        self._dead: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def note(self, peer: int, now: Optional[float] = None):
+        """Evidence of life (heartbeat or any complete frame)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if peer in self._last and now > self._last[peer]:
+                self._last[peer] = now
+
+    def age(self, peer: int, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return now - self._last.get(peer, now)
+
+    def ages(self, now: Optional[float] = None) -> Dict[int, float]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {p: now - t for p, t in self._last.items()}
+
+    @property
+    def dead(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._dead)
+
+    def check(self, now: Optional[float] = None) -> List[Tuple[int, float]]:
+        """Returns [(peer, silence_age)] NEWLY declared dead — each peer
+        at most once, ever."""
+        now = time.monotonic() if now is None else now
+        newly: List[Tuple[int, float]] = []
+        with self._lock:
+            for peer, last in self._last.items():
+                if peer in self._dead:
+                    continue
+                silence = now - last
+                if silence > self.window:
+                    self._dead[peer] = silence
+                    newly.append((peer, silence))
+        return newly
+
+
+class HeartbeatMonitor:
+    """One daemon thread per engine driving the liveness plane: send
+    beats/acks, drain idle sockets, run the detector, act on verdicts.
+
+    The coordinator (rank 0) watches every worker; workers watch the
+    coordinator only — peer-to-peer wedges surface at the coordinator
+    (the wedged rank stops gathering) and the verdict reaches everyone
+    through the negotiation broadcast, so a full-mesh detector is not
+    needed for bounded detection."""
+
+    def __init__(self, backend, rank: int, size: int, interval: float,
+                 miss_limit: int, engine=None, registry=None,
+                 hostname: Optional[str] = None):
+        from . import telemetry
+
+        self.backend = backend
+        self.rank = rank
+        self.size = size
+        self.interval = interval
+        self.miss_limit = miss_limit
+        self.engine = engine
+        self.hostname = hostname if hostname is not None else env_cfg.get_str(
+            env_cfg.HOSTNAME, "") or "?"
+        self._watch = (list(range(1, size)) if rank == 0 else [0])
+        self.detector = FailureDetector(self._watch, interval, miss_limit)
+        self.peer_hosts: Dict[int, str] = {}
+        self.verdicts: Dict[int, str] = {}
+        self._first_declared: Optional[float] = None
+        self._escalated = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        registry = registry if registry is not None \
+            else telemetry.default_registry()
+        self._m_sent = registry.counter(
+            "horovod_heartbeats_sent_total",
+            "Liveness beats/acks written to peer sockets")
+        self._m_recv = registry.counter(
+            "horovod_heartbeats_received_total",
+            "Liveness beats/acks consumed from peer sockets")
+        self._m_dead = registry.counter(
+            "horovod_ranks_declared_dead_total",
+            "Ranks this process declared dead by heartbeat silence")
+        self._gauges = {}
+        for peer in self._watch:
+            g = registry.gauge(
+                "horovod_heartbeat_age_seconds",
+                "Seconds since the last evidence of life from a peer",
+                labels={"peer": str(peer)})
+            fn = lambda p=peer: self.detector.age(p)  # noqa: E731
+            g.set_function(fn)
+            self._gauges[peer] = (g, fn)
+        backend.set_health_callback(self._on_health_frame)
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-health", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        # Pass our own callback: a replacement engine's monitor may
+        # already have taken these gauges over (telemetry ownership
+        # contract), and a late stop() must not freeze ITS ages.
+        for g, fn in self._gauges.values():
+            g.clear_function(fn)
+
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        """Live liveness view for /status (docs/metrics.md)."""
+        ages = self.detector.ages()
+        return {
+            "enabled": True,
+            "role": "coordinator" if self.rank == 0 else "worker",
+            "interval_seconds": self.interval,
+            "miss_limit": self.miss_limit,
+            "peers": {
+                str(p): {
+                    "age_seconds": round(ages.get(p, -1.0), 3),
+                    "host": self.peer_hosts.get(p, ""),
+                }
+                for p in self._watch
+            },
+            "dead": dict(self.verdicts),
+        }
+
+    # ------------------------------------------------------------------
+    def _on_health_frame(self, peer: int, payload: bytes):
+        """Runs on WHATEVER thread read the frame (demux reader, idle
+        drain) — keep it to dict stores."""
+        try:
+            rank, kind, host = decode_beat(payload)
+        except (struct.error, UnicodeDecodeError):  # pragma: no cover
+            return
+        self._m_recv.inc()
+        if host:
+            self.peer_hosts[peer] = host
+        self.detector.note(peer)
+
+    def _loop(self):
+        from . import fault_injection
+
+        inj = fault_injection.get_injector()
+        while not self._stop.wait(self.interval):
+            if inj.active and inj.wedged:
+                # A wedged process's monitor is as frozen as the rest
+                # of it: stop beating, stop detecting, park.
+                fault_injection.FaultInjector._park_forever()
+            try:
+                self._tick()
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("heartbeat tick failed")
+
+    def _tick(self):
+        kind = KIND_ACK if self.rank == 0 else KIND_BEAT
+        payload = encode_beat(self.rank, kind, self.hostname)
+        # Beats/acks go out BEFORE any drain can stall (send_async only
+        # enqueues): one peer wedged mid-frame must not starve the acks
+        # every other peer's detector depends on.
+        for peer in self._watch:
+            try:
+                self.backend.send_async(
+                    peer, payload, channel=_health_channel())
+                self._m_sent.inc()
+            except Exception:
+                # Severed/dead peer: the detector owns the verdict.
+                pass
+        for peer in self._watch:
+            # Fold transport-level receive activity into the detector
+            # BEFORE draining, so a frame that an active reader consumed
+            # since the last tick counts.
+            act = self.backend.peer_activity(peer)
+            if act is not None:
+                self.detector.note(peer, act)
+            try:
+                # The drain never blocks (it only reads bytes already
+                # in the kernel buffer, stashing a mid-arrival frame for
+                # the next pass), so this single thread keeps the beat
+                # cadence for every watched peer.
+                self.backend.try_drain_idle(peer)
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("idle drain for peer %d failed", peer)
+            act = self.backend.peer_activity(peer)
+            if act is not None:
+                self.detector.note(peer, act)
+        for peer, silence in self.detector.check():
+            self._declare_dead(peer, silence)
+        self._maybe_escalate()
+
+    @property
+    def window(self) -> float:
+        return self.detector.window
+
+    # ------------------------------------------------------------------
+    def _declare_dead(self, peer: int, silence: float):
+        host = self.peer_hosts.get(peer, "")
+        who = f"rank {peer} (host {host})" if host else f"rank {peer}"
+        if self.rank != 0 and peer == 0:
+            who = f"coordinator {who}"
+        reason = (
+            f"{who} declared dead by rank {self.rank}: no heartbeat or "
+            f"traffic for {silence:.1f}s (> HOROVOD_HEARTBEAT_MISS_LIMIT="
+            f"{self.miss_limit} x HOROVOD_HEARTBEAT_INTERVAL_SECONDS="
+            f"{self.interval:g})"
+        )
+        logger.error("liveness: %s", reason)
+        self._m_dead.inc()
+        self.verdicts[peer] = reason
+        if self._first_declared is None:
+            self._first_declared = time.monotonic()
+        # 1. Latch the verdict as the peer's root cause and hard-close
+        #    the socket: every I/O parked on it unblocks with the
+        #    attributed TransportError, bounded regardless of
+        #    HOROVOD_TCP_TIMEOUT_SECONDS.
+        self.backend.declare_dead(peer, reason)
+        if self.rank == 0:
+            # 2. Coordinator: the controller's next negotiation round
+            #    hits the severed peer, catches the attributed error,
+            #    and broadcasts the tensor-less ERROR verdict to the
+            #    survivors (engine/controller.py) — the monitor itself
+            #    must NOT kill the engine yet or the broadcast never
+            #    happens. It also publishes the verdict to the
+            #    rendezvous KV for the elastic driver's eviction fast
+            #    path.
+            self._publish_verdict(peer, host, reason)
+        else:
+            # Workers have nobody to tell: fail the engine directly so
+            # a loop parked outside a control recv (backpressure wait,
+            # fence drain) still dies within the window.
+            self._latch_engine(reason, peer)
+
+    def _maybe_escalate(self):
+        """Coordinator backstop: if the engine has not died within one
+        extra interval of the first declaration (e.g. the background
+        loop is parked in a fence drain and never reaches the
+        negotiation round that would broadcast the verdict), latch the
+        first verdict directly — survivors then learn through the
+        FIN/ack-loss cascade instead of the clean broadcast, but
+        detection stays bounded."""
+        if (self.rank != 0 or self._escalated or not self.verdicts
+                or self._first_declared is None or self.engine is None):
+            return
+        if self.engine._fatal_error is not None:
+            self._escalated = True
+            return
+        if time.monotonic() - self._first_declared > 2 * self.interval:
+            self._escalated = True
+            reason = next(iter(self.verdicts.values()))
+            self._latch_engine(reason, next(iter(self.verdicts)))
+
+    def _latch_engine(self, reason: str, peer: int):
+        if self.engine is None:
+            return
+        from .exceptions import TransportError
+
+        self.engine._latch_fatal(TransportError(
+            reason, peer=peer, reporter=self.rank, root_cause=reason))
+
+    def _publish_verdict(self, peer: int, host: str, reason: str):
+        try:
+            from ..backend import elastic_env
+
+            rdv = elastic_env._rendezvous()
+            if rdv is None:
+                return
+            epoch = elastic_env._current_epoch()
+            key = f"verdict_e{epoch if epoch is not None else 0}"
+            rdv.put(VERDICT_SCOPE, key, encode_verdict(peer, host, reason))
+        except Exception:  # best-effort: the broadcast is the main path
+            logger.warning("could not publish liveness verdict to the "
+                           "rendezvous KV", exc_info=True)
+
+
+def _health_channel() -> int:
+    from ..backend.base import HEALTH_CHANNEL
+
+    return HEALTH_CHANNEL
+
+
+def maybe_start_monitor(engine) -> Optional[HeartbeatMonitor]:
+    """Engine hook: start the liveness plane when enabled and the
+    backend supports it (the TCP mesh; local/threaded backends have no
+    sockets to watch)."""
+    backend = engine.backend
+    if (not env_cfg.heartbeat_enabled() or engine.size <= 1
+            or not hasattr(backend, "set_health_callback")):
+        return None
+    interval = env_cfg.heartbeat_interval_seconds()
+    miss = env_cfg.heartbeat_miss_limit()
+    mon = HeartbeatMonitor(
+        backend, engine.rank, engine.size, interval=interval,
+        miss_limit=miss, engine=engine, registry=engine.registry,
+    )
+    mon.start()
+    logger.debug(
+        "liveness plane armed: interval=%.3gs miss_limit=%d (window %.3gs)",
+        interval, miss, interval * miss)
+    return mon

@@ -32,11 +32,16 @@ def _reset():
     from ..backend import elastic_env
 
     _m_resets.inc()
+    # shutdown() also stops the notification server (it must not leak
+    # across resets); re-init it after the new topology lands so this
+    # worker re-registers its endpoint — under the NEW epoch's env —
+    # and keeps receiving host updates.
     basics.shutdown()
     elastic_env.refresh_topology_from_rendezvous()
     # init() re-sets the horovod_world_size gauge, so shrink/grow
     # history shows up next to the reset count.
     basics.init()
+    elastic_env.notification_manager.init()
 
 
 def run(func: Callable) -> Callable:

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..common.exceptions import TransportError
 from ..common.message import (
     Request,
     RequestList,
@@ -53,8 +54,23 @@ _FLAG_SHUTDOWN = 1 << 1
 # vector for it in the AND pass (a joined rank participates in every
 # cached collective with zeros, so it must not veto the intersection).
 _FLAG_JOINED = 1 << 2
+# Terminal abort verdict: the coordinator lost a rank mid-round (liveness
+# declaration or socket death observed during its gather) and is
+# delivering the attributed reason in place of the normal cache verdict
+# — the payload carries a trailing reason string, and every rank turns
+# it into the same tensor-less ERROR + shutdown a stall abort produces.
+_FLAG_ABORT = 1 << 3
 
 _ALL_ONES = 0xFFFFFFFFFFFFFFFF
+
+
+class _NegotiationAborted(Exception):
+    """Internal: negotiation ended in a terminal abort verdict; carries
+    the attributed reason every rank's pending handles will fail with."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 # Response types eligible for a pipelined executor channel. Everything
 # else (JOIN / BARRIER / ERROR) is a fence: the engine drains all
@@ -147,6 +163,25 @@ class Controller:
 
     # ------------------------------------------------------------------
     def compute_response_list(
+        self, messages: List[Request], shutdown: bool = False
+    ) -> Tuple[ResponseList, bool]:
+        """One negotiation cycle. Returns (responses, should_shutdown).
+
+        A terminal abort verdict — the coordinator observed a rank die
+        mid-round (liveness declaration severing its socket, or a
+        socket-level death), broadcast the attributed reason, and every
+        rank converged on it — surfaces as the same tensor-less ERROR +
+        shutdown a stall abort produces, so the engine fails every
+        pending handle with "rank 2 (host X) declared dead...", not a
+        bare transport error.
+        """
+        try:
+            return self._compute_response_list(messages, shutdown)
+        except _NegotiationAborted as exc:
+            err = Response(ResponseType.ERROR, [], error_message=exc.reason)
+            return ResponseList([err], shutdown=True), True
+
+    def _compute_response_list(
         self, messages: List[Request], shutdown: bool = False
     ) -> Tuple[ResponseList, bool]:
         """One negotiation cycle. Returns (responses, should_shutdown).
@@ -258,7 +293,21 @@ class Controller:
                 self._last_metrics_push = time.monotonic()
                 req_list.telemetry = _telemetry.encode_push(
                     self.registry, self.rank)
-            gathered = self.transport.gather_bytes(req_list.serialize())
+            try:
+                gathered = self.transport.gather_bytes(req_list.serialize())
+            except TransportError as exc:
+                if not self.is_coordinator:
+                    raise
+                # A rank died while the coordinator gathered request
+                # lists. Workers are (or will be) parked on THIS
+                # round's response broadcast — deliver the attributed
+                # verdict there, best-effort, then converge locally.
+                reason = self._abort_reason(exc)
+                err = Response(ResponseType.ERROR, [],
+                               error_message=reason)
+                self._bcast_lossy(
+                    ResponseList([err], shutdown=True).serialize())
+                raise _NegotiationAborted(reason) from exc
             if self.is_coordinator:
                 negotiated: List[Response] = []
                 ready_names: List[str] = []
@@ -312,9 +361,16 @@ class Controller:
                     ))
                 # Broadcast only the negotiated responses; every rank
                 # prepends its (identical) cached fast-path list locally.
-                self.transport.bcast_bytes(
-                    ResponseList(negotiated, shutdown=shutdown).serialize()
-                )
+                try:
+                    self.transport.bcast_bytes(
+                        ResponseList(negotiated,
+                                     shutdown=shutdown).serialize()
+                    )
+                except TransportError:
+                    # Same contract as the cache-verdict broadcast: the
+                    # dead peer is severed, survivors received the
+                    # round, the next gather aborts with attribution.
+                    pass
                 resp_list = ResponseList(responses + negotiated, shutdown)
             else:
                 recv = ResponseList.deserialize(self.transport.bcast_bytes(None))
@@ -334,16 +390,52 @@ class Controller:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _pack_coord(flags: int, a: Sequence[int], b: Sequence[int]) -> bytes:
+    def _pack_coord(flags: int, a: Sequence[int], b: Sequence[int],
+                    reason: str = "") -> bytes:
+        # Trailing reason bytes (present iff _FLAG_ABORT): decoders that
+        # stop after the word vectors stay compatible.
         return struct.pack(f"<QII{len(a)}Q{len(b)}Q",
-                           flags, len(a), len(b), *a, *b)
+                           flags, len(a), len(b), *a, *b) \
+            + reason.encode("utf-8", "replace")
 
     @staticmethod
-    def _unpack_coord(buf) -> Tuple[int, List[int], List[int]]:
+    def _unpack_coord(buf) -> Tuple[int, List[int], List[int], str]:
         flags, na, nb = struct.unpack_from("<QII", buf, 0)
         off = struct.calcsize("<QII")
         words = struct.unpack_from(f"<{na + nb}Q", buf, off)
-        return flags, list(words[:na]), list(words[na:])
+        reason = ""
+        if flags & _FLAG_ABORT:
+            reason = bytes(buf[off + 8 * (na + nb):]).decode(
+                "utf-8", "replace")
+        return flags, list(words[:na]), list(words[na:]), reason
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _abort_reason(exc: TransportError) -> str:
+        """Attributed abort reason for a transport failure the
+        coordinator observed mid-round. A liveness verdict (root_cause)
+        is already the full story; a socket-level death gets the peer
+        rank stamped on so survivors hear 'rank 2 died', never just
+        'connection reset'."""
+        if getattr(exc, "root_cause", None):
+            return str(exc)
+        peer = getattr(exc, "peer", None)
+        if peer is not None:
+            return (f"rank {peer} lost during negotiation "
+                    f"(observed by the coordinator): {exc}")
+        return f"coordinator negotiation transport failure: {exc}"
+
+    def _bcast_lossy(self, payload: bytes):
+        """Best-effort terminal-verdict broadcast: a second dead peer
+        must not stop the verdict reaching the remaining survivors."""
+        lossy = getattr(self.transport, "bcast_bytes_lossy", None)
+        try:
+            if lossy is not None:
+                lossy(payload)
+            else:
+                self.transport.bcast_bytes(payload)
+        except TransportError:  # pragma: no cover - mesh collapsing
+            pass
 
     def _coordinate_cache(
         self, flags: int, pending_words: List[int],
@@ -356,9 +448,22 @@ class Controller:
         implicit all-ones hit vector to the full width, so a joined
         rank can never veto bits its own cache hasn't grown to)."""
         payload = self._pack_coord(flags, pending_words, invalid_words)
-        gathered = self.transport.gather_bytes(payload)
+        try:
+            gathered = self.transport.gather_bytes(payload)
+        except TransportError as exc:
+            if not self.is_coordinator:
+                raise
+            # A rank died (or was declared dead by the liveness plane)
+            # while the coordinator gathered this round. The workers'
+            # next recv is THIS round's verdict broadcast, so the abort
+            # must ride the coord-verdict payload — then every rank
+            # raises the same attributed shutdown.
+            reason = self._abort_reason(exc)
+            self._bcast_lossy(self._pack_coord(
+                _FLAG_ABORT | _FLAG_SHUTDOWN, [], [], reason))
+            raise _NegotiationAborted(reason) from exc
         if self.is_coordinator:
-            decoded = [self._unpack_coord(b) for b in gathered]
+            decoded = [self._unpack_coord(b)[:3] for b in gathered]
             nw = max(1, max(len(p) for _, p, _ in decoded),
                      max(len(i) for _, _, i in decoded))
             out_flags = 0
@@ -385,10 +490,22 @@ class Controller:
             if requeue:
                 out_flags |= _FLAG_HAS_UNCACHED
             verdict = self._pack_coord(out_flags, common, or_invalid)
-            self.transport.bcast_bytes(verdict)
+            try:
+                self.transport.bcast_bytes(verdict)
+            except TransportError:
+                # A peer died between this round's gather and its
+                # broadcast. The dead peer is severed; the SURVIVORS all
+                # received the verdict (bcast attempts every peer), so
+                # the round is consistent — finish it locally and let
+                # the next round's gather hit the severed peer and
+                # broadcast the attributed abort in lockstep.
+                pass
         else:
             verdict = self.transport.bcast_bytes(None)
-        out_flags, common, or_invalid = self._unpack_coord(verdict)
+        out_flags, common, or_invalid, reason = self._unpack_coord(verdict)
+        if out_flags & _FLAG_ABORT:
+            raise _NegotiationAborted(
+                reason or "negotiation aborted by the coordinator")
         return (out_flags, ResponseCache.vector_to_bits(common),
                 ResponseCache.vector_to_bits(or_invalid))
 

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import telemetry
-from ..common.exceptions import HorovodInternalError
+from ..common.exceptions import HorovodInternalError, TransportError
 from ..common.message import Request, RequestType, Response, ResponseType
 from ..common.types import ReduceOp, Status, StatusType, to_wire_dtype
 from ..utils import env as env_cfg
@@ -250,9 +250,15 @@ class Engine:
         self._inflight = 0
         self._inflight_cond = threading.Condition()
         self._max_inflight = env_cfg.max_inflight_responses()
-        # First executor HorovodInternalError; latched once, kills the
-        # whole engine (read without the lock on hot paths — benign).
+        # First HorovodInternalError anywhere in the engine (executor,
+        # background loop, liveness monitor); latched once, kills the
+        # whole engine and is the reason EVERY pending handle fails
+        # with — first-cause attribution (read without the lock on hot
+        # paths — benign).
         self._fatal_error: Optional[HorovodInternalError] = None
+        # Liveness plane (common/health.py); armed by the background
+        # loop once the backend exists, when heartbeats are enabled.
+        self._health = None
         # Event-driven cycles: enqueues (and shutdown) set the event so
         # HOROVOD_CYCLE_TIME is a max-coalescing delay, not a floor.
         self._wake = threading.Event()
@@ -343,6 +349,9 @@ class Engine:
                 "executing": list(cur) if cur else [],
             }
         st["channels"] = channels
+        health = self._health
+        if health is not None:
+            st["health"] = health.status()
         ctrl = self.controller
         if ctrl is not None and ctrl.is_coordinator:
             now = time.monotonic()
@@ -453,25 +462,40 @@ class Engine:
             # lazily at first dispatch.
             for ch in range(env_cfg.num_channels()):
                 self._executor_for(ch)
+            # Liveness plane: heartbeats + failure detector over the
+            # mesh sockets (no-op for local/threaded backends or when
+            # HOROVOD_HEARTBEAT_INTERVAL_SECONDS/_MISS_LIMIT is 0).
+            from ..common import health
+
+            self._health = health.maybe_start_monitor(self)
             while self._run_loop_once():
                 pass
         except HorovodInternalError as e:
-            # Transport death (peer gone, socket timeout) or injected
-            # fault: the mesh is unusable, so EVERY pending handle —
-            # and every enqueue from here on — fails with this reason,
-            # unblocking all framework threads into elastic recovery at
-            # once (ref: the reference's ShutDown → callbacks-with-
-            # status path, operations.cc:300-330).
-            logger.error("background loop failed: %s", e)
-            self.tensor_queue.finalize(Status.Aborted(str(e)))
+            # Transport death (peer gone, socket timeout), liveness
+            # verdict, or injected fault: the mesh is unusable, so
+            # EVERY pending handle — and every enqueue from here on —
+            # fails with the FIRST cause (the latched error: a liveness
+            # verdict or an executor's transport death wins over the
+            # follow-on error that killed the loop), unblocking all
+            # framework threads into elastic recovery at once (ref: the
+            # reference's ShutDown → callbacks-with-status path,
+            # operations.cc:300-330).
+            self._latch_fatal(e)
+            first = self._fatal_error or e
+            logger.error("background loop failed: %s", first)
+            self.tensor_queue.finalize(Status.Aborted(str(first)))
         except BaseException as e:
             logger.error("background loop failed: %s", e)
             self.tensor_queue.finalize(Status.UnknownError(str(e)))
         finally:
-            # Stop order matters: queue the stop sentinels, then shut the
-            # backend (severing sockets unblocks any executor parked in a
-            # recv — its op fails with TransportError and its entries are
-            # finished by the executor's own error path), then join.
+            # Stop order matters: stop the liveness monitor (it must not
+            # read our own teardown as a peer death), queue the stop
+            # sentinels, then shut the backend (severing sockets
+            # unblocks any executor parked in a recv — its op fails
+            # with TransportError and its entries are finished by the
+            # executor's own error path), then join.
+            if self._health is not None:
+                self._health.stop()
             for ex in list(self._executors.values()):
                 ex.queue.put(_EXEC_STOP)
             if self.backend is not None:
@@ -563,6 +587,21 @@ class Engine:
         resp_list, should_shutdown = self.controller.compute_response_list(
             messages, shutdown=want_shutdown
         )
+        # Terminal abort verdict: a tensor-less ERROR + shutdown is a
+        # stall abort or a liveness death declaration ("rank 2 (host X)
+        # declared dead..."). Do NOT drain channels first — an executor
+        # may be parked in a recv the dead rank will never feed (with
+        # HOROVOD_TCP_TIMEOUT_SECONDS=0, forever). Latch the verdict as
+        # first cause and die; the teardown path severs every socket,
+        # which unblocks parked executors, and finalize fails every
+        # pending handle with the attributed reason.
+        if should_shutdown:
+            for resp in resp_list.responses:
+                if (resp.response_type == ResponseType.ERROR
+                        and not resp.tensor_names and resp.error_message):
+                    exc = HorovodInternalError(resp.error_message)
+                    self._latch_fatal(exc)
+                    raise exc
         if resp_list.responses:
             self.response_cycles += 1
         # Autotune (ref: operations.cc:592-600): windows are counted in
@@ -619,20 +658,13 @@ class Engine:
         self._last_cycle_ts = time.monotonic()
         self._m_cycle.observe(self._last_cycle_ts - cycle_t0)
         if should_shutdown:
-            # Shutdown is a fence too: in-flight collectives complete
-            # (every rank agreed to shut down, so their peers are still
-            # executing them) before pending handles are finalized.
+            # Clean shutdown (every rank agreed): a fence — in-flight
+            # collectives complete before pending handles are finalized.
+            # Abort verdicts (stall / liveness) took the hard latch+
+            # raise path above and never reach here.
             self._drain_channels()
-            # A stall-inspector abort rides the shutdown broadcast as a
-            # tensor-less ERROR response; its diagnosis becomes the
-            # failure reason every pending handle sees (on every rank,
-            # not just the coordinator that detected the stall).
-            reason = "Horovod has been shut down."
-            for resp in resp_list.responses:
-                if (resp.response_type == ResponseType.ERROR
-                        and not resp.tensor_names and resp.error_message):
-                    reason = resp.error_message
-            self.tensor_queue.finalize(Status.Aborted(reason))
+            self.tensor_queue.finalize(
+                Status.Aborted("Horovod has been shut down."))
             return False
         return True
 
@@ -718,12 +750,20 @@ class Engine:
                         e, Status.UnknownError(f"bad response {resp.response_type}"), None
                     )
         except HorovodInternalError as exc:
-            # Transport failure mid-collective: fail the in-flight
-            # entries, then re-raise so the background loop dies and
-            # finalizes every OTHER pending handle with the same error —
-            # a broken mesh can't serve the next response either, and
-            # leaving those handles parked would hang their waiters.
-            status = Status.Aborted(str(exc))
+            # Transport failure mid-collective: stamp the collective
+            # phase on the error ("... (during allreduce)" — the
+            # attribution the liveness plane threads through the whole
+            # stack), fail the in-flight entries with the FIRST cause
+            # when one is already latched (a liveness verdict beats the
+            # socket noise its sever produced), then re-raise so the
+            # background loop dies and finalizes every OTHER pending
+            # handle too — a broken mesh can't serve the next response
+            # either, and leaving those handles parked would hang their
+            # waiters.
+            if isinstance(exc, TransportError) and exc.phase is None:
+                exc.phase = resp.response_type.name.lower()
+            first = self._fatal_error
+            status = Status.Aborted(str(first if first is not None else exc))
             for e in entries:
                 self._finish(e, status, None)
             raise

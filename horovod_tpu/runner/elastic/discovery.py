@@ -8,8 +8,10 @@ from __future__ import annotations
 
 import subprocess
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
+from ...utils import env as env_cfg
 from ...utils.logging import get_logger
 
 logger = get_logger()
@@ -73,27 +75,58 @@ class FixedHosts(HostDiscovery):
 class HostManager:
     """Stable-ordered view of available hosts with blacklisting
     (ref: discovery.py:79-121 — order preserves host age so rank 0 stays
-    on the oldest surviving host, which carries state through resets)."""
+    on the oldest surviving host, which carries state through resets).
 
-    def __init__(self, discovery: HostDiscovery):
+    Blacklisting is cooldown-with-escalation
+    (docs/fault_tolerance.md): a host's FIRST failure parks it for
+    ``HOROVOD_BLACKLIST_COOLDOWN_SECONDS`` (a transient flake — OOM
+    blip, network hiccup — gets another chance once the storm passes),
+    a REPEAT failure parks it permanently. The reference's forever-set
+    semantics are available via cooldown 0."""
+
+    def __init__(self, discovery: HostDiscovery,
+                 cooldown: Optional[float] = None):
         self._discovery = discovery
         self._order: List[str] = []          # first-seen order
         self._current: Dict[str, int] = {}
-        self._blacklist: set = set()
+        # host -> blacklist expiry (monotonic; inf = permanent)
+        self._blacklist: Dict[str, float] = {}
+        self._strikes: Dict[str, int] = {}
+        self._cooldown = (env_cfg.blacklist_cooldown_seconds()
+                          if cooldown is None else cooldown)
         self._lock = threading.Lock()
+
+    def _active_blacklist(self) -> set:
+        """Prune expired cooldowns; call with the lock held."""
+        now = time.monotonic()
+        for h in [h for h, exp in self._blacklist.items() if exp <= now]:
+            del self._blacklist[h]
+            logger.warning(
+                "blacklist cooldown expired for host %s; it is eligible "
+                "again (a repeat failure will blacklist it permanently)",
+                h)
+        return set(self._blacklist)
 
     def update_available_hosts(self) -> int:
         new = self._discovery.find_available_hosts_and_slots()
         with self._lock:
-            res = HostUpdateResult.NO_UPDATE
+            # The previous view must be filtered with the blacklist AS
+            # IT WAS, before pruning expired cooldowns: a host whose
+            # cooldown just lapsed is absent from prev_active and
+            # present in active, i.e. an ADDED update — otherwise the
+            # recovered host is invisible (NO_UPDATE) and a driver
+            # parked on "not enough slots" never re-assigns.
+            prev_blacklist = set(self._blacklist)
+            blacklist = self._active_blacklist()
             prev_active = {
                 h: s for h, s in self._current.items()
-                if h not in self._blacklist
+                if h not in prev_blacklist
             }
+            res = HostUpdateResult.NO_UPDATE
             for h in new:
                 if h not in self._order:
                     self._order.append(h)
-            active = {h: s for h, s in new.items() if h not in self._blacklist}
+            active = {h: s for h, s in new.items() if h not in blacklist}
             if set(active) - set(prev_active) or any(
                 active.get(h, 0) > prev_active.get(h, 0) for h in active
             ):
@@ -110,22 +143,40 @@ class HostManager:
     def current_hosts(self) -> List[Tuple[str, int]]:
         """Active (hostname, slots), oldest first."""
         with self._lock:
+            blacklist = self._active_blacklist()
             return [
                 (h, self._current[h])
                 for h in self._order
-                if h in self._current and h not in self._blacklist
+                if h in self._current and h not in blacklist
                 and self._current[h] > 0
             ]
 
     def blacklist(self, host: str):
+        from ...common import telemetry
+
         with self._lock:
-            if host not in self._blacklist:
-                logger.warning("blacklisting host %s", host)
-                self._blacklist.add(host)
+            self._strikes[host] = strikes = self._strikes.get(host, 0) + 1
+            if strikes > 1 or self._cooldown <= 0:
+                expiry, how = float("inf"), "permanently"
+            else:
+                expiry = time.monotonic() + self._cooldown
+                how = f"for {self._cooldown:.0f}s (first failure)"
+            already = self._blacklist.get(host)
+            self._blacklist[host] = max(expiry, already or 0.0)
+            if already is None:
+                logger.warning("blacklisting host %s %s", host, how)
+                telemetry.counter(
+                    "horovod_hosts_blacklisted_total",
+                    "Hosts blacklisted after worker failures",
+                ).inc()
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
-            return host in self._blacklist
+            return host in self._active_blacklist()
+
+    def blacklist_strikes(self, host: str) -> int:
+        with self._lock:
+            return self._strikes.get(host, 0)
 
     def available_slots(self) -> int:
         return sum(s for _, s in self.current_hosts)

@@ -20,12 +20,14 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import telemetry
+from ...common.health import VERDICT_KEY_PREFIX, decode_verdict
 from ...utils import env as env_cfg
 from ...utils.logging import get_logger
 from ..hosts import HostInfo, SlotInfo, get_host_assignments
 from ..rendezvous_server import RendezvousServer
 from .discovery import HostManager, HostUpdateResult
-from .registration import WorkerStateRegistry
+from .registration import FAILURE, READY, WorkerStateRegistry
 
 logger = get_logger()
 
@@ -70,6 +72,36 @@ class ElasticDriver:
         self._finished = threading.Event()
         self.exit_code: Optional[int] = None
         self._discovery_thread: Optional[threading.Thread] = None
+        # Bounded-time recovery (docs/elastic.md "Recovery-time
+        # guarantees"): every epoch's reset barrier gets a deadline — a
+        # slot with no verdict by then is evicted (killed + recorded
+        # failed) so the barrier ALWAYS fires and survivors re-mesh
+        # instead of parking forever behind a wedged worker.
+        self._ready_timeout = env_cfg.elastic_ready_timeout()
+        # Watchdog state has its own leaf lock: the arm path is called
+        # from the registry's record path (first verdict of an epoch)
+        # on arbitrary threads — worker exit monitors, the rendezvous
+        # put hook — and stays off the driver lock so it can never
+        # participate in a lock-ordering cycle.
+        self._watchdog_lock = threading.Lock()
+        self._watchdog: Optional[threading.Timer] = None
+        # Registry-epoch token the armed watchdog was captured against
+        # (see _on_barrier_opened): identifies WHICH barrier the
+        # deadline belongs to, so a hook delayed past that barrier's
+        # resolution can never arm a deadline against the next one.
+        self._watchdog_token: Optional[int] = None
+        # First failure evidence of the current incident; observed into
+        # the recovery-duration histogram when the next activation
+        # completes (failure -> re-meshed).
+        self._failure_t0: Optional[float] = None
+        self._m_evictions = telemetry.counter(
+            "horovod_elastic_evictions_total",
+            "Reset-barrier slots evicted at the ready deadline "
+            "(worker killed, recorded as failed)")
+        self._m_recovery = telemetry.histogram(
+            "horovod_elastic_recovery_seconds",
+            "Failure detection to re-meshed activation", min_exp=-4,
+            max_exp=10)
         rendezvous.put_hook = self._observe_put
 
     # ------------------------------------------------------------------
@@ -178,9 +210,107 @@ class ElasticDriver:
                 if key not in self._workers:
                     self._spawn(key, slot)
 
-            self.registry.reset(len(new_assignments))
+            # The previous epoch's barrier is resolved; its deadline (if
+            # any) is moot. Cancel BEFORE the registry reset: a verdict
+            # recorded in the gap would see the stale timer as "already
+            # armed" and skip arming the new epoch's deadline.
+            self._cancel_watchdog()
+            self.registry.reset(
+                len(new_assignments),
+                expected={f"{h}:{i}" for (h, i) in new_assignments})
+            if self._failure_t0 is not None:
+                # Failure -> re-meshed: rows published, survivors
+                # spawned/notified, new barrier armed.
+                self._m_recovery.observe(
+                    time.monotonic() - self._failure_t0)
+                self._failure_t0 = None
         if notify_update:
             self._notify_workers(notify_update)
+
+    def _note_failure(self):
+        with self._lock:
+            if self._failure_t0 is None:
+                self._failure_t0 = time.monotonic()
+
+    # ------------------------------------------------------------------
+    def _on_barrier_opened(self, reg_epoch: int):
+        """First verdict of a registry epoch landed: that barrier is now
+        collecting, so give it a deadline. Called from the registry's
+        record path on arbitrary threads — keeps to its own leaf lock.
+
+        `reg_epoch` is the registry epoch captured under the registry
+        lock when the opening verdict was recorded. The hook runs after
+        that lock is released, so it can be delayed past the barrier's
+        own resolution (all remaining verdicts land, _activate resets
+        the registry) — armed naively, its timer would then expire
+        against the NEXT epoch's untouched barrier and evict every
+        healthy worker. The token makes that impossible: a stale timer
+        is inert at fire time (token no longer matches the registry)
+        and is replaced outright when the new barrier really opens."""
+        if self._ready_timeout <= 0 or self._finished.is_set():
+            return
+        with self._watchdog_lock:
+            if self._watchdog is not None:
+                if self._watchdog_token == reg_epoch:
+                    return  # already armed for this barrier
+                self._watchdog.cancel()  # stale timer from a lost race
+            t = threading.Timer(self._ready_timeout,
+                                self._evict_stragglers, args=(reg_epoch,))
+            t.daemon = True
+            t.name = f"elastic-watchdog-r{reg_epoch}"
+            self._watchdog = t
+            self._watchdog_token = reg_epoch
+            t.start()
+
+    def _cancel_watchdog(self):
+        with self._watchdog_lock:
+            if self._watchdog is not None:
+                self._watchdog.cancel()
+                self._watchdog = None
+                self._watchdog_token = None
+
+    def _evict_stragglers(self, reg_epoch: int):
+        """Ready-deadline eviction: every assigned slot with no verdict
+        (READY/SUCCESS/FAILURE) after HOROVOD_ELASTIC_READY_TIMEOUT is
+        killed and recorded as failed, so the barrier fires, the wedged
+        host is blacklisted (it failed — the reporters recorded READY),
+        and survivors re-mesh."""
+        with self._watchdog_lock:
+            if self._watchdog_token != reg_epoch:
+                return  # superseded (or cancelled) while firing
+            self._watchdog = None  # this timer just fired
+            self._watchdog_token = None
+        with self._lock:
+            if self._finished.is_set() or reg_epoch != self.registry.epoch:
+                return  # that barrier already resolved
+            verdicts = self.registry.verdicts()
+            missing = [k for k in self._assignments
+                       if f"{k[0]}:{k[1]}" not in verdicts]
+            if not missing:
+                return
+            stragglers = []
+            for key in missing:
+                rec = self._workers.get(key)
+                stragglers.append((key, rec))
+        for key, rec in stragglers:
+            host, idx = key
+            logger.error(
+                "evicting worker %s:%d: no verdict %.0fs after the reset "
+                "barrier opened (HOROVOD_ELASTIC_READY_TIMEOUT)",
+                host, idx, self._ready_timeout)
+            self._m_evictions.inc()
+            self._note_failure()
+            if rec is not None and rec.proc.poll() is None:
+                try:
+                    rec.proc.kill()
+                except OSError:  # pragma: no cover - already gone
+                    pass
+            # Record the failure directly (epoch-guarded: the killed
+            # worker's own exit monitor may fire the barrier first, and
+            # this record must then be dropped, not pollute the next
+            # epoch): even a kill-proof wedge or an already-reaped
+            # process must not hold the barrier.
+            self.registry.record_failure(host, idx, epoch=reg_epoch)
 
     def _prune_dead_workers(self):
         for key in [k for k, w in self._workers.items()
@@ -213,30 +343,89 @@ class ElasticDriver:
             cur = self._workers.get(rec.key)
             if cur is rec:
                 del self._workers[rec.key]
+            # A stale process (superseded after an eviction/respawn) or
+            # an unassigned slot must not feed the CURRENT epoch's
+            # barrier — its verdict belongs to a previous incident.
+            stale = cur is not rec
+            assigned = rec.key in self._assignments
         if rc == 0:
-            if rec.key in self._assignments:
+            if assigned and not stale:
                 self.registry.record_success(host, idx)
             # else: worker exited after an INVALID row — expected.
         else:
             logger.warning("worker %s:%d exited with %d", host, idx, rc)
-            self.registry.record_failure(host, idx)
+            if assigned and not stale:
+                self._note_failure()
+                self.registry.record_failure(host, idx)
 
     # ------------------------------------------------------------------
     def _observe_put(self, key: str, value: bytes):
         """Rendezvous put hook: READY announcements from resetting
-        workers feed the registry barrier."""
+        workers feed the registry barrier, and liveness verdicts from
+        the coordinator worker's heartbeat monitor trigger the eviction
+        fast path — the driver blacklists the host that FAILED (named
+        in the verdict), not the host that reported it, and does not
+        have to wait out the full ready deadline."""
+        if key.startswith(VERDICT_KEY_PREFIX):
+            try:
+                epoch = int(key[len(VERDICT_KEY_PREFIX):])
+            except ValueError:
+                return
+            parsed = decode_verdict(value)
+            if parsed is None:
+                return
+            dead_rank, host, reason = parsed
+            self._on_liveness_verdict(epoch, dead_rank, host, reason)
+            return
         if key.startswith(READY_PREFIX):
             epoch_part, _, ident = key[len(READY_PREFIX):].partition("/")
             try:
                 epoch = int(epoch_part)
             except ValueError:
                 return
-            if epoch == self.epoch and ident:
+            if not ident:
+                return
+            # Registry token BEFORE the driver-epoch check: if _activate
+            # runs between them the check goes stale-and-fails; if it
+            # runs after, the token mismatch drops the record — either
+            # way a late READY (e.g. from a worker the watchdog already
+            # evicted) can never count toward the NEXT epoch's barrier.
+            reg_epoch = self.registry.epoch
+            if epoch == self.epoch:
                 host, _, idx = ident.rpartition(":")
                 try:
-                    self.registry.record_ready(host, int(idx))
+                    self.registry.record(
+                        f"{host}:{int(idx)}", READY, epoch=reg_epoch)
                 except ValueError:
                     pass
+
+    def _on_liveness_verdict(self, epoch: int, dead_rank: int, host: str,
+                             reason: str):
+        with self._lock:
+            if self._finished.is_set() or epoch != self.epoch:
+                return  # stale verdict from a pre-reset mesh
+            reg_epoch = self.registry.epoch
+            target = None
+            for key, slot in self._assignments.items():
+                if slot.rank == dead_rank:
+                    target = (key, self._workers.get(key))
+                    break
+        if target is None:
+            return
+        (thost, idx), rec = target
+        already = self.registry.verdicts().get(f"{thost}:{idx}")
+        if already == FAILURE:
+            return
+        logger.error("liveness verdict for rank %d (%s:%d): %s — evicting",
+                     dead_rank, thost, idx, reason)
+        self._m_evictions.inc()
+        self._note_failure()
+        if rec is not None and rec.proc.poll() is None:
+            try:
+                rec.proc.kill()
+            except OSError:  # pragma: no cover - already gone
+                pass
+        self.registry.record_failure(thost, idx, epoch=reg_epoch)
 
     def _notify_workers(self, update_res: int):
         """Ping every live worker's notification endpoint
@@ -263,6 +452,7 @@ class ElasticDriver:
     # ------------------------------------------------------------------
     def stop(self):
         self.finish(self.exit_code if self.exit_code is not None else 1)
+        self._cancel_watchdog()
         with self._lock:
             workers = list(self._workers.values())
             self._workers.clear()

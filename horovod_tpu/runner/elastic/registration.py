@@ -28,31 +28,92 @@ class WorkerStateRegistry:
         self._reset_count = 0
         self._reset_limit = reset_limit
         self._world: int = 0
+        self._expected: Optional[set] = None
         self._epoch = 0
+        # One barrier action per epoch: a late verdict landing after the
+        # barrier already fired (e.g. the watchdog evicted a slot AND
+        # its process then died) must not re-run blacklist/resume.
+        self._acted = False
 
-    def reset(self, world_size: int):
+    def reset(self, world_size: int, expected=None):
         """New epoch: expect `world_size` verdicts before acting
-        (ref: registration.py:56 barrier resize)."""
+        (ref: registration.py:56 barrier resize). `expected` (a set of
+        "host:local_rank" keys) scopes the barrier: verdicts for keys
+        outside it are dropped — a worker evicted in the previous epoch
+        whose process dies a beat later must not count toward (or
+        instantly trip) the new, smaller barrier."""
         with self._lock:
             self._states = {}
             self._world = world_size
+            self._expected = set(expected) if expected is not None else None
             self._epoch += 1
+            self._acted = False
+
+    def verdicts(self) -> Dict[str, str]:
+        """Snapshot of this epoch's recorded verdicts (the ready-timeout
+        watchdog diffs it against the assignments to find the slots that
+        never answered)."""
+        with self._lock:
+            return dict(self._states)
+
+    @property
+    def epoch(self) -> int:
+        """Barrier-epoch token: capture it with a verdict snapshot and
+        pass it back to record() so a verdict computed against one
+        barrier can never pollute the next one (the eviction path races
+        the evicted worker's own exit monitor — whichever fires the
+        barrier first resets the epoch, and the loser's record must be
+        dropped)."""
+        with self._lock:
+            return self._epoch
 
     @property
     def reset_count(self) -> int:
         return self._reset_count
 
-    def record(self, key: str, state: str):
+    def record(self, key: str, state: str, epoch: Optional[int] = None):
         """Record a slot's verdict; the last verdict triggers the barrier
-        action (ref: registration.py:113-172)."""
+        action (ref: registration.py:113-172). `epoch` (from the
+        `epoch` property) makes the record conditional on the barrier it
+        was computed against."""
+        opener = None
+        opener_token = 0
+        fire: Optional[Dict[str, str]] = None
         with self._lock:
             if self._driver.finished:
                 return
+            if epoch is not None and epoch != self._epoch:
+                return  # stale verdict from a barrier that already fired
+            if self._expected is not None and key not in self._expected:
+                return  # slot not part of this epoch's barrier
+            opened = not self._states
             self._states[key] = state
             logger.debug("worker %s -> %s (%d/%d)", key, state,
                          len(self._states), self._world)
-            if len(self._states) >= self._world:
-                self._barrier_action()
+            if opened:
+                # First verdict of the epoch: the barrier is collecting.
+                # The driver arms the ready-deadline watchdog so a slot
+                # that never answers (wedged worker) is evicted and the
+                # barrier is guaranteed to fire (docs/elastic.md
+                # "Recovery-time guarantees").
+                opener = getattr(self._driver, "_on_barrier_opened", None)
+                # Token captured under the lock: the hook runs outside
+                # it and may be delayed past this barrier's resolution —
+                # the driver must know WHICH barrier it belongs to.
+                opener_token = self._epoch
+            if len(self._states) >= self._world and not self._acted:
+                self._acted = True
+                fire = dict(self._states)
+        # Driver callouts run OUTSIDE the registry lock: the barrier
+        # action takes the driver lock (finish/resume), and the driver's
+        # eviction paths take the driver lock before querying the
+        # registry (epoch/verdicts) — calling out while holding this
+        # lock is an AB-BA deadlock between the watchdog timer and the
+        # evicted worker's exit monitor.
+        if opener is not None:
+            opener(opener_token)
+        if fire is not None:
+            self._barrier_action(fire)
 
     def record_ready(self, host: str, local_rank: int):
         self.record(f"{host}:{local_rank}", READY)
@@ -60,12 +121,12 @@ class WorkerStateRegistry:
     def record_success(self, host: str, local_rank: int):
         self.record(f"{host}:{local_rank}", SUCCESS)
 
-    def record_failure(self, host: str, local_rank: int):
-        self.record(f"{host}:{local_rank}", FAILURE)
+    def record_failure(self, host: str, local_rank: int,
+                       epoch: Optional[int] = None):
+        self.record(f"{host}:{local_rank}", FAILURE, epoch=epoch)
 
     # ------------------------------------------------------------------
-    def _barrier_action(self):
-        states = dict(self._states)
+    def _barrier_action(self, states: Dict[str, str]):
         succeeded = [k for k, v in states.items() if v == SUCCESS]
         failed = [k for k, v in states.items() if v == FAILURE]
 
@@ -77,11 +138,14 @@ class WorkerStateRegistry:
             self._driver.finish(1)
             return
         # Partial failure → blacklist failing hosts and resume with the
-        # survivors (ref: registration.py:132-172).
-        for key in failed:
-            host = key.rsplit(":", 1)[0]
+        # survivors (ref: registration.py:132-172). Each host once per
+        # barrier: N failed slots on one host are ONE failure for the
+        # cooldown-escalation ladder, or a single bad epoch on a
+        # multi-slot host would jump straight to permanent.
+        for host in {key.rsplit(":", 1)[0] for key in failed}:
             self._hosts.blacklist(host)
-        self._reset_count += 1
+        with self._lock:
+            self._reset_count += 1
         if self._reset_limit is not None and self._reset_count > self._reset_limit:
             logger.error(
                 "reset limit %d exceeded; stopping job", self._reset_limit

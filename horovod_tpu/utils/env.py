@@ -59,6 +59,40 @@ CONNECT_ATTEMPTS = "HOROVOD_CONNECT_ATTEMPTS"
 CONNECT_BACKOFF = "HOROVOD_CONNECT_BACKOFF_SECONDS"
 CONNECT_BACKOFF_CAP = "HOROVOD_CONNECT_BACKOFF_CAP_SECONDS"
 
+# -- liveness plane knobs (docs/fault_tolerance.md) --------------------
+# Cadence of the always-on heartbeat plane: workers beat the coordinator
+# and the coordinator acks every worker on this interval, over the
+# existing control sockets (a dedicated frame tag, so heartbeats cost
+# nothing on the data path). 0 disables the liveness plane entirely.
+HEARTBEAT_INTERVAL = "HOROVOD_HEARTBEAT_INTERVAL_SECONDS"
+# A rank silent (no heartbeat AND no frames of any kind) for more than
+# miss_limit x interval is declared dead: the coordinator broadcasts a
+# tensor-less ERROR response naming it (the stall-abort path), so
+# detection is bounded even on an idle mesh with
+# HOROVOD_TCP_TIMEOUT_SECONDS=0. Workers symmetrically declare the
+# coordinator dead on missing acks. 0 disables dead declarations.
+HEARTBEAT_MISS_LIMIT = "HOROVOD_HEARTBEAT_MISS_LIMIT"
+# Elastic driver: a reset barrier slot with no verdict (READY/SUCCESS/
+# FAILURE) after this many seconds is evicted — the worker is killed and
+# recorded as failed — so the barrier ALWAYS fires and survivors
+# re-mesh. 0 disables the watchdog (the pre-liveness behavior: a wedged
+# worker parks every survivor forever).
+ELASTIC_READY_TIMEOUT = "HOROVOD_ELASTIC_READY_TIMEOUT"
+# Worker-side bound on waiting for a new topology epoch during an
+# elastic reset (refresh_topology_from_rendezvous).
+ELASTIC_RESET_TIMEOUT = "HOROVOD_ELASTIC_RESET_TIMEOUT"
+# Host blacklist cooldown: a host's FIRST failure blacklists it for this
+# many seconds (transient flake — the host gets another chance); a
+# repeat failure blacklists it permanently. 0 = permanent on the first
+# failure (the pre-cooldown behavior).
+BLACKLIST_COOLDOWN = "HOROVOD_BLACKLIST_COOLDOWN_SECONDS"
+
+DEFAULT_HEARTBEAT_INTERVAL_SECONDS = 5.0
+DEFAULT_HEARTBEAT_MISS_LIMIT = 6
+DEFAULT_ELASTIC_READY_TIMEOUT = 180.0
+DEFAULT_ELASTIC_RESET_TIMEOUT = 600.0
+DEFAULT_BLACKLIST_COOLDOWN_SECONDS = 600.0
+
 # -- pipelined execution knobs (docs/running.md) -----------------------
 # Number of concurrent executor channels the coordinator round-robins
 # non-fence responses over. Each rank executes a channel's responses in
@@ -181,6 +215,34 @@ def tcp_poll_seconds() -> float:
         # recv() could overshoot it.
         poll = min(poll, max(timeout / 4.0, 0.01))
     return max(poll, 0.01)
+
+
+def heartbeat_interval_seconds() -> float:
+    """Heartbeat cadence; 0 disables the liveness plane."""
+    return get_float(HEARTBEAT_INTERVAL, DEFAULT_HEARTBEAT_INTERVAL_SECONDS)
+
+
+def heartbeat_miss_limit() -> int:
+    """Silent intervals before a dead declaration; 0 disables."""
+    return get_int(HEARTBEAT_MISS_LIMIT, DEFAULT_HEARTBEAT_MISS_LIMIT)
+
+
+def heartbeat_enabled() -> bool:
+    return heartbeat_interval_seconds() > 0 and heartbeat_miss_limit() > 0
+
+
+def elastic_ready_timeout() -> float:
+    """Reset-barrier verdict deadline; 0 disables eviction."""
+    return get_float(ELASTIC_READY_TIMEOUT, DEFAULT_ELASTIC_READY_TIMEOUT)
+
+
+def elastic_reset_timeout() -> float:
+    return get_float(ELASTIC_RESET_TIMEOUT, DEFAULT_ELASTIC_RESET_TIMEOUT)
+
+
+def blacklist_cooldown_seconds() -> float:
+    """First-failure blacklist duration; 0 = permanent immediately."""
+    return get_float(BLACKLIST_COOLDOWN, DEFAULT_BLACKLIST_COOLDOWN_SECONDS)
 
 
 def num_channels() -> int:

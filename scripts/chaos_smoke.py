@@ -1,17 +1,27 @@
 #!/usr/bin/env python
-"""Chaos smoke: kill one of N local workers mid-step, by hand.
+"""Chaos smoke: kill — or wedge — one of N local workers mid-step.
 
-Reproduces the fault-tolerance acceptance scenario outside pytest
-(tests/test_fault_tolerance.py::test_chaos_kill_one_of_four_workers):
+Reproduces the fault-tolerance acceptance scenarios outside pytest:
 spawn N process-mode workers allreducing in a loop, arm a deterministic
-``kill:step=K`` fault-injection rule on one rank, and report how every
-survivor died. Success means every survivor exited through
-HorovodInternalError within 2x HOROVOD_TCP_TIMEOUT_SECONDS — no hang,
-no raw ConnectionError.
+fault-injection rule on one rank, and report how every survivor died.
+
+Default (kill) mode — tests/test_fault_tolerance.py's scenario: the
+doomed rank ``os._exit``\\s at a step; success means every survivor
+exited through HorovodInternalError within 2x
+``HOROVOD_TCP_TIMEOUT_SECONDS`` — no hang, no raw ConnectionError.
+
+``--wedge`` mode — tests/test_health.py's scenario: the doomed rank
+FREEZES (process alive, sockets open, heartbeats stop) with
+``HOROVOD_TCP_TIMEOUT_SECONDS=0`` (unbounded), the hang only the
+liveness plane can bound. Success means every survivor raised
+HorovodInternalError NAMING the wedged rank within
+``miss_limit x interval`` (+ slack), while the wedged process itself
+stayed alive until this script killed it.
 
     python scripts/chaos_smoke.py                 # 4 workers, kill rank 2 at step 3
     python scripts/chaos_smoke.py --np 8 --kill-rank 5 --kill-step 10
-    python scripts/chaos_smoke.py --timeout 2.0 --steps 100
+    python scripts/chaos_smoke.py --wedge         # wedge rank 2 instead
+    python scripts/chaos_smoke.py --wedge --hb-interval 0.5 --hb-miss 4
 """
 from __future__ import annotations
 
@@ -36,6 +46,13 @@ WORKER = textwrap.dedent("""
     from horovod_tpu.common.exceptions import HorovodInternalError
 
     STEPS = int(os.environ["CHAOS_STEPS"])
+    VERDICT = os.environ.get("CHAOS_VERDICT_FILE")
+
+    def verdict(text):
+        if VERDICT:
+            with open(VERDICT, "w") as f:
+                f.write(text)
+
     hvd.init()
     rank = hvd.rank()
     try:
@@ -45,12 +62,15 @@ WORKER = textwrap.dedent("""
             if step % 10 == 0:
                 print(f"rank {rank}: step {step}", flush=True)
         print(f"rank {rank}: completed all {STEPS} steps", flush=True)
+        verdict("completed")
         sys.exit(0)
     except HorovodInternalError as e:
         print(f"rank {rank}: HorovodInternalError: {e}", flush=True)
+        verdict(str(e))
         sys.exit(42)
     except ConnectionError as e:
         print(f"rank {rank}: RAW ConnectionError LEAKED: {e}", flush=True)
+        verdict(f"RAW: {e}")
         sys.exit(13)
 """)
 
@@ -59,12 +79,21 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--np", dest="np_", type=int, default=4,
                     help="world size (default 4)")
-    ap.add_argument("--kill-rank", type=int, default=2)
+    ap.add_argument("--kill-rank", type=int, default=2,
+                    help="rank to kill/wedge (default 2)")
     ap.add_argument("--kill-step", type=int, default=3)
     ap.add_argument("--steps", type=int, default=50,
                     help="total training steps per worker")
     ap.add_argument("--timeout", type=float, default=5.0,
-                    help="HOROVOD_TCP_TIMEOUT_SECONDS for the workers")
+                    help="HOROVOD_TCP_TIMEOUT_SECONDS for kill mode")
+    ap.add_argument("--wedge", action="store_true",
+                    help="wedge (freeze) the doomed rank instead of "
+                         "killing it, with unbounded TCP timeouts — "
+                         "exercises heartbeat detection")
+    ap.add_argument("--hb-interval", type=float, default=0.5,
+                    help="HOROVOD_HEARTBEAT_INTERVAL_SECONDS (wedge mode)")
+    ap.add_argument("--hb-miss", type=int, default=4,
+                    help="HOROVOD_HEARTBEAT_MISS_LIMIT (wedge mode)")
     args = ap.parse_args()
 
     from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
@@ -81,6 +110,7 @@ def main() -> int:
         slots = get_host_assignments(
             parse_hosts(f"localhost:{args.np_}"), args.np_)
         procs = {}
+        verdict_files = {}
         try:
             for slot in slots:
                 env = dict(os.environ)
@@ -88,57 +118,122 @@ def main() -> int:
                 env["PYTHONPATH"] = REPO
                 env["HVDRUN_FORCE_LOCAL"] = "1"
                 env["HOROVOD_CYCLE_TIME"] = "1"
-                env["HOROVOD_TCP_TIMEOUT_SECONDS"] = str(args.timeout)
                 env["CHAOS_STEPS"] = str(args.steps)
+                verdict_files[slot.rank] = os.path.join(
+                    td, f"verdict_{slot.rank}")
+                env["CHAOS_VERDICT_FILE"] = verdict_files[slot.rank]
                 env.pop("HOROVOD_FAULT_INJECT", None)
+                if args.wedge:
+                    # The headline scenario: UNBOUNDED socket I/O — only
+                    # the liveness plane bounds detection.
+                    env["HOROVOD_TCP_TIMEOUT_SECONDS"] = "0"
+                    env["HOROVOD_HEARTBEAT_INTERVAL_SECONDS"] = str(
+                        args.hb_interval)
+                    env["HOROVOD_HEARTBEAT_MISS_LIMIT"] = str(args.hb_miss)
+                else:
+                    env["HOROVOD_TCP_TIMEOUT_SECONDS"] = str(args.timeout)
                 if slot.rank == args.kill_rank:
-                    env["HOROVOD_FAULT_INJECT"] = f"kill:step={args.kill_step}"
+                    action = "wedge" if args.wedge else "kill"
+                    env["HOROVOD_FAULT_INJECT"] = \
+                        f"{action}:step={args.kill_step}"
                 procs[slot.rank] = subprocess.Popen(
                     [sys.executable, script], env=env)
-            print(f"spawned {args.np_} workers; rank {args.kill_rank} dies "
-                  f"at step {args.kill_step} "
-                  f"(timeout={args.timeout}s)", flush=True)
+            mode = "wedges" if args.wedge else "dies"
+            print(f"spawned {args.np_} workers; rank {args.kill_rank} "
+                  f"{mode} at step {args.kill_step}", flush=True)
 
-            t_death = None
-            deadline = time.monotonic() + 300
-            while time.monotonic() < deadline:
-                if procs[args.kill_rank].poll() is not None:
-                    t_death = time.monotonic()
-                    break
-                time.sleep(0.1)
-            if t_death is None:
-                print("FAIL: doomed worker never died", flush=True)
-                return 2
-            print(f"rank {args.kill_rank} died "
-                  f"(exit {procs[args.kill_rank].returncode})", flush=True)
-
-            budget = 2 * args.timeout + 30
-            ok = True
-            for rank, proc in sorted(procs.items()):
-                if rank == args.kill_rank:
-                    continue
-                remaining = budget - (time.monotonic() - t_death)
-                try:
-                    proc.wait(timeout=max(remaining, 1.0))
-                except subprocess.TimeoutExpired:
-                    print(f"FAIL: rank {rank} HUNG past {budget:.0f}s",
-                          flush=True)
-                    ok = False
-                    continue
-                verdict = {42: "clean HorovodInternalError",
-                           0: "completed (died pre-mesh?)",
-                           13: "RAW ConnectionError (FORBIDDEN)"}.get(
-                               proc.returncode, "unexpected")
-                print(f"rank {rank}: exit {proc.returncode} ({verdict})",
-                      flush=True)
-                ok = ok and proc.returncode == 42
-            print("PASS" if ok else "FAIL", flush=True)
-            return 0 if ok else 1
+            if args.wedge:
+                return run_wedge(args, procs, verdict_files)
+            return run_kill(args, procs)
         finally:
             for p in procs.values():
                 if p.poll() is None:
                     p.kill()
             server.stop()
+
+
+def run_kill(args, procs) -> int:
+    t_death = None
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if procs[args.kill_rank].poll() is not None:
+            t_death = time.monotonic()
+            break
+        time.sleep(0.1)
+    if t_death is None:
+        print("FAIL: doomed worker never died", flush=True)
+        return 2
+    print(f"rank {args.kill_rank} died "
+          f"(exit {procs[args.kill_rank].returncode})", flush=True)
+
+    budget = 2 * args.timeout + 30
+    ok = True
+    for rank, proc in sorted(procs.items()):
+        if rank == args.kill_rank:
+            continue
+        remaining = budget - (time.monotonic() - t_death)
+        try:
+            proc.wait(timeout=max(remaining, 1.0))
+        except subprocess.TimeoutExpired:
+            print(f"FAIL: rank {rank} HUNG past {budget:.0f}s",
+                  flush=True)
+            ok = False
+            continue
+        verdict = {42: "clean HorovodInternalError",
+                   0: "completed (died pre-mesh?)",
+                   13: "RAW ConnectionError (FORBIDDEN)"}.get(
+                       proc.returncode, "unexpected")
+        print(f"rank {rank}: exit {proc.returncode} ({verdict})",
+              flush=True)
+        ok = ok and proc.returncode == 42
+    print("PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+def run_wedge(args, procs, verdict_files) -> int:
+    window = args.hb_interval * args.hb_miss
+    # Survivors must fail within the detection window (+ generous slack
+    # for oversubscribed CI boxes); the wedged process must stay ALIVE.
+    budget = window + 60
+    deadline = time.monotonic() + 120 + budget
+    ok = True
+    rows = []
+    for rank, proc in sorted(procs.items()):
+        if rank == args.kill_rank:
+            continue
+        try:
+            proc.wait(timeout=max(deadline - time.monotonic(), 1.0))
+        except subprocess.TimeoutExpired:
+            rows.append((rank, "HUNG", "survivor hung past the "
+                         "heartbeat window (liveness plane broken)"))
+            ok = False
+            continue
+        msg = ""
+        try:
+            with open(verdict_files[rank]) as f:
+                msg = f.read()
+        except OSError:
+            pass
+        named = f"rank {args.kill_rank}" in msg and "declared dead" in msg
+        clean = proc.returncode == 42
+        rows.append((rank, f"exit {proc.returncode}",
+                     msg if msg else "(no verdict written)"))
+        ok = ok and clean and named
+    if procs[args.kill_rank].poll() is not None:
+        print(f"FAIL: wedged rank {args.kill_rank} DIED "
+              f"(exit {procs[args.kill_rank].returncode}) — a wedge must "
+              "keep the process alive", flush=True)
+        ok = False
+    else:
+        print(f"wedged rank {args.kill_rank} is alive and frozen, as "
+              "intended (killing it now)", flush=True)
+
+    print(f"\nper-rank verdicts (window {window:.1f}s = "
+          f"{args.hb_miss} x {args.hb_interval:g}s):", flush=True)
+    for rank, status, msg in rows:
+        print(f"  rank {rank}: {status}: {msg}", flush=True)
+    print("PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -17,6 +17,9 @@ python scripts/telemetry_smoke.py
 echo "=== data-plane perf smoke (2-worker loopback, exact byte accounting) ==="
 python scripts/perf_smoke.py
 
+echo "=== elastic recovery smoke (wedge 1 of 4, survivors resume at np=3) ==="
+python scripts/elastic_smoke.py
+
 echo "=== multichip sharding dryrun (8 virtual devices) ==="
 python __graft_entry__.py
 

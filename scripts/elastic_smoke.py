@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Elastic-recovery smoke: wedge 1 of 4 elastic workers, assert the
+survivors resume at np=3 within the deadline.
+
+The CI-runnable version of the liveness-plane acceptance scenario
+(tests/test_health.py::test_chaos_wedge_elastic_recovery_and_hang_control,
+minus the hang control): four local workers under a real ElasticDriver,
+``HOROVOD_TCP_TIMEOUT_SECONDS=0`` (unbounded), one worker FREEZES
+mid-step (``wedge`` fault rule: process alive, sockets open, heartbeats
+stop). The heartbeat plane must declare it dead, the driver must evict
+its slot at the ready deadline and blacklist its host, and the three
+survivors must finish training at np=3 — all inside ``--deadline``
+seconds.
+
+    python scripts/elastic_smoke.py
+    python scripts/elastic_smoke.py --wedge-host hostA --deadline 180
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = textwrap.dedent("""
+    import os, pickle, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.backend.elastic_env import spawn_identity
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+    from horovod_tpu.common import fault_injection
+    from horovod_tpu.elastic.state import ObjectState
+    from horovod_tpu.utils import env as env_cfg
+
+    TOTAL = int(os.environ["SMOKE_TOTAL_BATCHES"])
+    hvd.init()
+    state = ObjectState(batch=0, history=[])
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < TOTAL:
+            hvd.allreduce(np.ones(2, np.float32), name="g")
+            fault_injection.advance_step()   # the doomed worker wedges here
+            state.history.append((hvd.rank(), hvd.size()))
+            state.batch += 1
+            state.commit()
+            time.sleep(0.05)
+        return list(state.history)
+
+    hist = train(state)
+    rdv = RendezvousClient(env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR),
+                           env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0))
+    rdv.put("smoke_results", spawn_identity(), pickle.dumps(hist))
+    print(f"worker {spawn_identity()} done as rank {hvd.rank()} "
+          f"size {hvd.size()}", flush=True)
+""")
+
+HOSTS = ["hostA", "hostB", "hostC", "hostD"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--wedge-host", default="hostC",
+                    help="logical host whose worker wedges (default hostC)")
+    ap.add_argument("--wedge-step", type=int, default=3)
+    ap.add_argument("--batches", type=int, default=12)
+    ap.add_argument("--deadline", type=float, default=240.0,
+                    help="wall-clock bound on the whole scenario")
+    ap.add_argument("--hb-interval", type=float, default=0.5)
+    ap.add_argument("--hb-miss", type=int, default=4)
+    ap.add_argument("--ready-timeout", type=float, default=8.0,
+                    help="HOROVOD_ELASTIC_READY_TIMEOUT for the driver")
+    args = ap.parse_args()
+
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.launch import slot_env, spawn_worker
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    os.environ["HVDRUN_FORCE_LOCAL"] = "1"
+    os.environ["HOROVOD_ELASTIC_READY_TIMEOUT"] = str(args.ready_timeout)
+    server = RendezvousServer()
+    port = server.start()
+    driver = ElasticDriver(server, FixedHosts({h: 1 for h in HOSTS}),
+                           min_np=2, max_np=4, poll_interval=0.25)
+
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+
+        def create_worker(slot, extra_env):
+            env = slot_env(slot, "127.0.0.1", port, elastic=True)
+            env.update(extra_env)
+            env["PYTHONPATH"] = REPO
+            env["HVDRUN_FORCE_LOCAL"] = "1"
+            env["HOROVOD_CYCLE_TIME"] = "1"
+            env["HOROVOD_TCP_TIMEOUT_SECONDS"] = "0"   # unbounded: the point
+            env["HOROVOD_HEARTBEAT_INTERVAL_SECONDS"] = str(args.hb_interval)
+            env["HOROVOD_HEARTBEAT_MISS_LIMIT"] = str(args.hb_miss)
+            env["SMOKE_TOTAL_BATCHES"] = str(args.batches)
+            env.pop("HOROVOD_FAULT_INJECT", None)
+            if slot.hostname == args.wedge_host:
+                env["HOROVOD_FAULT_INJECT"] = f"wedge:step={args.wedge_step}"
+            handle = spawn_worker(slot, [sys.executable, script], env,
+                                  prefix_output=False)
+            return handle.proc
+
+        t0 = time.monotonic()
+        try:
+            driver.start(create_worker)
+            code = driver.wait(timeout=args.deadline)
+            elapsed = time.monotonic() - t0
+            if code != 0:
+                print(f"FAIL: driver exit {code} after {elapsed:.0f}s "
+                      f"(None = still hung at the deadline)", flush=True)
+                return 1
+            survivors = [h for h in HOSTS if h != args.wedge_host]
+            ok = True
+            for h in survivors:
+                blob = server.handle_get(f"smoke_results/{h}:0")
+                if blob is None:
+                    print(f"FAIL: survivor {h} reported no result",
+                          flush=True)
+                    ok = False
+                    continue
+                hist = pickle.loads(blob)
+                final_np = hist[-1][1]
+                print(f"{h}: finished batch {len(hist)} at np={final_np}",
+                      flush=True)
+                ok = ok and final_np == 3
+            if not driver.host_manager.blacklist_strikes(args.wedge_host):
+                print(f"FAIL: wedged host {args.wedge_host} was never "
+                      "blacklisted", flush=True)
+                ok = False
+            print(f"recovered and finished at np=3 in {elapsed:.0f}s "
+                  f"(deadline {args.deadline:.0f}s)" if ok else "FAIL",
+                  flush=True)
+            print("PASS" if ok else "FAIL", flush=True)
+            return 0 if ok else 1
+        finally:
+            driver.stop()
+            server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -178,6 +178,237 @@ def test_reset_limit_enforced():
         driver.stop()
 
 
+def test_host_manager_blacklist_cooldown_then_escalation(monkeypatch):
+    """First failure parks the host for the cooldown; a repeat failure
+    is permanent (ISSUE 5: cooldown-with-escalation instead of the old
+    forever-set)."""
+    monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_SECONDS", "0.2")
+    d = FixedHosts({"a": 1, "b": 1})
+    m = HostManager(d)
+    m.update_available_hosts()
+    m.blacklist("a")
+    assert m.is_blacklisted("a")
+    assert [h for h, _ in m.current_hosts] == ["b"]
+    # Cooldown expires: the host is eligible again.
+    deadline = time.monotonic() + 5
+    while m.is_blacklisted("a") and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not m.is_blacklisted("a")
+    assert [h for h, _ in m.current_hosts] == ["a", "b"]
+    assert m.blacklist_strikes("a") == 1
+    # Second strike: permanent.
+    m.blacklist("a")
+    time.sleep(0.3)
+    assert m.is_blacklisted("a")
+    assert m.blacklist_strikes("a") == 2
+
+
+def test_host_manager_cooldown_expiry_is_an_added_update(monkeypatch):
+    """The discovery loop only re-assigns on a non-NO_UPDATE result, so
+    a lapsed cooldown must surface as ADDED: the recovered host is
+    filtered out of the previous view (pre-prune blacklist) but present
+    in the new one — otherwise a driver parked on "not enough slots"
+    never sees the host come back."""
+    monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_SECONDS", "0.2")
+    m = HostManager(FixedHosts({"a": 1, "b": 1}))
+    m.update_available_hosts()
+    m.blacklist("a")
+    assert m.update_available_hosts() == HostUpdateResult.NO_UPDATE
+    time.sleep(0.3)
+    assert m.update_available_hosts() == HostUpdateResult.ADDED
+    assert m.update_available_hosts() == HostUpdateResult.NO_UPDATE
+
+
+def test_registry_driver_callouts_run_outside_registry_lock():
+    """The barrier action and barrier-opened hook call into the driver,
+    whose eviction paths take the driver lock BEFORE querying
+    registry.epoch/verdicts — so record() must never hold the registry
+    lock across a driver callout (AB-BA deadlock between the watchdog
+    timer and the evicted worker's exit monitor)."""
+    observed = []
+
+    class _D:
+        finished = False
+
+        def _probe(self):
+            # Mirrors the driver's lock order: driver-side code under
+            # its own lock queries the registry. If record() called us
+            # with the registry lock held, this acquire would fail.
+            acquired = reg._lock.acquire(blocking=False)
+            if acquired:
+                reg._lock.release()
+            observed.append(acquired)
+
+        def _on_barrier_opened(self, reg_epoch):
+            self._probe()
+
+        def finish(self, code):
+            self._probe()
+
+        def resume(self):
+            self._probe()
+
+    class _H:
+        def blacklist(self, host):
+            pass
+
+    reg = WorkerStateRegistry(_D(), _H())
+    reg.reset(2)
+    reg.record_ready("a", 0)       # barrier-opened hook
+    reg.record_failure("b", 0)     # barrier action -> resume
+    assert len(observed) == 2 and all(observed)
+
+
+def test_host_manager_blacklist_permanent_with_zero_cooldown(monkeypatch):
+    monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_SECONDS", "0")
+    m = HostManager(FixedHosts({"a": 1}))
+    m.update_available_hosts()
+    m.blacklist("a")
+    time.sleep(0.1)
+    assert m.is_blacklisted("a")  # the pre-cooldown behavior
+
+
+def test_registry_one_barrier_action_per_epoch():
+    """A late verdict landing after the barrier fired (evicted slot's
+    process dying afterwards) must not re-trigger blacklist/resume."""
+    actions = []
+
+    class _D:
+        finished = False
+
+        def finish(self, code):
+            actions.append(("finish", code))
+
+        def resume(self):
+            actions.append(("resume",))
+
+    class _H:
+        def blacklist(self, host):
+            actions.append(("blacklist", host))
+
+    reg = WorkerStateRegistry(_D(), _H())
+    reg.reset(2)
+    reg.record_ready("a", 0)
+    reg.record_failure("b", 0)     # barrier fires: blacklist b + resume
+    assert actions == [("blacklist", "b"), ("resume",)]
+    reg.record_failure("b", 0)     # late duplicate: no second action
+    assert actions == [("blacklist", "b"), ("resume",)]
+
+
+def test_driver_ready_timeout_evicts_wedged_slot(monkeypatch):
+    """3 hosts; b's worker dies, a announces READY, c never answers
+    (wedged). The ready-deadline watchdog must kill c's worker, record
+    it failed, fire the barrier, blacklist b AND c, and resume with a —
+    the barrier can never park forever (ISSUE 5)."""
+    monkeypatch.setenv("HOROVOD_ELASTIC_READY_TIMEOUT", "0.5")
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 1, "b": 1, "c": 1}, 1, 3)
+    driver.start(create)
+    try:
+        procs[("b", 0)].exit(1)          # first verdict arms the watchdog
+        server.handle_put("ready_e0/a:0", b"1")
+        # c:0 stays silent -> evicted at the deadline.
+        deadline = time.monotonic() + 10
+        while driver.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.epoch >= 1, "barrier never fired"
+        assert driver.host_manager.is_blacklisted("b")
+        assert driver.host_manager.is_blacklisted("c")
+        assert procs[("c", 0)].poll() is not None, "wedged worker not killed"
+        assert driver._m_evictions.value >= 1
+        e = driver.epoch
+        row = server.handle_get(f"rank_and_size_e{e}/a:0")
+        assert row is not None and row.decode().startswith("0,1,")
+        assert not driver.finished
+    finally:
+        driver.stop()
+
+
+def test_driver_stale_barrier_opened_hook_never_evicts_healthy_epoch(
+        monkeypatch):
+    """record() invokes the barrier-opened hook OUTSIDE the registry
+    lock, so the hook can be delayed past the barrier's own resolution
+    (remaining verdicts land, _activate resets the registry). A stale
+    hook must not arm a ready deadline that later expires against the
+    NEXT epoch's untouched barrier — that would evict every healthy
+    worker on an idle mesh — and a genuine opening of the new barrier
+    must replace any stale timer."""
+    monkeypatch.setenv("HOROVOD_ELASTIC_READY_TIMEOUT", "0.3")
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 1, "b": 1}, 1, 2)
+    driver.start(create)
+    try:
+        evictions_before = driver._m_evictions.value  # process-wide counter
+        # A hook carrying the token of a barrier that already resolved.
+        driver._on_barrier_opened(driver.registry.epoch - 1)
+        time.sleep(0.8)  # well past the deadline
+        assert driver._m_evictions.value == evictions_before
+        assert all(p.poll() is None for p in procs.values())
+        assert not driver.finished
+        # The stale timer (fired inert) does not shadow a real opening.
+        driver._on_barrier_opened(driver.registry.epoch - 1)
+        driver._on_barrier_opened(driver.registry.epoch)
+        assert driver._watchdog_token == driver.registry.epoch
+    finally:
+        driver.stop()
+
+
+def test_driver_liveness_verdict_fast_path_evicts(monkeypatch):
+    """A health/verdict_e<epoch> KV put from the coordinator's monitor
+    names the dead rank: the driver kills that worker and records the
+    failure immediately — blacklisting the host that FAILED, not the
+    one that reported."""
+    monkeypatch.setenv("HOROVOD_ELASTIC_READY_TIMEOUT", "60")  # not the path
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 1, "b": 1, "c": 1}, 1, 3)
+    driver.start(create)
+    try:
+        # Coordinator (rank 0 on a) declares rank 2 (c's worker) dead.
+        server.handle_put(
+            "health/verdict_e0",
+            b"2|c|rank 2 (host c) declared dead by rank 0: no heartbeat")
+        deadline = time.monotonic() + 5
+        while procs[("c", 0)].poll() is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert procs[("c", 0)].poll() is not None, "verdict did not evict"
+        # Survivors announce ready; the barrier completes normally.
+        server.handle_put("ready_e0/a:0", b"1")
+        server.handle_put("ready_e0/b:0", b"1")
+        deadline = time.monotonic() + 5
+        while driver.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.epoch >= 1
+        assert driver.host_manager.is_blacklisted("c")
+        assert not driver.host_manager.is_blacklisted("a")
+        assert not driver.host_manager.is_blacklisted("b")
+        # A stale verdict (old epoch) is ignored.
+        server.handle_put("health/verdict_e0", b"0|a|stale")
+        time.sleep(0.3)
+        assert not driver.host_manager.is_blacklisted("a")
+    finally:
+        driver.stop()
+
+
+def test_driver_recovery_duration_histogram(monkeypatch):
+    """failure -> re-meshed activation is observed into
+    horovod_elastic_recovery_seconds."""
+    server, discovery, driver, procs, create = make_driver(
+        {"a": 1, "b": 1}, 1, 2)
+    driver.start(create)
+    try:
+        before = driver._m_recovery.count
+        procs[("b", 0)].exit(1)
+        server.handle_put("ready_e0/a:0", b"1")
+        deadline = time.monotonic() + 5
+        while driver.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.epoch >= 1
+        assert driver._m_recovery.count == before + 1
+    finally:
+        driver.stop()
+
+
 def test_registry_invalid_worker_exit_not_counted():
     """A worker that exits 0 after receiving an INVALID row must not be
     recorded as a SUCCESS verdict for the new epoch."""
