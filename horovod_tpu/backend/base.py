@@ -20,6 +20,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..common import tracing
 from ..common.types import ReduceOp
 from ..engine.controller import ControllerTransport
 
@@ -84,6 +85,12 @@ class Backend(ControllerTransport):
     # MPIHierarchicalAllgather) — set by the engine from the collectively
     # agreed topology validity.
     hier_allgather: bool = False
+    # Tracing plane (common/tracing.py): the engine installs its tracer
+    # here so backend phase spans (ring segment recv/reduce, star
+    # gather/bcast, TCP sender dwell) land in the same flight recorder
+    # as the engine's. Inert by default — a backend used outside an
+    # engine records nothing.
+    tracer: tracing.Tracer = tracing.NULL_TRACER
 
     def channel_scope(self, channel: int):
         """Context manager tagging this thread's data-plane traffic with

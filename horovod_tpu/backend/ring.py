@@ -482,6 +482,12 @@ class RingCollectivesMixin(StarCollectivesMixin):
             i %= n
             return flat[bounds[i]: bounds[i + 1]]
 
+        # Tracing-plane segment spans (docs/tracing.md): recv + reduce
+        # per pipeline segment, send completion per step. The wire time
+        # of the overlapped sends shows up as tcp.sender_dwell spans on
+        # the persistent sender's lane (tagged with this thread's trace
+        # scope, captured at enqueue).
+        tr = self.tracer
         for s in range(n - 1):
             send_c = chunk(pos - s)
             tgt = chunk(pos - s - 1)
@@ -492,11 +498,16 @@ class RingCollectivesMixin(StarCollectivesMixin):
             rb = self._segment_bounds(tgt.size, seg)
             for k, (a, b) in enumerate(zip(rb, rb[1:])):
                 half = scratch[(k % 2) * seg_cap:][: b - a]
-                self.recv_into_from(left, half)
+                with tr.span("ring.recv", cat="xfer",
+                             args={"bytes": (b - a) * flat.itemsize}):
+                    self.recv_into_from(left, half)
                 if b > a:
-                    _reduce_into(red, tgt[a:b], half)
-            for t in tickets:
-                t.wait()
+                    with tr.span("ring.reduce", cat="compute"):
+                        _reduce_into(red, tgt[a:b], half)
+            with tr.span("ring.send_wait", cat="xfer",
+                         args={"segments": len(tickets)}):
+                for t in tickets:
+                    t.wait()
 
     def _ring_allgather_chunks(self, group: List[int], flat: np.ndarray):
         """Ring allgather of the per-position chunks: position p starts
@@ -513,6 +524,7 @@ class RingCollectivesMixin(StarCollectivesMixin):
             i %= n
             return flat[bounds[i]: bounds[i + 1]]
 
+        tr = self.tracer
         for s in range(n - 1):
             send_c = chunk(pos - s + 1)
             tgt = chunk(pos - s)
@@ -522,9 +534,13 @@ class RingCollectivesMixin(StarCollectivesMixin):
             self._count_segments(len(tickets))
             rb = self._segment_bounds(tgt.size, seg)
             for a, b in zip(rb, rb[1:]):
-                self.recv_into_from(left, tgt[a:b])
-            for t in tickets:
-                t.wait()
+                with tr.span("ring.recv", cat="xfer",
+                             args={"bytes": (b - a) * flat.itemsize}):
+                    self.recv_into_from(left, tgt[a:b])
+            with tr.span("ring.send_wait", cat="xfer",
+                         args={"segments": len(tickets)}):
+                for t in tickets:
+                    t.wait()
 
     def _ring_allreduce_group(self, group: List[int], flat: np.ndarray,
                               op: ReduceOp):

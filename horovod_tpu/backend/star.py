@@ -92,16 +92,25 @@ class StarCollectivesMixin(Backend):
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         if self.size == 1:
             return arr.copy()
-        gathered = self.gather_bytes(pack_array(arr))
+        # Tracing-plane phase spans (docs/tracing.md): gather / reduce /
+        # bcast, inheriting the executor's trace scope so the merged
+        # trace shows which phase of WHICH collective ate the time.
+        tr = self.tracer
+        with tr.span("star.gather", cat="xfer",
+                     args={"bytes": int(arr.nbytes)}):
+            gathered = self.gather_bytes(pack_array(arr))
         if self.rank == 0:
-            arrays = [unpack_array(b) for b in gathered]
-            # Joined ranks contribute empty arrays == zeros
-            # (ref: JoinOp semantics, controller.cc:220-231).
-            nonempty = [a for a in arrays if a.size > 0]
-            out = _reduce(op, nonempty) if nonempty else arrays[0]
-            self.bcast_bytes(pack_array(out))
+            with tr.span("star.reduce", cat="compute"):
+                arrays = [unpack_array(b) for b in gathered]
+                # Joined ranks contribute empty arrays == zeros
+                # (ref: JoinOp semantics, controller.cc:220-231).
+                nonempty = [a for a in arrays if a.size > 0]
+                out = _reduce(op, nonempty) if nonempty else arrays[0]
+            with tr.span("star.bcast", cat="xfer"):
+                self.bcast_bytes(pack_array(out))
             return out.reshape(arr.shape) if arr.size else out
-        out = own_array(unpack_array(self.bcast_bytes(None)))
+        with tr.span("star.bcast", cat="xfer"):
+            out = own_array(unpack_array(self.bcast_bytes(None)))
         return out.reshape(arr.shape) if arr.size and out.size == arr.size else out
 
     def adasum_allreduce_all(self, arr: np.ndarray) -> np.ndarray:

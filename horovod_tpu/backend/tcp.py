@@ -62,8 +62,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..common import fault_injection
+from ..common import fault_injection, tracing
 from ..common.exceptions import HorovodInternalError, TransportError
+from ..utils import clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from ..utils.retry import call_with_retry
@@ -284,13 +285,19 @@ class _PeerSender:
 
     def send(self, payload, channel: int = CTRL_CHANNEL) -> _SendTicket:
         ticket = _SendTicket()
+        # Tracing: dwell = enqueue to wire-complete, measured across
+        # the thread hop. The trace id is captured on the CALLER's
+        # thread (the sender worker has no trace scope of its own),
+        # exactly like the channel tag.
+        t_enq = clock.mono_ns()
+        trace_id = tracing.current_trace()
         with self._lock:
             if self._closed:
                 ticket._done(TransportError(
                     f"sender for peer {self.peer} shut down"))
                 return ticket
             self.pending[channel] = self.pending.get(channel, 0) + 1
-            self.queue.put((payload, channel, ticket))
+            self.queue.put((payload, channel, ticket, t_enq, trace_id))
         return ticket
 
     def channel_idle(self, channel: int) -> bool:
@@ -317,7 +324,7 @@ class _PeerSender:
             item = self.queue.get()
             if item is _SENDER_STOP:
                 break
-            payload, channel, ticket = item
+            payload, channel, ticket, t_enq, trace_id = item
             try:
                 self._backend._peer_send_direct(self.peer, payload, channel)
             except BaseException as e:
@@ -330,6 +337,11 @@ class _PeerSender:
                 # itself after this frame.
                 self._frame_done(channel)
                 ticket._done()
+                tr = self._backend.tracer
+                if tr.enabled and channel != HEALTH_CHANNEL:
+                    tr.emit("tcp.sender_dwell", "xfer", t_enq,
+                            clock.mono_ns() - t_enq, trace_id=trace_id,
+                            args={"peer": self.peer, "channel": channel})
         # Belt-and-braces drain: _closed guarantees nothing lands after
         # the sentinel, but fail anything unexpectedly left anyway
         # rather than leave a waiter parked.

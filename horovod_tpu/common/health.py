@@ -47,13 +47,20 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
+from . import tracing
 
 logger = get_logger()
 
-# Heartbeat frame payload: <i rank> <B kind> <hostname utf-8...>
-_BEAT = struct.Struct("<iB")
+# Heartbeat frame payload:
+#   <i rank> <B kind> <q sent_ns> <q echo_ns> <q echo_recv_ns> <hostname...>
+# The three timestamps are the tracing plane's clock-offset estimator
+# (tracing.estimate_offset): sent_ns is the sender's monotonic stamp,
+# echo_ns is the receiver's own stamp the sender last saw, echo_recv_ns
+# is the sender's local receipt time of it. Zero = no sample yet.
+_BEAT = struct.Struct("<iBqqq")
 KIND_BEAT = 0   # worker -> coordinator
 KIND_ACK = 1    # coordinator -> worker
 
@@ -77,13 +84,16 @@ def decode_verdict(value: bytes) -> Optional[Tuple[int, str, str]]:
         return None
 
 
-def encode_beat(rank: int, kind: int, hostname: str) -> bytes:
-    return _BEAT.pack(rank, kind) + hostname.encode("utf-8", "replace")
+def encode_beat(rank: int, kind: int, hostname: str, sent_ns: int = 0,
+                echo_ns: int = 0, echo_recv_ns: int = 0) -> bytes:
+    return _BEAT.pack(rank, kind, sent_ns, echo_ns, echo_recv_ns) \
+        + hostname.encode("utf-8", "replace")
 
 
-def decode_beat(payload: bytes) -> Tuple[int, int, str]:
-    rank, kind = _BEAT.unpack_from(payload, 0)
-    return rank, kind, payload[_BEAT.size:].decode("utf-8", "replace")
+def decode_beat(payload: bytes) -> Tuple[int, int, str, int, int, int]:
+    rank, kind, sent_ns, echo_ns, echo_recv_ns = _BEAT.unpack_from(payload, 0)
+    return (rank, kind, payload[_BEAT.size:].decode("utf-8", "replace"),
+            sent_ns, echo_ns, echo_recv_ns)
 
 
 class FailureDetector:
@@ -168,6 +178,14 @@ class HeartbeatMonitor:
         self.detector = FailureDetector(self._watch, interval, miss_limit)
         self.peer_hosts: Dict[int, str] = {}
         self.verdicts: Dict[int, str] = {}
+        # Clock-offset estimation for the tracing plane (docs/
+        # tracing.md): each received beat/ack carries the sender's
+        # stamp plus an echo of ours, one NTP-style sample per
+        # exchange; the minimum-RTT sample bounds the alignment error
+        # by rtt/2, so it wins. peer -> (peer_sent_ns, local_recv_ns)
+        # feeds the echo of our next frame to that peer.
+        self._last_remote: Dict[int, Tuple[int, int]] = {}
+        self._offsets: Dict[int, Tuple[int, int]] = {}  # peer -> (off, rtt)
         self._first_declared: Optional[float] = None
         self._escalated = False
         self._stop = threading.Event()
@@ -224,6 +242,7 @@ class HeartbeatMonitor:
                 str(p): {
                     "age_seconds": round(ages.get(p, -1.0), 3),
                     "host": self.peer_hosts.get(p, ""),
+                    "clock_offset_ns": self._offsets.get(p, (None, 0))[0],
                 }
                 for p in self._watch
             },
@@ -235,13 +254,29 @@ class HeartbeatMonitor:
         """Runs on WHATEVER thread read the frame (demux reader, idle
         drain) — keep it to dict stores."""
         try:
-            rank, kind, host = decode_beat(payload)
+            rank, kind, host, sent_ns, echo_ns, echo_recv_ns = \
+                decode_beat(payload)
         except (struct.error, UnicodeDecodeError):  # pragma: no cover
             return
         self._m_recv.inc()
         if host:
             self.peer_hosts[peer] = host
+        now_ns = clock.mono_ns()
+        if sent_ns:
+            self._last_remote[peer] = (sent_ns, now_ns)
+            if echo_ns:
+                off, rtt = tracing.estimate_offset(
+                    sent_ns, echo_ns, echo_recv_ns, now_ns)
+                cur = self._offsets.get(peer)
+                if cur is None or rtt <= cur[1]:
+                    self._offsets[peer] = (off, rtt)
         self.detector.note(peer)
+
+    def clock_offsets(self) -> Dict[int, int]:
+        """Best (minimum-RTT) peer-clock offsets in ns: peer clock
+        minus this process's clock. The merged-trace renderer subtracts
+        them to put every rank's spans on one timebase."""
+        return {p: o for p, (o, _rtt) in self._offsets.items()}
 
     def _loop(self):
         from . import fault_injection
@@ -259,11 +294,17 @@ class HeartbeatMonitor:
 
     def _tick(self):
         kind = KIND_ACK if self.rank == 0 else KIND_BEAT
-        payload = encode_beat(self.rank, kind, self.hostname)
         # Beats/acks go out BEFORE any drain can stall (send_async only
         # enqueues): one peer wedged mid-frame must not starve the acks
-        # every other peer's detector depends on.
+        # every other peer's detector depends on. Per-peer payloads:
+        # each carries the echo of THAT peer's last stamp for the
+        # clock-offset estimator.
         for peer in self._watch:
+            echo_ns, echo_recv_ns = self._last_remote.get(peer, (0, 0))
+            payload = encode_beat(self.rank, kind, self.hostname,
+                                  sent_ns=clock.mono_ns(),
+                                  echo_ns=echo_ns,
+                                  echo_recv_ns=echo_recv_ns)
             try:
                 self.backend.send_async(
                     peer, payload, channel=_health_channel())

@@ -182,10 +182,18 @@ class Response:
     # channel in the same per-channel FIFO order, the ordering invariant
     # that keeps concurrent collectives from deadlocking.
     channel: int = 0
+    # Tracing-plane correlation id the coordinator assigned
+    # (common/tracing.py). Wire-carried like the channel id so every
+    # rank's spans for this collective — negotiation, queue dwell,
+    # executor run, backend phases — share one id in the merged trace.
+    # Cache-replayed responses use a deterministic per-rank replay
+    # sequence instead (odd id space; the cache fast path exchanges no
+    # per-response bytes).
+    trace_id: int = 0
 
     def serialize(self) -> bytes:
         out = struct.pack(
-            "<iiddiii",
+            "<iiddiiiq",
             int(self.response_type),
             int(self.tensor_type),
             self.prescale_factor,
@@ -193,6 +201,7 @@ class Response:
             self.last_joined_rank,
             self.reduce_op,
             self.channel,
+            self.trace_id,
         )
         out += struct.pack("<I", len(self.tensor_names))
         for n in self.tensor_names:
@@ -207,9 +216,9 @@ class Response:
 
     @staticmethod
     def deserialize(buf: bytes, off: int = 0) -> Tuple["Response", int]:
-        rt, tt, pre, post, ljr, rop, chan = struct.unpack_from(
-            "<iiddiii", buf, off)
-        off += struct.calcsize("<iiddiii")
+        rt, tt, pre, post, ljr, rop, chan, trace_id = struct.unpack_from(
+            "<iiddiiiq", buf, off)
+        off += struct.calcsize("<iiddiiiq")
         (n,) = struct.unpack_from("<I", buf, off)
         off += 4
         names = []
@@ -227,7 +236,8 @@ class Response:
             shapes.append(tuple(int(d) for d in shp))
         return (
             Response(ResponseType(rt), names, err, [int(d) for d in devices],
-                     sizes, DataType(tt), pre, post, ljr, shapes, rop, chan),
+                     sizes, DataType(tt), pre, post, ljr, shapes, rop, chan,
+                     trace_id),
             off,
         )
 

@@ -181,9 +181,18 @@ class _Handler(BaseHTTPRequestHandler):
                 status = srv.status_fn() if srv.status_fn else {}
                 self._send(200, json.dumps(status, indent=1, sort_keys=True),
                            "application/json")
+            elif self.path.startswith("/trace"):
+                # Merged Perfetto/Chrome trace: one process lane per
+                # rank, clock-aligned (docs/tracing.md). Save the body
+                # as a .json and open it in ui.perfetto.dev.
+                if srv.trace_fn is None:
+                    self._send(404, "tracing not served on this rank\n",
+                               "text/plain")
+                else:
+                    self._send(200, srv.trace_fn(), "application/json")
             else:
-                self._send(404, "not found: try /metrics, /metrics.json, /status\n",
-                           "text/plain")
+                self._send(404, "not found: try /metrics, /metrics.json, "
+                           "/status, /trace\n", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper hung up mid-response; nothing left to answer
         except Exception as e:  # a broken provider must not kill the server
@@ -204,10 +213,12 @@ class MetricsHTTPServer:
                  registry: Optional[telemetry.MetricsRegistry] = None,
                  fleet: Optional[telemetry.FleetView] = None,
                  status_fn: Optional[Callable[[], dict]] = None,
-                 addr: str = "127.0.0.1"):
+                 addr: str = "127.0.0.1",
+                 trace_fn: Optional[Callable[[], str]] = None):
         self.registry = registry or telemetry.default_registry()
         self.fleet = fleet
         self.status_fn = status_fn
+        self.trace_fn = trace_fn
         self._httpd = ThreadingHTTPServer((addr, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self  # type: ignore[attr-defined]
@@ -238,6 +249,7 @@ def start_exporters_from_env(
     fleet: Optional[telemetry.FleetView] = None,
     status_fn: Optional[Callable[[], dict]] = None,
     rank: int = 0,
+    trace_fn: Optional[Callable[[], str]] = None,
 ):
     """Start the exporters the environment asks for. Returns a list of
     started exporter objects (each has .stop()). The HTTP endpoint only
@@ -263,7 +275,8 @@ def start_exporters_from_env(
         addr = env_cfg.get_str(env_cfg.METRICS_ADDR, "127.0.0.1")
         try:
             started.append(MetricsHTTPServer(
-                port, registry, fleet, status_fn=status_fn, addr=addr
+                port, registry, fleet, status_fn=status_fn, addr=addr,
+                trace_fn=trace_fn,
             ).start())
         except OSError as e:
             logger.warning("metrics endpoint on port %d failed to start: %s",

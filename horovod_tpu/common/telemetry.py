@@ -319,12 +319,17 @@ def histogram(name: str, help: str = "", labels: LabelDict = None,
 # ---------------------------------------------------------------------------
 # Cross-rank aggregation (coordinator side).
 
-def encode_push(registry: MetricsRegistry, rank: int) -> bytes:
-    """Scalar snapshot blob a rank piggybacks on its RequestList."""
-    return json.dumps(
-        {"rank": rank, "time": time.time(), "metrics": registry.scalars()},
-        separators=(",", ":"),
-    ).encode("utf-8")
+def encode_push(registry: MetricsRegistry, rank: int,
+                extra: Optional[dict] = None) -> bytes:
+    """Scalar snapshot blob a rank piggybacks on its RequestList.
+    `extra` merges additional top-level keys into the JSON — the
+    tracing plane rides its span batches ("spans" + "anchor") here so
+    trace collection reuses the gather the metrics sync already pays
+    for (common/tracing.py TraceCollector)."""
+    doc = {"rank": rank, "time": time.time(), "metrics": registry.scalars()}
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, separators=(",", ":")).encode("utf-8")
 
 
 class FleetView:

@@ -24,11 +24,12 @@ from __future__ import annotations
 
 import struct
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..common import tracing
 from ..common.exceptions import TransportError
 from ..common.message import (
     Request,
@@ -39,6 +40,7 @@ from ..common.message import (
     ResponseType,
 )
 from ..common.types import DataType, ReduceOp, dtype_size
+from ..utils import clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from .response_cache import CacheState, ResponseCache
@@ -115,7 +117,7 @@ class _TensorRecord:
 
 class Controller:
     def __init__(self, transport: ControllerTransport, size: int, rank: int,
-                 timeline=None, registry=None):
+                 timeline=None, registry=None, tracer=None):
         from ..common import telemetry
 
         # Coordinator-side timeline hook: negotiation phases are only
@@ -160,6 +162,32 @@ class Controller:
         # part of the cached Response on every rank), which keeps the
         # per-channel FIFO identical everywhere.
         self._next_channel = 0
+        # -- tracing plane (common/tracing.py, docs/tracing.md) --------
+        # Negotiated responses get a coordinator-assigned trace id
+        # carried on the Response wire message (even id space);
+        # cache-replayed responses use a deterministic per-rank replay
+        # sequence (odd space — every rank emits the same cached set in
+        # the same order, so local counters agree without wire bytes).
+        self.tracer: Optional[tracing.Tracer] = tracer
+        self._trace_seq = 0
+        self._replay_seq = 0
+        # Rank 0 accumulates every rank's span batches (piggybacked on
+        # the telemetry push) for the merged /trace view.
+        self.trace_collector = (
+            tracing.TraceCollector(size) if self.is_coordinator else None)
+        self._trace_cursor = 0
+        # Per-tensor request-arrival stamps (coordinator): feed the
+        # NEGOTIATE span and the straggler attribution gauges — the
+        # rank whose request lands last is the one everyone waited for.
+        self._arrivals: Dict[str, Dict[int, int]] = {}
+        self._neg_spans: Dict[str, Tuple[int, int, int]] = {}
+        if self.is_coordinator:
+            self._m_straggler = self.registry.gauge(
+                "horovod_straggler_rank",
+                "Rank whose request arrived last for the most recently "
+                "negotiated collective (-1 before the first)")
+            self._m_straggler.set(-1)
+            self._m_neg_wait: Dict[int, object] = {}
 
     # ------------------------------------------------------------------
     def compute_response_list(
@@ -269,11 +297,18 @@ class Controller:
             # Emit cached responses common to all ranks, in stable bit
             # order. A joined rank emits them too — it must take part in
             # the data plane (with zero contributions) or peers block.
+            # Each replay gets a fresh trace id from the deterministic
+            # replay sequence (identical on every rank: same bits, same
+            # order, same counter) — a shallow copy, so the cached
+            # entry itself stays untouched.
             for bit in sorted(common_bits):
                 if bit in self._pending_cached or (
                     self.joined and self.response_cache.has_bit(bit)
                 ):
-                    responses.append(self.response_cache.get_response_by_bit(bit))
+                    resp = self.response_cache.get_response_by_bit(bit)
+                    self._replay_seq += 1
+                    responses.append(replace(
+                        resp, trace_id=(self._replay_seq << 1) | 1))
                     self._pending_cached.pop(bit, None)
                     self.response_cache.count_hit()
         else:
@@ -291,10 +326,20 @@ class Controller:
                 from ..common import telemetry as _telemetry
 
                 self._last_metrics_push = time.monotonic()
+                # Tracing piggyback: new flight-recorder events since
+                # the last push ride the same blob, so trace collection
+                # costs no extra control round (docs/tracing.md).
+                extra = None
+                if self.tracer is not None and self.tracer.enabled:
+                    evs, self._trace_cursor = \
+                        self.tracer.recorder.batch_since(self._trace_cursor)
+                    extra = {"spans": evs, "anchor": clock.anchor_meta()}
                 req_list.telemetry = _telemetry.encode_push(
-                    self.registry, self.rank)
+                    self.registry, self.rank, extra=extra)
             try:
-                gathered = self.transport.gather_bytes(req_list.serialize())
+                with self._span("ctrl.gather"):
+                    gathered = self.transport.gather_bytes(
+                        req_list.serialize())
             except TransportError as exc:
                 if not self.is_coordinator:
                     raise
@@ -314,8 +359,13 @@ class Controller:
                 joined_before = len(self.joined_ranks)
                 for peer_rank, payload in enumerate(gathered):
                     rl = RequestList.deserialize(payload)
-                    if rl.telemetry is not None and self.fleet is not None:
-                        self.fleet.ingest(rl.telemetry, rank_hint=peer_rank)
+                    if rl.telemetry is not None:
+                        if self.fleet is not None:
+                            self.fleet.ingest(rl.telemetry,
+                                              rank_hint=peer_rank)
+                        if self.trace_collector is not None:
+                            self.trace_collector.ingest_blob(
+                                peer_rank, rl.telemetry)
                     shutdown = shutdown or rl.shutdown
                     for req in rl.requests:
                         if req.request_type == RequestType.JOIN:
@@ -359,13 +409,15 @@ class Controller:
                     negotiated.append(Response(
                         ResponseType.ERROR, [], error_message=stall_reason
                     ))
+                self._assign_trace_ids(negotiated)
                 # Broadcast only the negotiated responses; every rank
                 # prepends its (identical) cached fast-path list locally.
                 try:
-                    self.transport.bcast_bytes(
-                        ResponseList(negotiated,
-                                     shutdown=shutdown).serialize()
-                    )
+                    with self._span("ctrl.bcast"):
+                        self.transport.bcast_bytes(
+                            ResponseList(negotiated,
+                                         shutdown=shutdown).serialize()
+                        )
                 except TransportError:
                     # Same contract as the cache-verdict broadcast: the
                     # dead peer is severed, survivors received the
@@ -373,7 +425,9 @@ class Controller:
                     pass
                 resp_list = ResponseList(responses + negotiated, shutdown)
             else:
-                recv = ResponseList.deserialize(self.transport.bcast_bytes(None))
+                with self._span("ctrl.bcast"):
+                    recv = ResponseList.deserialize(
+                        self.transport.bcast_bytes(None))
                 resp_list = ResponseList(responses + recv.responses, recv.shutdown)
             # Populate cache from negotiated responses on every rank so
             # cache bit assignment stays rank-consistent.
@@ -541,6 +595,72 @@ class Controller:
             self._next_channel = (self._next_channel + 1) % bulk
 
     # ------------------------------------------------------------------
+    # tracing plane (docs/tracing.md)
+    def _span(self, name: str):
+        t = self.tracer
+        if t is None:
+            return tracing.NOOP_SPAN
+        return t.span(name, cat=tracing.CAT_NEGOTIATE)
+
+    def _assign_trace_ids(self, responses: List[Response]):
+        """Coordinator: stamp every negotiated response (fences and
+        errors included) with a fresh trace id — carried on the wire,
+        so every rank's spans for this collective share it — and emit
+        the NEGOTIATE span (first request arrival → ready) under that
+        id, naming the straggler."""
+        for resp in responses:
+            self._trace_seq += 1
+            resp.trace_id = self._trace_seq << 1
+            if self.tracer is None or not self.tracer.enabled:
+                continue
+            info = None
+            for n in resp.tensor_names:
+                info = self._neg_spans.pop(n, None) or info
+            if info is not None:
+                first, last, straggler = info
+                self.tracer.emit(
+                    "negotiate", tracing.CAT_NEGOTIATE, first,
+                    max(last - first, 0), trace_id=resp.trace_id,
+                    args={"tensors": len(resp.tensor_names),
+                          "straggler": straggler})
+
+    def collect_local(self):
+        """Fold this rank's newest flight-recorder events into the
+        collector (rank 0 render-time freshness; the collector dedups
+        by sequence number, so overlap with the push path is free)."""
+        if self.trace_collector is None or self.tracer is None:
+            return
+        self.trace_collector.ingest(
+            self.rank, self.tracer.recorder.snapshot(), clock.anchor_meta())
+
+    def _note_negotiated(self, name: str):
+        """Straggler attribution for one ready tensor: per-rank
+        negotiation wait (how long the collective waited on each rank
+        past the first arrival) and the straggler gauge (the last
+        rank in). Gauges live on the coordinator's registry; the fleet
+        view redistributes them."""
+        arr = self._arrivals.pop(name, None)
+        if not arr:
+            return
+        if len(arr) < 2:
+            self._neg_spans[name] = (
+                next(iter(arr.values())), next(iter(arr.values())), -1)
+            return
+        first = min(arr.values())
+        last_rank = max(arr, key=arr.get)
+        for r, t in arr.items():
+            g = self._m_neg_wait.get(r)
+            if g is None:
+                g = self._m_neg_wait[r] = self.registry.gauge(
+                    "horovod_negotiation_wait_seconds",
+                    "How long the most recent collective's negotiation "
+                    "waited on this rank past the first request arrival",
+                    labels={"rank": str(r)})
+            g.set((t - first) / 1e9)
+        self._m_straggler.set(last_rank)
+        self._neg_spans[name] = (first, arr[last_rank], last_rank)
+
+    # ------------------------------------------------------------------
     def _telemetry_elapsed(self) -> float:
         return time.monotonic() - self._last_metrics_push
 
@@ -565,6 +685,8 @@ class Controller:
         if req.request_rank not in rec.ranks:
             rec.requests.append(req)
             rec.ranks.add(req.request_rank)
+            self._arrivals.setdefault(
+                req.tensor_name, {})[req.request_rank] = clock.mono_ns()
         self.stall_inspector.record(req.tensor_name, req.request_rank)
         return len(rec.ranks) == self.size - len(self.joined_ranks)
 
@@ -580,6 +702,7 @@ class Controller:
                 name, rec.requests[0].request_type.name
             )
         self.stall_inspector.remove(name)
+        self._note_negotiated(name)
         reqs = rec.requests
         first = reqs[0]
 

@@ -28,6 +28,7 @@ HOROVOD_CYCLE_TIME a max-coalescing delay instead of a latency floor.
 """
 from __future__ import annotations
 
+import os
 import queue as queue_mod
 import threading
 import time
@@ -35,10 +36,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common import telemetry
+from ..common import telemetry, tracing
 from ..common.exceptions import HorovodInternalError, TransportError
 from ..common.message import Request, RequestType, Response, ResponseType
 from ..common.types import ReduceOp, Status, StatusType, to_wire_dtype
+from ..utils import clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from .controller import Controller
@@ -157,6 +159,16 @@ class _ChannelExecutor:
                 # background loop, and a broken mesh can't serve them.
                 if eng._fatal_error is None:
                     self.current = list(resp.tensor_names)
+                    # Tracing: executor-queue dwell — dispatch to
+                    # pickup, the head-of-line wait the channel lanes
+                    # exist to bound.
+                    disp = getattr(resp, "_dispatch_ns", None)
+                    if disp is not None and eng.tracer.enabled:
+                        eng.tracer.emit(
+                            "exec.queue_wait", tracing.CAT_EXEC, disp,
+                            clock.mono_ns() - disp,
+                            trace_id=resp.trace_id,
+                            args={"channel": self.channel})
                     eng._perform_operation(resp)
             except HorovodInternalError as exc:
                 # _perform_operation already failed THIS response's
@@ -231,6 +243,12 @@ class Engine:
             "Seconds since the background loop last completed a cycle",
         ).set_function(self._last_cycle_age)
         self.handles = HandleManager()
+        # Tracing plane (common/tracing.py, docs/tracing.md): the
+        # always-on flight recorder behind the span API. Per-engine
+        # like the registry so the in-process multi-rank harness keeps
+        # per-"rank" recorders separable.
+        self.tracer = tracing.Tracer(registry=self.registry)
+        self._pm_dumped = False
         self.timeline = (Timeline(registry=self.registry) if rank == 0
                          else Timeline(use_env=False, registry=self.registry))
         self.cycle_time_s = env_cfg.cycle_time_ms() / 1000.0
@@ -349,6 +367,13 @@ class Engine:
                 "executing": list(cur) if cur else [],
             }
         st["channels"] = channels
+        # Tracing plane: recorder depth / drop count / last dump — the
+        # "is the flight recorder actually capturing" view.
+        trace = self.tracer.status()
+        ctrl0 = self.controller
+        if ctrl0 is not None and ctrl0.trace_collector is not None:
+            trace["collected"] = ctrl0.trace_collector.status()
+        st["trace"] = trace
         health = self._health
         if health is not None:
             st["health"] = health.status()
@@ -391,6 +416,7 @@ class Engine:
         self._exporters = metrics_export.start_exporters_from_env(
             registry=self.registry, fleet=fleet, status_fn=self.status,
             rank=self.rank,
+            trace_fn=(self._trace_json if self.rank == 0 else None),
         )
 
     def _background_loop(self):
@@ -409,9 +435,13 @@ class Engine:
                                           registry=self.registry)
             self.backend.set_topology(self.local_rank, self.local_size,
                                       self.cross_rank, self.cross_size)
+            # Backend phase spans (ring/star/TCP sender dwell) land in
+            # this engine's flight recorder.
+            self.backend.tracer = self.tracer
             self.controller = Controller(self.backend, self.size, self.rank,
                                          timeline=self.timeline,
-                                         registry=self.registry)
+                                         registry=self.registry,
+                                         tracer=self.tracer)
             from .parameter_manager import ParameterManager
 
             self.param_manager = ParameterManager(
@@ -496,6 +526,15 @@ class Engine:
             # executor's own error path), then join.
             if self._health is not None:
                 self._health.stop()
+            # Black-box stitching (rank 0): the per-rank flight dumps
+            # were written at latch time; merge whatever landed in
+            # HOROVOD_TRACE_DIR with the health verdict into one
+            # post-mortem before the process winds down.
+            if self.rank == 0 and self._fatal_error is not None:
+                try:
+                    self._stitch_post_mortem()
+                except Exception:  # pragma: no cover - best-effort
+                    logger.exception("post-mortem stitch failed")
             for ex in list(self._executors.values()):
                 ex.queue.put(_EXEC_STOP)
             if self.backend is not None:
@@ -518,11 +557,23 @@ class Engine:
         return ex
 
     def _latch_fatal(self, exc: HorovodInternalError):
+        first = False
         with self._inflight_cond:
             if self._fatal_error is None:
                 self._fatal_error = exc
+                first = True
             self._inflight_cond.notify_all()
         self._wake.set()
+        if first:
+            # Auto-dump the flight recorder the moment the FIRST cause
+            # latches (docs/tracing.md): the ring still holds the
+            # events leading up to the failure, and the dying loop's
+            # teardown (rank 0) stitches every rank's dump into the
+            # post-mortem. Outside the condvar — this writes a file.
+            try:
+                self._dump_post_mortem(exc)
+            except Exception:  # pragma: no cover - best-effort
+                logger.exception("flight-recorder dump failed")
 
     def _check_fatal(self):
         if self._fatal_error is not None:
@@ -546,6 +597,7 @@ class Engine:
             # executor discards the response and the dying loop's
             # finalize fails its entries, so accounting stays straight.
             self._inflight += 1
+        resp._dispatch_ns = clock.mono_ns()  # executor queue-wait span
         ex.queue.put(resp)
 
     def _drain_channels(self):
@@ -580,7 +632,7 @@ class Engine:
         reason = self._cycle_wait()
         self._m_wake[reason].inc()
         self._check_fatal()
-        cycle_t0 = time.monotonic()
+        cycle_t0 = clock.monotonic()
         self.timeline.mark_cycle()
         messages = self.tensor_queue.pop_messages_from_queue()
         want_shutdown = self._shutdown_requested.is_set()
@@ -655,7 +707,7 @@ class Engine:
         # Cycle work duration (waits excluded) + liveness stamp: the
         # last-cycle age gauge is how /status distinguishes "idle" from
         # "background loop wedged".
-        self._last_cycle_ts = time.monotonic()
+        self._last_cycle_ts = clock.monotonic()
         self._m_cycle.observe(self._last_cycle_ts - cycle_t0)
         if should_shutdown:
             # Clean shutdown (every rank agreed): a fence — in-flight
@@ -675,15 +727,33 @@ class Engine:
         response's channel scope, so every data-plane frame it moves is
         tagged with the channel and demultiplexes cleanly from
         concurrent collectives — and inline on the background thread
-        for fences (control-plane tagged)."""
+        for fences (control-plane tagged). The whole operation runs
+        inside the response's trace scope, so every backend span it
+        produces (ring segments, star phases, sender dwell) carries
+        the wire-assigned trace id."""
         scope = getattr(self.backend, "channel_scope", None)
-        if scope is None or resp.response_type in _FENCE_TYPES:
-            return self._execute_response(resp)
-        with scope(resp.channel):
-            return self._execute_response(resp)
+        with tracing.trace_scope(resp.trace_id), self.tracer.span(
+                f"exec.{resp.response_type.name.lower()}",
+                cat=tracing.CAT_EXEC,
+                args={"channel": resp.channel,
+                      "tensors": len(resp.tensor_names)}):
+            if scope is None or resp.response_type in _FENCE_TYPES:
+                return self._execute_response(resp)
+            with scope(resp.channel):
+                return self._execute_response(resp)
 
     def _execute_response(self, resp: Response):
         entries = self.tensor_queue.get_tensor_entries(resp.tensor_names)
+        if entries and self.tracer.enabled:
+            # Queue-dwell span: earliest enqueue of this response's
+            # tensors → execution start (inherits the trace scope set
+            # by _perform_operation).
+            now = clock.mono_ns()
+            t0 = min((e.enqueued_ns for e in entries if e.enqueued_ns),
+                     default=0)
+            if t0:
+                self.tracer.emit("queue.dwell", tracing.CAT_QUEUE, t0,
+                                 now - t0, args={"tensors": len(entries)})
         if resp.response_type != ResponseType.ERROR:
             self._record_response(
                 resp.response_type, len(entries),
@@ -712,27 +782,27 @@ class Engine:
                     op = self.op_manager.select(ResponseType.ALLGATHER,
                                                 nbytes=nbytes,
                                                 ndim=e.tensor.ndim)
-                    t0 = time.monotonic()
+                    t0 = clock.monotonic()
                     with self.timeline.activity(e.tensor_name, op.name):
                         out = op.execute(e.tensor, list(resp.tensor_sizes))
-                    self._observe_op(op.name, time.monotonic() - t0)
+                    self._observe_op(op.name, clock.monotonic() - t0)
                     self._finish(e, Status.OK(), out)
             elif resp.response_type == ResponseType.BROADCAST:
                 op = self.op_manager.select(ResponseType.BROADCAST)
                 for e in entries:
                     arr = e.tensor if self.rank == e.root_rank else None
-                    t0 = time.monotonic()
+                    t0 = clock.monotonic()
                     with self.timeline.activity(e.tensor_name, op.name):
                         out = op.execute(arr, e.root_rank)
-                    self._observe_op(op.name, time.monotonic() - t0)
+                    self._observe_op(op.name, clock.monotonic() - t0)
                     self._finish(e, Status.OK(), out)
             elif resp.response_type == ResponseType.ALLTOALL:
                 op = self.op_manager.select(ResponseType.ALLTOALL)
                 for e in entries:
-                    t0 = time.monotonic()
+                    t0 = clock.monotonic()
                     with self.timeline.activity(e.tensor_name, op.name):
                         out, recv_splits = op.execute(e.tensor, e.splits)
-                    self._observe_op(op.name, time.monotonic() - t0)
+                    self._observe_op(op.name, clock.monotonic() - t0)
                     e.output = out
                     self._finish(e, Status.OK(), (out, recv_splits))
             elif resp.response_type == ResponseType.BARRIER:
@@ -832,10 +902,10 @@ class Engine:
             ResponseType.ADASUM if adasum else ResponseType.ALLREDUCE,
             nbytes=buf.nbytes, reduce_op=rop,
         )
-        t0 = time.monotonic()
+        t0 = clock.monotonic()
         with self.timeline.activity(name0, op.name):
             red = op.execute(buf, rop, owned=owned)
-        self._observe_op(op.name, time.monotonic() - t0)
+        self._observe_op(op.name, clock.monotonic() - t0)
         if post != 1.0:
             red = _scale_np(red, post)
         if shapes is None:
@@ -931,6 +1001,7 @@ class Engine:
             root_rank=root_rank,
             callback=callback,
             splits=splits,
+            enqueued_ns=clock.mono_ns(),
         )
         status = self.tensor_queue.add_to_tensor_queue(entry, req)
         if not status.ok():
@@ -1004,6 +1075,95 @@ class Engine:
         )
 
     # ------------------------------------------------------------------
+    # tracing plane (docs/tracing.md)
+    def render_trace(self) -> dict:
+        """Merged Chrome/Perfetto document: one process lane per rank.
+        On the coordinator this folds every rank's collected span
+        batches (clock-aligned via the health plane's RTT offsets, or
+        wall anchors as the fallback); elsewhere it renders this rank's
+        own flight recorder."""
+        ctrl = self.controller
+        offsets = {}
+        health = self._health
+        if health is not None:
+            offsets = health.clock_offsets()
+        if ctrl is not None and ctrl.trace_collector is not None:
+            ctrl.collect_local()
+            segments = ctrl.trace_collector.segments(
+                offsets, clock.anchor_meta())
+        else:
+            segments = [{"rank": self.rank,
+                         "events": self.tracer.recorder.snapshot(),
+                         "anchor": clock.anchor_meta(), "offset_ns": 0}]
+        return tracing.render_chrome(
+            segments, base_ns=clock.MONO_ANCHOR_NS,
+            metadata={"horovod_trace": {
+                "rank": self.rank, "size": self.size,
+                "clock_offsets_ns": {str(k): v for k, v in offsets.items()},
+            }})
+
+    def _trace_json(self) -> str:
+        import json
+
+        return json.dumps(self.render_trace())
+
+    def _write_trace_file(self):
+        """HOROVOD_TRACE_FILE dump at shutdown: rank 0 writes the
+        merged trace; with `{rank}` in the path every rank writes its
+        own lane (useful without a coordinator to pull through)."""
+        path = env_cfg.trace_file()
+        if not path or not self.tracer.enabled:
+            return
+        if self.rank != 0 and "{rank}" not in path:
+            return
+        try:
+            doc = self.render_trace()
+            from ..utils import chrome_trace
+
+            out = path.replace("{rank}", str(self.rank))
+            chrome_trace.write_trace(
+                out, doc.pop("traceEvents"), metadata=doc)
+            self.tracer.last_dump = out
+            logger.info("merged trace written to %s", out)
+        except Exception:  # pragma: no cover - best-effort on teardown
+            logger.exception("trace file dump failed")
+
+    def _dump_post_mortem(self, exc: BaseException):
+        """Every rank's black box: on the first latched fatal error,
+        write the flight recorder (last HOROVOD_TRACE_BUFFER_EVENTS
+        events, clock anchor, health view, the attributed reason) to
+        HOROVOD_TRACE_DIR. No-op without a trace dir."""
+        trace_dir = env_cfg.trace_dir()
+        if (not trace_dir or not self.tracer.enabled
+                or not env_cfg.trace_dump_on_error() or self._pm_dumped):
+            return
+        self._pm_dumped = True
+        os.makedirs(trace_dir, exist_ok=True)
+        health = self._health.status() if self._health is not None else None
+        path = self.tracer.dump_flight(
+            tracing.flight_path(trace_dir, self.rank), self.rank,
+            extra={"reason": str(exc), "health": health})
+        logger.error("flight recorder dumped to %s", path)
+
+    def _stitch_post_mortem(self):
+        """Coordinator: merge every rank's flight dump + the health
+        verdict into HOROVOD_TRACE_DIR/postmortem.json (polling briefly
+        for ranks still writing theirs)."""
+        trace_dir = env_cfg.trace_dir()
+        if (not trace_dir or not self.tracer.enabled
+                or not env_cfg.trace_dump_on_error()):
+            return
+        health = self._health.status() if self._health is not None else None
+        out = tracing.stitch_post_mortem(
+            trace_dir,
+            verdict=str(self._fatal_error or ""),
+            health=health,
+            expect_ranks=self.size,
+        )
+        if out:
+            logger.error("post-mortem stitched to %s", out)
+
+    # ------------------------------------------------------------------
     def poll(self, handle: int) -> bool:
         return self.handles.poll(handle)
 
@@ -1017,6 +1177,9 @@ class Engine:
         self._wake.set()  # end any coalescing wait immediately
         self._thread.join(timeout=60)
         self._thread = None
+        # Trace file AFTER the loop died (the final negotiation rounds'
+        # span batches have been collected) but BEFORE exporters stop.
+        self._write_trace_file()
         for exp in self._exporters:
             try:
                 exp.stop()

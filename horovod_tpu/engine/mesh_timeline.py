@@ -23,9 +23,6 @@ Usage::
 """
 from __future__ import annotations
 
-import glob
-import gzip
-import json
 import os
 import re
 import shutil
@@ -33,6 +30,7 @@ import tempfile
 from contextlib import contextmanager
 from typing import List, Optional
 
+from ..utils import chrome_trace, clock
 from ..utils import env as env_cfg
 
 # XLA op-name fragments that identify cross-device communication.
@@ -77,7 +75,10 @@ class MeshTimeline:
 
     # ------------------------------------------------------------------
     def _splice(self, profile_dir: str):
-        events = _load_profiler_events(profile_dir)
+        # Shared glob/gzip/parse helper (utils/chrome_trace) — the same
+        # reader scripts/profile_step.py and the tracing plane's
+        # analyzers use.
+        events = chrome_trace.load_profiler_events(profile_dir)
         if events is None:
             return
         out: List[dict] = []
@@ -103,26 +104,9 @@ class MeshTimeline:
         out.append({"ph": "M", "name": "process_name",
                     "pid": _COLLECTIVE_LANE_PID,
                     "args": {"name": "ICI collectives"}})
-        with open(self.output_path, "w") as f:
-            json.dump({"traceEvents": out}, f)
-
-
-def _load_profiler_events(profile_dir: str) -> Optional[List[dict]]:
-    """Newest trace.json(.gz) under a jax.profiler output dir."""
-    paths = sorted(
-        glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
-                  recursive=True)
-        + glob.glob(os.path.join(profile_dir, "**", "*.trace.json"),
-                    recursive=True)
-    )
-    if not paths:
-        return None
-    path = paths[-1]
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:
-        data = json.load(f)
-    # A Chrome trace may be a top-level array rather than an object;
-    # data.get on a list raises before any default applies.
-    if isinstance(data, list):
-        return data
-    return data.get("traceEvents", [])
+        # The wall-clock identity of this process's host-trace origin
+        # rides along so the host timeline (engine/timeline.py, same
+        # anchor) can be laid next to these device lanes offline.
+        chrome_trace.write_trace(
+            self.output_path, out,
+            metadata={"horovod_clock": clock.anchor_meta()})

@@ -31,6 +31,9 @@ class TensorTableEntry:
     callback: Optional[Callable[[Status, Optional[np.ndarray]], None]] = None
     # Alltoall splits (ref: operations.cc:979-1042)
     splits: Optional[List[int]] = None
+    # Monotonic enqueue stamp (utils/clock): the tracing plane's
+    # queue-dwell span runs from here to execution start.
+    enqueued_ns: int = 0
 
 
 class TensorQueue:

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..utils import clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 
@@ -49,13 +50,16 @@ class Timeline:
         self._tid_lock = threading.Lock()
         self._writer: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._t0 = time.monotonic_ns()
         # A full writer queue drops events (the hot path must never block
         # on file IO) — but silently losing trace data made every
-        # truncated timeline look complete. Count the drops, shout once.
+        # truncated timeline look complete. Count the drops, shout once
+        # — through the tracing plane's shared drop counter, so one
+        # metric covers every trace output (docs/tracing.md).
         self._m_dropped = (registry or telemetry.default_registry()).counter(
-            "horovod_timeline_events_dropped_total",
-            "Timeline events dropped because the writer queue was full")
+            "horovod_trace_events_dropped_total",
+            "Trace events lost before reaching an output (flight-"
+            "recorder ring overwrites, timeline writer-queue drops)",
+            labels={"source": "timeline"})
         self._warned_drop = False
         if self.enabled:
             self._writer = threading.Thread(
@@ -64,7 +68,11 @@ class Timeline:
             self._writer.start()
 
     def _ts(self) -> float:
-        return (time.monotonic_ns() - self._t0) / 1e3  # microseconds
+        # Shared process anchor (utils/clock): this file's ts axis now
+        # lines up with the tracing plane's spans and — via the wall-
+        # clock identity in the metadata event — with mesh_timeline.py
+        # device lanes when spliced side by side.
+        return clock.trace_us(clock.mono_ns())  # microseconds
 
     def _tid(self, tensor_name: str) -> int:
         with self._tid_lock:
@@ -85,7 +93,7 @@ class Timeline:
                 logger.warning(
                     "timeline writer queue is full; dropping events (the "
                     "trace will have gaps — see "
-                    "horovod_timeline_events_dropped_total)")
+                    'horovod_trace_events_dropped_total{source="timeline"})')
 
     # -- per-tensor state machine (ref: timeline.h:81-126) --------------
     def negotiate_start(self, name: str, op_name: str):
@@ -140,7 +148,14 @@ class Timeline:
     def _write_loop(self):
         with open(self.filename, "w") as f:
             f.write("[\n")
-            first = True
+            # Clock-anchor metadata event first: the wall-clock identity
+            # of this file's t=0, so offline tools can splice it against
+            # the mesh timeline's device lanes (or another process's
+            # host lanes) on a common axis.
+            f.write(json.dumps({"ph": "M", "name": "horovod_clock",
+                                "pid": 0, "tid": 0,
+                                "args": clock.anchor_meta()}))
+            first = False
             while not self._stop.is_set() or not self._q.empty():
                 try:
                     ev = self._q.get(timeout=0.1)

@@ -1,0 +1,68 @@
+"""Shared monotonic/wall clock anchor for every trace producer.
+
+The repo has three timestamp producers that must splice into one
+Perfetto view: the host-side Chrome-trace timeline (engine/timeline.py),
+the distributed tracing plane's flight recorder (common/tracing.py), and
+the XLA profiler's device lanes (engine/mesh_timeline.py). Before this
+module each held its own ``time.monotonic_ns()`` origin, so two files
+captured in the same process disagreed about where t=0 was and lanes
+could not be laid side by side.
+
+One process-wide anchor fixes that: ``MONO_ANCHOR_NS`` /
+``WALL_ANCHOR_NS`` are captured once at import, every host trace event's
+``ts`` is microseconds since the SAME monotonic anchor (``trace_us``),
+and ``anchor_meta()`` stamps the wall-clock identity of that anchor into
+each output file so offline tools (and the mesh-timeline splicer) can
+align files from different processes — or device lanes with their own
+epoch — via wall time.
+
+Cross-RANK alignment is a different problem (different machines,
+different clocks) and is solved by the liveness plane's NTP-style
+offset estimation (common/health.py clock_offsets); this module only
+guarantees that everything inside one process agrees with itself.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+# Captured once per process; every host-side trace ts derives from it.
+MONO_ANCHOR_NS: int = time.monotonic_ns()
+WALL_ANCHOR_NS: int = time.time_ns()
+
+
+def mono_ns() -> int:
+    """The one timestamp source for trace events and latency histograms."""
+    return time.monotonic_ns()
+
+
+def monotonic() -> float:
+    """Seconds variant for duration math feeding telemetry histograms."""
+    return time.monotonic_ns() / 1e9
+
+
+def anchor_ns() -> int:
+    return MONO_ANCHOR_NS
+
+
+def trace_us(ns: int) -> float:
+    """Chrome-trace ``ts``: microseconds since the process anchor."""
+    return (ns - MONO_ANCHOR_NS) / 1e3
+
+
+def mono_to_wall_ns(ns: int) -> int:
+    """Map a monotonic stamp to wall-clock ns via the shared anchor."""
+    return ns - MONO_ANCHOR_NS + WALL_ANCHOR_NS
+
+
+def anchor_meta() -> dict:
+    """Identity of this process's trace origin, embedded in every trace
+    file so offline tools can align files captured by different
+    processes (or splice in device lanes timed against wall clock)."""
+    return {
+        "mono_anchor_ns": MONO_ANCHOR_NS,
+        "wall_anchor_ns": WALL_ANCHOR_NS,
+        "pid": os.getpid(),
+        "host": os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname(),
+    }

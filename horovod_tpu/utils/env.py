@@ -125,6 +125,28 @@ DEFAULT_NUM_CHANNELS = 2
 MAX_CHANNELS = 16
 DEFAULT_LATENCY_CHANNEL_BYTES = 65536
 
+# -- tracing knobs (docs/tracing.md) -----------------------------------
+# Merged Perfetto/Chrome trace file rank 0 writes at shutdown (every
+# rank writes its own when the path contains `{rank}`). Unset = no file
+# (the /trace endpoint still serves the live merged view).
+TRACE_FILE = "HOROVOD_TRACE_FILE"
+# Directory for failure post-mortems: on an engine latch every rank
+# dumps its flight recorder here (flight_rank<r>.json) and the
+# coordinator stitches them with the health verdict into
+# postmortem.json. Unset = no dumps.
+TRACE_DIR = "HOROVOD_TRACE_DIR"
+# Capacity of the always-on in-memory flight recorder (events per
+# rank). The ring overwrites oldest events (counted in
+# horovod_trace_events_dropped_total{source="recorder"}); 0 disables
+# the tracing plane entirely (spans become no-ops).
+TRACE_BUFFER = "HOROVOD_TRACE_BUFFER_EVENTS"
+# Auto-dump the flight recorder to HOROVOD_TRACE_DIR when the engine
+# latches a fatal error (default on; the dump is a no-op without a
+# trace dir).
+TRACE_DUMP_ON_ERROR = "HOROVOD_TRACE_DUMP_ON_ERROR"
+
+DEFAULT_TRACE_BUFFER_EVENTS = 16384
+
 # -- telemetry knobs (docs/metrics.md) ---------------------------------
 # Serve Prometheus text at /metrics and live job state at /status from a
 # daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
@@ -275,6 +297,23 @@ def latency_channel_bytes() -> int:
 
 def cycle_event_driven() -> bool:
     return get_bool(CYCLE_EVENT, True)
+
+
+def trace_buffer_events() -> int:
+    """Flight-recorder ring capacity; 0 disables the tracing plane."""
+    return max(get_int(TRACE_BUFFER, DEFAULT_TRACE_BUFFER_EVENTS), 0)
+
+
+def trace_file() -> str:
+    return get_str(TRACE_FILE, "")
+
+
+def trace_dir() -> str:
+    return get_str(TRACE_DIR, "")
+
+
+def trace_dump_on_error() -> bool:
+    return get_bool(TRACE_DUMP_ON_ERROR, True)
 
 
 def metrics_sync_seconds() -> float:

@@ -14,6 +14,9 @@ python -m pytest tests/ -x -q -m slow
 echo "=== telemetry smoke (metrics endpoint + snapshot) ==="
 python scripts/telemetry_smoke.py
 
+echo "=== tracing smoke (merged /trace + post-mortem on injected sever) ==="
+python scripts/trace_smoke.py
+
 echo "=== data-plane perf smoke (2-worker loopback, exact byte accounting) ==="
 python scripts/perf_smoke.py
 

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Critical-path / straggler analysis over a merged horovod_tpu trace.
+
+Input: a Chrome/Perfetto trace produced by the tracing plane — the
+`/trace` endpoint body, a ``HOROVOD_TRACE_FILE`` dump, or a stitched
+``postmortem.json`` (docs/tracing.md). Every X event carries its
+collective's trace id in ``args.trace_id`` and its phase in ``cat``
+(negotiate / queue / exec / xfer / compute); the process lane (pid) is
+the rank.
+
+For each collective (trace id) the analyzer computes:
+
+* wall span (first event start -> last event end, clock-aligned);
+* per-phase attribution (how much of the span each category covered,
+  summed over ranks — where did the 40 ms go);
+* the straggler rank: the rank whose `exec.*` span finished last — the
+  rank everyone else's allgather/bcast waited on.
+
+The summary aggregates phase totals and names the worst stragglers
+(rank -> how many collectives it finished last, and by how much).
+
+    python scripts/critical_path.py trace.json
+    python scripts/critical_path.py postmortem.json --top 10
+    curl -s localhost:9099/trace | python scripts/critical_path.py -
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.utils import chrome_trace  # noqa: E402
+
+
+def load_events(path: str):
+    if path == "-":
+        doc = json.load(sys.stdin)
+    else:
+        doc = chrome_trace.read_trace_file(path)
+    return chrome_trace.trace_events(doc), doc
+
+
+def analyze(events, top: int = 5):
+    by_trace = collections.defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if not tid:
+            continue  # control-plane/heartbeat spans: no collective
+        by_trace[tid].append(e)
+
+    collectives = []
+    phase_totals = collections.Counter()
+    straggler_counts = collections.Counter()
+    straggler_margin_us = collections.Counter()
+    for trace_id, evs in by_trace.items():
+        t0 = min(e["ts"] for e in evs)
+        t1 = max(e["ts"] + e.get("dur", 0) for e in evs)
+        ranks = sorted({e.get("pid") for e in evs})
+        phases = collections.Counter()
+        for e in evs:
+            phases[e.get("cat", "?")] += e.get("dur", 0)
+            phase_totals[e.get("cat", "?")] += e.get("dur", 0)
+        # Straggler: the rank whose executor span ends last. Fall back
+        # to any span when a rank's exec events were overwritten.
+        exec_end = {}
+        for e in evs:
+            if str(e.get("name", "")).startswith("exec."):
+                end = e["ts"] + e.get("dur", 0)
+                r = e.get("pid")
+                exec_end[r] = max(exec_end.get(r, 0), end)
+        straggler = None
+        margin = 0.0
+        if len(exec_end) > 1:
+            ordered = sorted(exec_end.items(), key=lambda kv: kv[1])
+            straggler = ordered[-1][0]
+            margin = ordered[-1][1] - ordered[-2][1]
+            straggler_counts[straggler] += 1
+            straggler_margin_us[straggler] += margin
+        names = [e["name"] for e in evs
+                 if str(e.get("name", "")).startswith("exec.")
+                 and e["name"] != "exec.queue_wait"]
+        collectives.append({
+            "trace_id": trace_id,
+            "op": names[0] if names else "?",
+            "ranks": ranks,
+            "span_us": round(t1 - t0, 1),
+            "phases_us": {k: round(v, 1) for k, v in phases.most_common()},
+            "straggler_rank": straggler,
+            "straggler_margin_us": round(margin, 1),
+        })
+
+    collectives.sort(key=lambda c: -c["span_us"])
+    total = sum(phase_totals.values()) or 1.0
+    return {
+        "collectives_analyzed": len(collectives),
+        "phase_attribution_us": {
+            k: round(v, 1) for k, v in phase_totals.most_common()},
+        "phase_attribution_pct": {
+            k: round(100.0 * v / total, 1)
+            for k, v in phase_totals.most_common()},
+        "stragglers": {
+            str(r): {"times_last": n,
+                     "total_margin_us": round(straggler_margin_us[r], 1)}
+            for r, n in straggler_counts.most_common()},
+        "slowest": collectives[:top],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="merged trace JSON ('-' for stdin)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest collectives to detail")
+    args = ap.parse_args()
+    events, doc = load_events(args.trace)
+    out = analyze(events, top=args.top)
+    pm = doc.get("horovod_postmortem") if isinstance(doc, dict) else None
+    if pm:
+        out["postmortem_verdict"] = pm.get("verdict")
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -14,8 +14,6 @@ from __future__ import annotations
 
 import argparse
 import collections
-import glob
-import gzip
 import json
 import os
 import re
@@ -24,6 +22,8 @@ import sys
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.utils import chrome_trace  # noqa: E402
 
 
 def capture(model: str, batch: int, seq: int, chunk: int, outdir: str):
@@ -46,16 +46,15 @@ def capture(model: str, batch: int, seq: int, chunk: int, outdir: str):
 
 
 def aggregate(outdir: str, steps: int):
-    traces = sorted(glob.glob(
-        os.path.join(outdir, "plugins/profile/*/*.trace.json.gz")))
-    if not traces:
+    # Shared glob/gzip/parse helper (utils/chrome_trace) — one reader
+    # for this script, engine/mesh_timeline.py and the tracing plane.
+    events = chrome_trace.load_profiler_events(outdir)
+    if events is None:
         raise RuntimeError(
             f"no Chrome trace captured under {outdir} — the profiler "
             "plugin produced nothing (capture failed or unsupported on "
             "this device transport)"
         )
-    data = json.load(gzip.open(traces[-1]))
-    events = data["traceEvents"]
     device_pids = {
         e["pid"] for e in events
         if e.get("ph") == "M" and e.get("name") == "process_name"

@@ -397,12 +397,16 @@ def test_timeline_drop_counting_and_flush(tmp_path):
         tl.start(f"t{i % 3}", "ALLREDUCE")
         tl.end(f"t{i % 3}", "ALLREDUCE")
     tl.shutdown()
-    dropped = reg.snapshot()["horovod_timeline_events_dropped_total"]
+    # The timeline reports drops through the tracing plane's shared
+    # counter (one metric for every trace output), tagged by source.
+    dropped = reg.snapshot()[
+        'horovod_trace_events_dropped_total{source="timeline"}']
     written = json.loads(path.read_text())
     assert dropped > 0
-    # Everything not dropped reached the file: the writer drained the
-    # queue on shutdown instead of abandoning it.
-    assert len(written) + dropped == 10000
+    # Everything not dropped reached the file (+1: the leading
+    # clock-anchor metadata event): the writer drained the queue on
+    # shutdown instead of abandoning it.
+    assert len(written) + dropped == 10000 + 1
 
 
 class _CaptureHandler(__import__("logging").Handler):

@@ -30,7 +30,11 @@ def test_timeline_writes_valid_chrome_trace(tmp_path, monkeypatch):
     assert "ALLREDUCE" in names          # op phase
     assert any(n and n.startswith("NEGOTIATE") for n in names if n)
     assert "CYCLE" in names              # mark-cycles enabled
-    for e in events:
+    # The clock-anchor metadata event leads the file (the wall-clock
+    # identity of t=0, for splicing against mesh_timeline device lanes).
+    assert events[0]["ph"] == "M" and events[0]["name"] == "horovod_clock"
+    assert "wall_anchor_ns" in events[0]["args"]
+    for e in events[1:]:
         assert e["ph"] in ("B", "E", "i")
         assert "ts" in e and "tid" in e
 
@@ -57,7 +61,8 @@ def test_timeline_phase_nesting(tmp_path, monkeypatch):
              if e["ph"] == "B" and e["name"] == "NEGOTIATE_ALLREDUCE"]
     assert neg_b, events
     tid = neg_b[0]["tid"]
-    lane = [e for e in events if e["tid"] == tid]
+    lane = [e for e in events
+            if e.get("tid") == tid and e["ph"] != "M"]
 
     # Phase sequence on the lane: NEGOTIATE B ... rank instants ... E,
     # then op B ... activities ... E, with balanced B/E throughout.
