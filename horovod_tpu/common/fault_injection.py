@@ -41,10 +41,23 @@ Rule actions:
 ``hang``    the matching I/O (``peer=P``, ``after=K``, ``op=...``)
             parks forever — a single stuck network operation, with the
             rest of the process (heartbeats included) still running.
+``diskfail``raise ``OSError`` on the Nth matching disk I/O (checkpoint
+            shard writes, metrics dumps — everything routed through
+            ``utils/atomic_file.py``). Optional ``path=SUBSTR`` confines
+            it to paths containing the substring, ``op=read|write`` to
+            one direction (default: both), ``after=K`` to skip the
+            first K matches. The disk-full / yanked-NFS scenario the
+            durability plane (docs/checkpoint.md) must absorb without
+            ever committing a manifest referencing a missing shard.
+``diskslow``sleep ``secs=S`` before the matching disk I/O — a slow
+            (gcsfuse-cold, contended) store; checkpoint writes must
+            stay off the training thread and absorb this as latency,
+            not failure.
 
 Every rule may carry ``rank=R`` so one job-wide env var can target a
-single rank, and ``op=connect|send|recv`` to confine it to one hook
-(default: send+recv for sever/drop/delay).
+single rank, and ``op=connect|send|recv`` (network rules) or
+``op=read|write`` (disk rules) to confine it to one hook (default:
+send+recv for sever/drop/delay; read+write for disk rules).
 
 The harness is a no-op singleton when no rules are installed — the
 hooks cost one attribute check on the hot path.
@@ -84,15 +97,27 @@ class InjectedFault(ConnectionError):
     transport failure (→ TransportError → elastic recovery)."""
 
 
+class InjectedDiskFault(OSError):
+    """Raised by a diskfail rule; an OSError subclass so disk writers
+    exercise exactly their real-disk-error paths (retry, skip, count)."""
+
+
+_NET_ACTIONS = ("kill", "sever", "drop", "delay", "wedge", "hang")
+_DISK_ACTIONS = ("diskfail", "diskslow")
+
+
 @dataclass
 class Rule:
-    action: str                       # kill | sever | drop | delay | wedge | hang
+    action: str                       # kill | sever | drop | delay | wedge |
+                                      #   hang | diskfail | diskslow
     peer: Optional[int] = None        # None = any peer
     rank: Optional[int] = None        # None = any rank
-    op: Optional[str] = None          # connect | send | recv | None=both
+    op: Optional[str] = None          # net: connect|send|recv; disk:
+                                      #   read|write; None = default set
     after: int = 0                    # fire from the Nth matching I/O on
     step: Optional[int] = None        # kill trigger
     secs: float = 0.0                 # delay duration
+    path: Optional[str] = None        # disk rules: path substring match
     # mutable state: matching-I/O counter per rule
     hits: int = field(default=0, compare=False)
 
@@ -106,7 +131,7 @@ def parse_spec(spec: str) -> List[Rule]:
             continue
         fields = part.split(":")
         action = fields[0].strip().lower()
-        if action not in ("kill", "sever", "drop", "delay", "wedge", "hang"):
+        if action not in _NET_ACTIONS + _DISK_ACTIONS:
             raise ValueError(f"unknown fault action {action!r} in {part!r}")
         kw: Dict[str, str] = {}
         for f in fields[1:]:
@@ -119,9 +144,18 @@ def parse_spec(spec: str) -> List[Rule]:
             rule.peer = int(kw["peer"])
         if "rank" in kw:
             rule.rank = int(kw["rank"])
+        if "path" in kw:
+            if action not in _DISK_ACTIONS:
+                raise ValueError(
+                    f"path= applies to disk rules only (got {part!r})")
+            rule.path = kw["path"]
         if "op" in kw:
-            if kw["op"] not in ("connect", "send", "recv"):
-                raise ValueError(f"bad fault op {kw['op']!r}")
+            valid = (("read", "write") if action in _DISK_ACTIONS
+                     else ("connect", "send", "recv"))
+            if kw["op"] not in valid:
+                raise ValueError(
+                    f"bad fault op {kw['op']!r} for {action} "
+                    f"(expected one of {valid})")
             rule.op = kw["op"]
         if action == "drop" and kw.get("op") not in (None, "send"):
             # A recv cannot be "dropped" — the bytes either arrive or
@@ -137,8 +171,8 @@ def parse_spec(spec: str) -> List[Rule]:
             rule.secs = float(kw["secs"])
         if rule.action in ("kill", "wedge") and rule.step is None:
             raise ValueError(f"{rule.action} rule needs step=N: {part!r}")
-        if rule.action == "delay" and rule.secs <= 0:
-            raise ValueError(f"delay rule needs secs=S: {part!r}")
+        if rule.action in ("delay", "diskslow") and rule.secs <= 0:
+            raise ValueError(f"{rule.action} rule needs secs=S: {part!r}")
         rules.append(rule)
     return rules
 
@@ -272,7 +306,7 @@ class FaultInjector:
             self._load_env()
             verdict = PASS
             for r in self._rules:
-                if r.action in ("kill", "wedge"):
+                if r.action in ("kill", "wedge") or r.action in _DISK_ACTIONS:
                     continue
                 if r.rank is not None and r.rank != rank:
                     continue
@@ -315,6 +349,48 @@ class FaultInjector:
                          rank, op, peer)
             self._park_forever()
         return verdict
+
+    def check_disk(self, op: str, path: str):
+        """Hook for a disk writer/reader about to do `op`
+        ('read'|'write') on `path` (utils/atomic_file.py calls this on
+        every atomic write and checked read). diskslow sleeps; diskfail
+        raises InjectedDiskFault — an OSError, exactly what a real disk
+        error looks like to the caller."""
+        if not self.active:
+            return
+        if self._wedge_fired.is_set():
+            self._park_forever()
+        own_rank = env_cfg.get_int(env_cfg.RANK, -1)
+        sleep_s = 0.0
+        with self._lock:
+            self._load_env()
+            for r in self._rules:
+                if r.action not in _DISK_ACTIONS:
+                    continue
+                if r.rank is not None and r.rank != own_rank:
+                    continue
+                if r.op is not None and r.op != op:
+                    continue
+                if r.path is not None and r.path not in path:
+                    continue
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.action == "diskslow":
+                    _fault_counter("diskslow").inc()
+                    sleep_s += r.secs
+                else:
+                    _fault_counter("diskfail").inc()
+                    raise InjectedDiskFault(
+                        f"fault injection failed disk {op} of {path!r}")
+        # Sleep OUTSIDE the lock: disk I/O runs on background writer
+        # threads, and a slow-disk injection that held the shared lock
+        # would stall every network check_io hook — heartbeats
+        # included — turning a disk fault into false dead-peer
+        # declarations. (Network `delay` deliberately sleeps under the
+        # lock: it fires on the very I/O being delayed.)
+        if sleep_s > 0:
+            time.sleep(sleep_s)
 
 
 # The process-wide singleton the transports consult.

@@ -19,12 +19,12 @@ adds per engine cycle).
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from ..utils import atomic_file
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from . import telemetry
@@ -131,18 +131,13 @@ class MetricsFileWriter:
         return self
 
     def _dump(self):
-        tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "w") as f:
-                f.write(to_json(self.registry, self.fleet,
-                                extra={"rank": self.rank}))
-            os.replace(tmp, self.path)
+            atomic_file.atomic_write_text(
+                self.path,
+                to_json(self.registry, self.fleet,
+                        extra={"rank": self.rank}))
         except OSError as e:  # an unwritable path must not kill the job
             logger.warning("metrics file dump to %s failed: %s", self.path, e)
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
 
     def _loop(self):
         while not self._stop.wait(self.interval):

@@ -59,7 +59,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from ..utils import clock
+from ..utils import atomic_file, clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 
@@ -338,10 +338,8 @@ class Tracer:
         }
         if extra:
             doc.update(extra)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)
+        atomic_file.atomic_write(path, lambda f: json.dump(doc, f),
+                                 mode="w")
         self.last_dump = path
         return path
 
@@ -607,8 +605,5 @@ def stitch_post_mortem(trace_dir: str, verdict: str = "",
         },
     })
     out = os.path.join(trace_dir, out_name)
-    tmp = f"{out}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-    os.replace(tmp, out)
+    atomic_file.atomic_write(out, lambda f: json.dump(doc, f), mode="w")
     return out

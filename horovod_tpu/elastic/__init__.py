@@ -9,7 +9,9 @@ Worker loop semantics (ref: common/elastic.py:147-168):
            except HostsUpdatedInterrupt -> (commit is still valid);
            reset(): hvd.shutdown()+hvd.init(); state.on_reset() }
 """
+from ..common.checkpoint import CheckpointManager
 from .state import State, ObjectState, JaxState, TrainStateState
 from .run import run, run_fn
 
-__all__ = ["State", "ObjectState", "JaxState", "TrainStateState", "run", "run_fn"]
+__all__ = ["State", "ObjectState", "JaxState", "TrainStateState", "run",
+           "run_fn", "CheckpointManager"]

@@ -55,11 +55,39 @@ def run(func: Callable) -> Callable:
 
 
 def run_fn(func: Callable, state: State, *args, **kwargs):
-    """(ref: common/elastic.py:133-168)"""
+    """(ref: common/elastic.py:133-168)
+
+    With ``HOROVOD_CHECKPOINT_DIR`` set, the durability plane
+    (docs/checkpoint.md) wraps the loop: the newest complete durable
+    checkpoint is restored into `state` BEFORE the first sync — so a
+    job whose every rank died resumes at the last committed step — and
+    every ``state.commit()`` thereafter feeds the background shard
+    writer. The restore happens identically on every rank (all shards
+    are read from shared storage), so the first ``state.sync()``
+    broadcast confirms rather than repairs."""
     from ..backend.elastic_env import notification_manager
+    from ..common import checkpoint
 
     notification_manager.init()
     notification_manager.register_listener(state)
+    ckpt_mgr = checkpoint.manager_from_env()
+    if ckpt_mgr is not None and not state.supports_durability():
+        # A state without the hooks would commit (empty) checkpoints it
+        # could never load back — crashing a RESTART instead of this
+        # run. Loudly off is strictly better.
+        logger.warning(
+            "HOROVOD_CHECKPOINT_DIR is set but %s implements no "
+            "durability hooks (checkpoint_objects/checkpoint_trees/"
+            "load_checkpoint); durable checkpointing is disabled",
+            type(state).__name__)
+        ckpt_mgr = None
+    if ckpt_mgr is not None:
+        checkpoint.set_current(ckpt_mgr)
+        state.set_checkpoint_manager(ckpt_mgr)
+        restored = ckpt_mgr.restore_latest(state)
+        if restored is not None:
+            logger.info("resuming from durable checkpoint at step %d",
+                        restored)
     skip_sync = False
     try:
         while True:
@@ -78,5 +106,19 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
                 skip_sync = e.skip_sync
             _reset()
             state.on_reset()
+            if ckpt_mgr is not None:
+                # Counters are per-rank private state; a worker that
+                # joined mid-run anchored at the restored step while
+                # survivors kept counting. Re-anchor everyone on the
+                # newest committed manifest so interval triggers stay
+                # in lockstep across the new world.
+                ckpt_mgr.resync_after_reset()
     finally:
+        if ckpt_mgr is not None:
+            state.set_checkpoint_manager(None)
+            # Drain the writer: the last checkpoint of a clean exit is
+            # the one a follow-up job restores.
+            ckpt_mgr.stop()
+            if checkpoint.current() is ckpt_mgr:
+                checkpoint.set_current(None)
         notification_manager.remove_listener(state)

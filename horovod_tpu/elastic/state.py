@@ -42,8 +42,15 @@ class State:
 
     def commit(self):
         """Save + check for pending host updates
-        (ref: common/elastic.py:60-71)."""
+        (ref: common/elastic.py:60-71). With a checkpoint manager
+        attached, the freshly committed snapshot is also offered to the
+        durability plane — BEFORE the host-update check, which may
+        raise HostsUpdatedInterrupt (the snapshot must not be lost to
+        the reset)."""
         self.save()
+        mgr = getattr(self, "_checkpoint_manager", None)
+        if mgr is not None:
+            mgr.maybe_save(self)
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -83,6 +90,14 @@ class State:
                 skip_sync=(res == HostUpdateResult.REMOVED)
             )
 
+    def set_checkpoint_manager(self, manager):
+        """Attach the durability plane (common/checkpoint.py): every
+        ``commit()`` then also feeds the manager, which checkpoints the
+        committed snapshot to shared storage every N commits. The
+        elastic run loop wires this from HOROVOD_CHECKPOINT_DIR
+        (docs/checkpoint.md)."""
+        self._checkpoint_manager = manager
+
     # subclass interface
     def save(self):
         raise NotImplementedError
@@ -95,6 +110,28 @@ class State:
 
     def reset(self):
         pass
+
+    # -- durability hooks (common/checkpoint.py) -----------------------
+    # The checkpoint payload is the last *committed* snapshot — the
+    # same rollback point `restore()` uses — never the live attributes
+    # (which training mutates while the background writer runs).
+    def supports_durability(self) -> bool:
+        """Whether this state implements the checkpoint hooks. The
+        elastic run loop checks this before wiring a manager: a state
+        without hooks must not commit (empty) checkpoints it could
+        never load back."""
+        return False
+
+    def checkpoint_objects(self) -> dict:
+        return {}
+
+    def checkpoint_trees(self) -> dict:
+        """{attr: flat leaf list} of the committed pytree snapshots."""
+        return {}
+
+    def load_checkpoint(self, objects: dict, trees: dict):
+        raise NotImplementedError(
+            "this State subclass does not support durable checkpoints")
 
 
 class ObjectState(State):
@@ -124,6 +161,27 @@ class ObjectState(State):
             setattr(self, k, v)
         self.save()
 
+    # -- durability hooks (common/checkpoint.py) -----------------------
+    def supports_durability(self) -> bool:
+        return True
+
+    def checkpoint_objects(self) -> dict:
+        # `_saved` was deep-copied at save() and is REBOUND (never
+        # mutated) by the next save(), so the background writer can
+        # pickle this dict while training commits ahead.
+        return self._saved
+
+    def load_checkpoint(self, objects: dict, trees: dict):
+        if trees:
+            raise ValueError(
+                "checkpoint holds pytrees but this state is a plain "
+                "ObjectState; restore into a JaxState")
+        for k, v in objects.items():
+            setattr(self, k, copy.deepcopy(v))
+            if k not in self._attrs:
+                self._attrs.append(k)
+        self.save()
+
 
 class JaxState(ObjectState):
     """Elastic state holding JAX pytrees (params/opt_state) plus scalars
@@ -141,16 +199,33 @@ class JaxState(ObjectState):
 
     def save(self):
         super().save()
+        # Host-copy every leaf. np.asarray materializes device arrays
+        # but ALIASES leaves that are already np.ndarrays — and an
+        # aliased snapshot is silently corrupted by in-place training
+        # updates (a numpy optimizer step), poisoning both the
+        # rollback point and whatever the background checkpoint writer
+        # is pickling. A jax.Array is immutable, so its asarray host
+        # view is safe to reference.
         self._saved_trees = {
-            k: jax.tree.map(np.asarray, getattr(self, k))
+            k: jax.tree.map(
+                lambda a: a.copy() if isinstance(a, np.ndarray)
+                else np.asarray(a),
+                getattr(self, k))
             for k in self._tree_attrs
             if getattr(self, k) is not None
         }
 
     def restore(self):
+        # COPY the snapshot out — never hand back the saved arrays
+        # themselves. The old identity map (`lambda a: a`) aliased the
+        # restored attributes to `_saved_trees`, so post-restore
+        # in-place mutation (a numpy optimizer step, a donated buffer)
+        # silently corrupted the rollback snapshot AND any checkpoint
+        # writer still serializing it: a second restore() then yielded
+        # the mutated values, not the committed ones.
         super().restore()
         for k, v in getattr(self, "_saved_trees", {}).items():
-            setattr(self, k, jax.tree.map(lambda a: a, v))
+            setattr(self, k, jax.tree.map(np.copy, v))
 
     def sync(self):
         for k in self._tree_attrs:
@@ -158,6 +233,37 @@ class JaxState(ObjectState):
             if v is not None:
                 setattr(self, k, broadcast_parameters(v, root_rank=0))
         super().sync()
+
+    # -- durability hooks (common/checkpoint.py) -----------------------
+    def checkpoint_trees(self) -> dict:
+        # Leaves of the committed host-side snapshot, in deterministic
+        # (tree-flatten) order. The arrays are the host copies save()
+        # made; save() rebinds `_saved_trees` rather than mutating it,
+        # so the background writer reads a stable view.
+        return {
+            k: jax.tree.leaves(v)
+            for k, v in getattr(self, "_saved_trees", {}).items()
+        }
+
+    def load_checkpoint(self, objects: dict, trees: dict):
+        """Reassemble restored leaves against the LIVE state's tree
+        structure (the restarted job constructed the same model), so a
+        checkpoint written at any world size loads at any other."""
+        for k, leaves in trees.items():
+            cur = getattr(self, k, None)
+            if cur is None:
+                raise ValueError(
+                    f"checkpoint holds pytree {k!r} but the restarted "
+                    f"state has no structure for it; construct the "
+                    f"state with {k}= before restoring")
+            treedef = jax.tree.structure(cur)
+            if treedef.num_leaves != len(leaves):
+                raise ValueError(
+                    f"checkpoint pytree {k!r} has {len(leaves)} leaves "
+                    f"but the live state expects {treedef.num_leaves}; "
+                    "the model structure changed since the checkpoint")
+            setattr(self, k, jax.tree.unflatten(treedef, leaves))
+        super().load_checkpoint(objects, {})
 
 
 # Alias for users coming from flax TrainState-centric code.

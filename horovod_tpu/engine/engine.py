@@ -377,6 +377,15 @@ class Engine:
         health = self._health
         if health is not None:
             st["health"] = health.status()
+        # Durability plane: last committed/pending checkpoint step,
+        # last error (docs/checkpoint.md). The manager is owned by the
+        # elastic run loop, not the engine — report whichever one is
+        # live in this process.
+        from ..common import checkpoint as _ckpt
+
+        ckpt_mgr = _ckpt.current()
+        if ckpt_mgr is not None:
+            st["checkpoint"] = ckpt_mgr.status()
         ctrl = self.controller
         if ctrl is not None and ctrl.is_coordinator:
             now = time.monotonic()

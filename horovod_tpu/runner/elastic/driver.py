@@ -123,12 +123,43 @@ class ElasticDriver:
     def start(self, create_worker: Callable):
         """create_worker(slot: SlotInfo, extra_env: dict) -> Popen."""
         self._create_worker = create_worker
+        self._announce_resume_point()
         self.wait_for_available_slots(self.min_np)
         self._activate()
         self._discovery_thread = threading.Thread(
             target=self._discover_loop, name="elastic-discovery", daemon=True
         )
         self._discovery_thread.start()
+
+    def _announce_resume_point(self):
+        """Kill-all-job recovery, driver side (docs/checkpoint.md): at
+        (re)start, discover the newest COMPLETE checkpoint manifest in
+        HOROVOD_CHECKPOINT_DIR and publish it to the rendezvous KV
+        (``ckpt/resume``) — observability for operators and a
+        cross-check for workers, which perform the actual shard loads
+        from shared storage in `hvd.elastic.run` before their first
+        step. No checkpoint dir (or no manifest) = a fresh job."""
+        root = env_cfg.checkpoint_dir()
+        if not root:
+            return
+        from ...common import checkpoint as ckpt
+
+        found = ckpt.find_latest_manifest(root)
+        if found is None:
+            logger.info("no complete checkpoint under %s; starting fresh",
+                        root)
+            return
+        step, manifest, _ = found
+        logger.info(
+            "job will resume from checkpoint step %d (%d shards, "
+            "written at world size %d)", step, len(manifest["shards"]),
+            manifest["world_size"])
+        import json as _json
+
+        self.rendezvous.handle_put(
+            f"{ckpt.LATEST_SCOPE}/{ckpt.RESUME_KEY}",
+            _json.dumps({"step": step,
+                         "world_size": manifest["world_size"]}).encode())
 
     def wait_for_available_slots(self, min_np: int, timeout: float = 600.0):
         """(ref: driver.py:145 wait_for_available_slots)"""
@@ -323,6 +354,18 @@ class ElasticDriver:
             env_cfg.MESH_SCOPE: f"hvd_mesh_e{self.epoch}",
             "HOROVOD_SPAWN_LOCAL_RANK": str(slot.local_rank),
         }
+        # Durability knobs travel with the slot: a create_worker that
+        # builds a minimal env from slot_env (rather than inheriting
+        # os.environ) must still give every worker the same checkpoint
+        # plane the driver discovered its resume point from.
+        import os as _os
+
+        for var in (env_cfg.CHECKPOINT_DIR, env_cfg.CHECKPOINT_INTERVAL,
+                    env_cfg.CHECKPOINT_KEEP,
+                    env_cfg.CHECKPOINT_COMMIT_TIMEOUT,
+                    env_cfg.CHECKPOINT_FSYNC):
+            if var in _os.environ:
+                extra_env[var] = _os.environ[var]
         proc = self._create_worker(slot, extra_env)
         rec = _WorkerRecord(key, proc)
         rec.thread = threading.Thread(

@@ -26,6 +26,8 @@ import shutil
 import time
 from typing import Any, List, Optional
 
+from ..utils import atomic_file
+
 
 class Store:
     """(ref: store.py:29-144 — path scheme + checkpoint/log IO.)"""
@@ -184,11 +186,10 @@ class LocalStore(Store):
             return f.read()
 
     def write(self, path: str, data: bytes):
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)  # atomic: readers never see partial files
+        # Crash-safe tmp+rename via the shared helper (utils/atomic_file
+        # — same protocol as the checkpoint shard writer and the
+        # metrics/trace dumps): readers never see partial files.
+        atomic_file.atomic_write_bytes(path, data)
 
     # -- parquet data path --------------------------------------------
     def is_parquet_dataset(self, path: str) -> bool:

@@ -1,0 +1,126 @@
+"""Crash-safe atomic file writes: one tmp+rename protocol for every
+local-filesystem writer.
+
+The write-then-`os.replace` dance — readers never observe a partial
+file, a crash leaves only a recognizable ``*.tmp.*`` orphan — used to be
+hand-rolled in four places (`spark/store.py`, the metrics JSON dump, the
+flight-recorder/post-mortem dumps, the merged trace file) and is now
+also the foundation of the checkpoint shard writer
+(`common/checkpoint.py`, docs/checkpoint.md). One module, one tmp-name
+scheme (``<path>.tmp.<pid>.<mono_ns>``), one cleanup contract: on any
+failure the tmp file is unlinked and the destination is untouched.
+
+Durability note: `os.replace` gives *atomicity* (all-or-nothing name
+binding); `fsync=True` additionally forces the data to stable storage
+before the rename AND the parent directory entry after it — without
+the latter the bytes survive power loss but the name binding may not,
+which is what a checkpoint needs to survive power loss rather than
+mere process death. Metadata writers skip the fsync — a lost metrics
+snapshot costs nothing.
+
+Fault injection: every write consults the chaos injector's disk hooks
+(``diskfail:`` / ``diskslow:`` rules, docs/fault_tolerance.md) so disk
+full / slow-NFS scenarios are deterministic, unit-testable inputs. An
+injected failure surfaces as `OSError` — exactly what a real disk
+error raises — so callers exercise their real error paths.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional, Union
+
+TMP_MARKER = ".tmp."
+
+
+def tmp_path_for(path: str) -> str:
+    """The tmp name a write of `path` uses. Unique per process *and*
+    call (monotonic-ns suffix), so concurrent writers of one path never
+    collide and a crashed writer's orphan never blocks a retry."""
+    return f"{path}{TMP_MARKER}{os.getpid()}.{time.monotonic_ns()}"
+
+
+def is_tmp_debris(name: str) -> bool:
+    """Whether a file name is an orphaned tmp from an interrupted write
+    (checkpoint discovery and GC must ignore — and may delete — these)."""
+    return TMP_MARKER in name
+
+
+def _fsync_dir(dirpath: str):
+    """Force the directory entry — the rename itself — to stable
+    storage; without this the *data* survives power loss but the name
+    binding may not, and a 'committed' checkpoint vanishes. Best
+    effort: some filesystems refuse fsync on a directory fd (EINVAL),
+    where the filesystem's own ordering guarantee is the best
+    available."""
+    try:
+        fd = os.open(dirpath or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _check_disk(op: str, path: str):
+    # Lazy import: utils must stay importable without the common package
+    # fully initialized (logging bootstraps through here). get_injector
+    # (not the raw singleton) so HOROVOD_FAULT_INJECT disk rules fire
+    # even in processes where no transport ever loaded the env spec.
+    from ..common.fault_injection import get_injector
+
+    inj = get_injector()
+    if inj.active:
+        inj.check_disk(op, path)
+
+
+def atomic_write(path: str, fill: Callable, mode: str = "wb",
+                 make_dirs: bool = True, fsync: bool = False) -> str:
+    """Write `path` atomically: `fill(f)` populates a tmp file which is
+    then renamed over `path`. Returns `path`. On any failure the tmp is
+    removed and the previous `path` (if any) is left intact."""
+    _check_disk("write", path)
+    if make_dirs:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    tmp = tmp_path_for(path)
+    try:
+        with open(tmp, mode) as f:
+            fill(f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: readers never see partial files
+        if fsync:
+            _fsync_dir(os.path.dirname(path))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def atomic_write_bytes(path: str, data: bytes, make_dirs: bool = True,
+                       fsync: bool = False) -> str:
+    return atomic_write(path, lambda f: f.write(data), mode="wb",
+                        make_dirs=make_dirs, fsync=fsync)
+
+
+def atomic_write_text(path: str, text: str, make_dirs: bool = True,
+                      fsync: bool = False) -> str:
+    return atomic_write(path, lambda f: f.write(text), mode="w",
+                        make_dirs=make_dirs, fsync=fsync)
+
+
+def checked_read_bytes(path: str) -> bytes:
+    """Read a whole file through the disk fault hooks (``diskfail`` with
+    ``op=read`` exercises restore-time error handling)."""
+    _check_disk("read", path)
+    with open(path, "rb") as f:
+        return f.read()

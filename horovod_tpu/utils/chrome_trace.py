@@ -53,9 +53,11 @@ def load_profiler_events(profile_dir: str) -> Optional[List[dict]]:
 def write_trace(path: str, events: List[dict], metadata: Optional[dict] = None):
     """Write events as a ``{"traceEvents": [...]}`` document (the object
     form — Perfetto accepts extra top-level keys, so tool metadata rides
-    along without confusing the viewer)."""
+    along without confusing the viewer). Atomic (tmp+rename) so a crash
+    mid-dump never leaves a torn JSON where a viewer expects a trace."""
     doc = {"traceEvents": events}
     if metadata:
         doc.update(metadata)
-    with open(path, "w") as f:
-        json.dump(doc, f)
+    from . import atomic_file
+
+    atomic_file.atomic_write(path, lambda f: json.dump(doc, f), mode="w")

@@ -147,6 +147,30 @@ TRACE_DUMP_ON_ERROR = "HOROVOD_TRACE_DUMP_ON_ERROR"
 
 DEFAULT_TRACE_BUFFER_EVENTS = 16384
 
+# -- durability / checkpoint knobs (docs/checkpoint.md) ----------------
+# Directory for sharded checkpoints (shared storage across all ranks —
+# a gcsfuse mount on TPU-VMs, NFS, or a local dir on one box). Unset =
+# the durability plane is off: no snapshots, no writer thread.
+CHECKPOINT_DIR = "HOROVOD_CHECKPOINT_DIR"
+# Checkpoint every N state commits (the elastic loop's `state.commit()`
+# is the trigger point). 0 disables periodic checkpoints even with a
+# directory set (explicit `CheckpointManager.save()` still works).
+CHECKPOINT_INTERVAL = "HOROVOD_CHECKPOINT_INTERVAL_STEPS"
+# Complete checkpoints retained; older manifests AND their shard dirs
+# are garbage-collected by the coordinator after each commit.
+CHECKPOINT_KEEP = "HOROVOD_CHECKPOINT_KEEP"
+# Coordinator-side bound on collecting per-rank durability acks before
+# a manifest commit is abandoned (counted as a failure; shards from the
+# incomplete checkpoint are GC'd later, never referenced).
+CHECKPOINT_COMMIT_TIMEOUT = "HOROVOD_CHECKPOINT_COMMIT_TIMEOUT_SECONDS"
+# fsync shard + manifest writes (survive power loss, not just process
+# death). Default on; turn off to trade durability for write latency.
+CHECKPOINT_FSYNC = "HOROVOD_CHECKPOINT_FSYNC"
+
+DEFAULT_CHECKPOINT_INTERVAL_STEPS = 10
+DEFAULT_CHECKPOINT_KEEP = 3
+DEFAULT_CHECKPOINT_COMMIT_TIMEOUT = 120.0
+
 # -- telemetry knobs (docs/metrics.md) ---------------------------------
 # Serve Prometheus text at /metrics and live job state at /status from a
 # daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
@@ -314,6 +338,33 @@ def trace_dir() -> str:
 
 def trace_dump_on_error() -> bool:
     return get_bool(TRACE_DUMP_ON_ERROR, True)
+
+
+def checkpoint_dir() -> str:
+    """Shared checkpoint directory; empty = durability plane off."""
+    return get_str(CHECKPOINT_DIR, "")
+
+
+def checkpoint_interval_steps() -> int:
+    """Commits between checkpoints; 0 disables periodic snapshots."""
+    return max(get_int(CHECKPOINT_INTERVAL,
+                       DEFAULT_CHECKPOINT_INTERVAL_STEPS), 0)
+
+
+def checkpoint_keep() -> int:
+    """Complete checkpoints retained by GC (always >= 1)."""
+    return max(get_int(CHECKPOINT_KEEP, DEFAULT_CHECKPOINT_KEEP), 1)
+
+
+def checkpoint_commit_timeout() -> float:
+    """Bound on the coordinator's ack-collection before a manifest
+    commit is abandoned."""
+    return get_float(CHECKPOINT_COMMIT_TIMEOUT,
+                     DEFAULT_CHECKPOINT_COMMIT_TIMEOUT)
+
+
+def checkpoint_fsync() -> bool:
+    return get_bool(CHECKPOINT_FSYNC, True)
 
 
 def metrics_sync_seconds() -> float:

@@ -18,10 +18,18 @@ HorovodInternalError NAMING the wedged rank within
 ``miss_limit x interval`` (+ slack), while the wedged process itself
 stayed alive until this script killed it.
 
+``--killall`` mode — whole-job loss, the scenario the elastic plane
+alone cannot survive and the durability plane (docs/checkpoint.md)
+exists for: EVERY rank dies at the kill step (rendezvous server
+included), then a fresh job over the same checkpoint dir must resume
+at the last committed checkpoint with bitwise state parity. Delegates
+to ``checkpoint_smoke``'s two-phase harness.
+
     python scripts/chaos_smoke.py                 # 4 workers, kill rank 2 at step 3
     python scripts/chaos_smoke.py --np 8 --kill-rank 5 --kill-step 10
     python scripts/chaos_smoke.py --wedge         # wedge rank 2 instead
     python scripts/chaos_smoke.py --wedge --hb-interval 0.5 --hb-miss 4
+    python scripts/chaos_smoke.py --killall --kill-step 7
 """
 from __future__ import annotations
 
@@ -94,7 +102,18 @@ def main() -> int:
                     help="HOROVOD_HEARTBEAT_INTERVAL_SECONDS (wedge mode)")
     ap.add_argument("--hb-miss", type=int, default=4,
                     help="HOROVOD_HEARTBEAT_MISS_LIMIT (wedge mode)")
+    ap.add_argument("--killall", action="store_true",
+                    help="kill EVERY rank at --kill-step (whole-job "
+                         "loss) and assert a restarted job resumes "
+                         "from the last committed durable checkpoint "
+                         "with bitwise parity")
+    ap.add_argument("--interval", type=int, default=2,
+                    help="HOROVOD_CHECKPOINT_INTERVAL_STEPS "
+                         "(killall mode)")
     args = ap.parse_args()
+
+    if args.killall:
+        return run_killall(args)
 
     from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
     from horovod_tpu.runner.launch import slot_env
@@ -150,6 +169,24 @@ def main() -> int:
                 if p.poll() is None:
                     p.kill()
             server.stop()
+
+
+def run_killall(args) -> int:
+    """Whole-job loss + recovery. The kill rule is armed on EVERY rank
+    (``kill:step=K`` with no rank= filter), so nothing survives — not
+    even the rendezvous KV. checkpoint_smoke's harness then restarts
+    the job from nothing but the shared checkpoint dir and asserts a
+    bitwise resume at the last committed step, bitwise-identical final
+    weights, and zero partial-checkpoint debris."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import checkpoint_smoke
+
+    if args.kill_step <= args.interval:
+        print(f"FAIL: --kill-step {args.kill_step} <= --interval "
+              f"{args.interval}: no checkpoint can commit before the "
+              "kill", flush=True)
+        return 2
+    return checkpoint_smoke.run_killall(args)
 
 
 def run_kill(args, procs) -> int:

@@ -23,6 +23,12 @@ python scripts/perf_smoke.py
 echo "=== elastic recovery smoke (wedge 1 of 4, survivors resume at np=3) ==="
 python scripts/elastic_smoke.py
 
+echo "=== durability smoke (kill ALL ranks, restart, bitwise resume) ==="
+python scripts/checkpoint_smoke.py
+
+echo "=== checkpoint overhead smoke (background write <5% of step time) ==="
+python scripts/checkpoint_smoke.py --overhead
+
 echo "=== multichip sharding dryrun (8 virtual devices) ==="
 python __graft_entry__.py
 

@@ -1,0 +1,765 @@
+"""Durability plane tests (docs/checkpoint.md): atomic writes, the
+sharded CheckpointManager's full save → commit → kill → restore
+roundtrip with bitwise parity, torn-write recovery, world-size
+re-sharding, disk fault injection, GC, and the JaxState.restore
+aliasing regression."""
+import json
+import os
+import pickle
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import checkpoint as ck
+from horovod_tpu.common import telemetry
+from horovod_tpu.common.fault_injection import (
+    InjectedDiskFault, Rule, injector, parse_spec,
+)
+from horovod_tpu.elastic.state import JaxState, ObjectState
+from horovod_tpu.utils import atomic_file
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injector.clear()
+    yield
+    injector.clear()
+
+
+def _params():
+    return {
+        "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.ones(4, np.float32),
+    }
+
+
+def _state(batch=5):
+    st = JaxState(
+        params=_params(),
+        opt_state=[np.zeros(3, np.float32), {"m": np.full((2, 2), 7.0)}],
+        batch=batch, history=[(1, 2)],
+    )
+    st.save()
+    return st
+
+
+def _fresh_state():
+    return JaxState(
+        params={"w": np.zeros((3, 4), np.float32),
+                "b": np.zeros(4, np.float32)},
+        opt_state=[np.zeros(3, np.float32), {"m": np.zeros((2, 2))}],
+        batch=0, history=[],
+    )
+
+
+def _write_world(td, state, step, size, **kw):
+    """Write a complete checkpoint at `step` as a `size`-rank world
+    (one manager per rank sharing the dir; coordinator last so its
+    ack-collection finds every shard already durable)."""
+    mgrs = [ck.CheckpointManager(str(td), rank=r, size=size,
+                                 interval_steps=1, commit_timeout=10, **kw)
+            for r in range(size)]
+    for m in mgrs[1:]:
+        assert m.save(state, step=step, blocking=True)
+    assert mgrs[0].save(state, step=step, blocking=True)
+    for m in mgrs:
+        m.stop()
+    return mgrs[0]
+
+
+# ---------------------------------------------------------------------------
+# utils/atomic_file.py
+
+
+def test_atomic_write_and_read(tmp_path):
+    p = str(tmp_path / "sub" / "f.bin")
+    atomic_file.atomic_write_bytes(p, b"hello", fsync=True)
+    assert atomic_file.checked_read_bytes(p) == b"hello"
+    atomic_file.atomic_write_text(p, "world")
+    with open(p) as f:
+        assert f.read() == "world"
+    # No tmp debris after successful writes.
+    assert not [n for n in os.listdir(tmp_path / "sub")
+                if atomic_file.is_tmp_debris(n)]
+
+
+def test_atomic_write_failure_leaves_destination_and_no_tmp(tmp_path):
+    p = str(tmp_path / "f.bin")
+    atomic_file.atomic_write_bytes(p, b"v1")
+
+    def boom(f):
+        f.write(b"partial")
+        raise RuntimeError("writer died")
+
+    with pytest.raises(RuntimeError):
+        atomic_file.atomic_write(p, boom, mode="wb")
+    with open(p, "rb") as f:
+        assert f.read() == b"v1"  # previous version intact
+    assert not [n for n in os.listdir(tmp_path)
+                if atomic_file.is_tmp_debris(n)]
+
+
+def test_atomic_write_diskfail_rule(tmp_path):
+    p = str(tmp_path / "f.bin")
+    atomic_file.atomic_write_bytes(p, b"v1")
+    injector.install([Rule(action="diskfail", op="write")])
+    with pytest.raises(OSError):
+        atomic_file.atomic_write_bytes(p, b"v2")
+    injector.clear()
+    with open(p, "rb") as f:
+        assert f.read() == b"v1"
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection grammar
+
+
+def test_parse_disk_rules():
+    rules = parse_spec("diskfail:op=write:path=shard:after=2;"
+                       "diskslow:secs=0.1:rank=3")
+    assert rules[0].action == "diskfail"
+    assert rules[0].op == "write" and rules[0].path == "shard"
+    assert rules[0].after == 2
+    assert rules[1].action == "diskslow" and rules[1].secs == 0.1
+    assert rules[1].rank == 3
+
+
+def test_parse_disk_rules_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        parse_spec("diskslow")  # needs secs
+    with pytest.raises(ValueError):
+        parse_spec("diskfail:op=send")  # net op on a disk rule
+    with pytest.raises(ValueError):
+        parse_spec("sever:path=x")  # path on a net rule
+    with pytest.raises(ValueError):
+        parse_spec("delay:op=write:secs=1")  # disk op on a net rule
+
+
+def test_disk_rules_do_not_fire_on_network_io():
+    injector.install([Rule(action="diskfail")])
+    # A disk rule must never sever the data plane.
+    assert injector.check_io(0, 1, "send") == "pass"
+    with pytest.raises(InjectedDiskFault):
+        injector.check_disk("write", "/tmp/x")
+
+
+def test_diskfail_after_and_path_filters(tmp_path):
+    injector.install([
+        Rule(action="diskfail", op="write", path="shard", after=1)])
+    injector.check_disk("write", "/a/shard-0.pkl")  # first match passes
+    injector.check_disk("write", "/a/manifest.json")  # path filtered out
+    with pytest.raises(InjectedDiskFault):
+        injector.check_disk("write", "/a/shard-1.pkl")
+
+
+# ---------------------------------------------------------------------------
+# JaxState restore aliasing regression (the bug: restore handed back the
+# snapshot arrays themselves, so in-place mutation corrupted the
+# rollback point)
+
+
+def test_restore_does_not_alias_saved_snapshot():
+    st = _state()
+    committed = {k: v.copy() for k, v in st.params.items()}
+    st.restore()
+    # Mutate the restored params IN PLACE — an optimizer step on numpy
+    # state does exactly this.
+    st.params["w"] += 100.0
+    st.params["b"] *= 0.0
+    # A second restore must still yield the committed values.
+    st.restore()
+    np.testing.assert_array_equal(st.params["w"], committed["w"])
+    np.testing.assert_array_equal(st.params["b"], committed["b"])
+    # And the restored arrays are fresh on every restore.
+    assert st.params["w"] is not st._saved_trees["params"]["w"]
+
+
+def test_save_does_not_alias_numpy_leaves():
+    """np.asarray on an np.ndarray returns the SAME object, so the
+    snapshot must copy numpy-backed leaves explicitly — otherwise an
+    in-place training update corrupts the rollback point AND whatever
+    the background checkpoint writer is pickling."""
+    st = _state()
+    saved_w = st._saved_trees["params"]["w"]
+    assert saved_w is not st.params["w"]
+    st.params["w"][:] = -777.0  # in-place, no rebind, no save()
+    assert saved_w[0, 0] == 0.0  # the committed snapshot is untouched
+    st.restore()
+    np.testing.assert_array_equal(st.params["w"], _params()["w"])
+
+
+# ---------------------------------------------------------------------------
+# shard_ranges
+
+
+def test_shard_ranges_tile_and_balance():
+    sizes = [100, 1, 1, 100, 50, 50]
+    for n in (1, 2, 3, 4, 6, 9):
+        ranges = ck.shard_ranges(sizes, n)
+        assert len(ranges) == n
+        assert ranges[0][0] == 0 and ranges[-1][1] == len(sizes)
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c  # contiguous tiling
+    # More shards than leaves: the extras are empty, never negative.
+    ranges = ck.shard_ranges([10], 4)
+    assert all(a <= b for a, b in ranges)
+    assert sum(b - a for a, b in ranges) == 1
+
+
+# ---------------------------------------------------------------------------
+# The full durability roundtrip
+
+
+def test_roundtrip_kill_all_and_restore_bitwise(tmp_path):
+    st = _state()
+    _write_world(tmp_path, st, step=4, size=2)
+
+    found = ck.find_latest_manifest(str(tmp_path))
+    assert found is not None
+    step, man, _ = found
+    assert step == 4 and man["world_size"] == 2
+    # Shard ranges tile the leaf space (the re-sharding metadata).
+    ranges = sorted(s["leaves"] for s in man["shards"])
+    assert ranges[0][0] == 0 and ranges[-1][1] == man["num_leaves"]
+
+    # "Kill": nothing survives but the files. A fresh state + manager
+    # (any world size) restores bitwise-identically.
+    st2 = _fresh_state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+    try:
+        assert m.restore_latest(st2) == 4
+    finally:
+        m.stop()
+    for k in ("w", "b"):
+        assert st2.params[k].tobytes() == st.params[k].tobytes()
+    assert st2.opt_state[1]["m"].tobytes() == st.opt_state[1]["m"].tobytes()
+    assert st2.batch == 5 and st2.history == [(1, 2)]
+    # The restored state is re-snapshotted: an in-memory rollback goes
+    # to the restored values.
+    st2.params["w"] = st2.params["w"] + 1
+    st2.restore()
+    assert st2.params["w"].tobytes() == st.params["w"].tobytes()
+
+
+@pytest.mark.parametrize("restore_size", [1, 3])
+def test_restore_at_different_world_size(tmp_path, restore_size):
+    st = _state()
+    _write_world(tmp_path, st, step=7, size=2)
+    st2 = _fresh_state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=restore_size)
+    try:
+        assert m.restore_latest(st2) == 7
+    finally:
+        m.stop()
+    assert st2.params["w"].tobytes() == st.params["w"].tobytes()
+
+
+def test_object_state_only_roundtrip(tmp_path):
+    st = ObjectState(batch=9, lr=0.125, history=["a"])
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=1, commit_timeout=5)
+    try:
+        assert m.save(st, step=1, blocking=True)
+    finally:
+        m.stop()
+    st2 = ObjectState(batch=0, lr=0.0, history=[])
+    m2 = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+    try:
+        assert m2.restore_latest(st2) == 1
+    finally:
+        m2.stop()
+    assert (st2.batch, st2.lr, st2.history) == (9, 0.125, ["a"])
+
+
+def test_torn_write_recovery(tmp_path):
+    """A `.tmp` orphan, a manifest-less shard dir, a manifest with a
+    missing shard, and a CRC-corrupt shard must all be ignored in favor
+    of the last complete checkpoint."""
+    st = _state()
+    _write_world(tmp_path, st, step=4, size=1)
+
+    # 1) Orphan shard dir from a kill mid-write (no manifest) + tmp.
+    d8 = ck.step_dir(str(tmp_path), 8)
+    os.makedirs(d8)
+    with open(os.path.join(d8, "shard-00000.pkl.tmp.123.456"), "wb") as f:
+        f.write(b"partial")
+    with open(os.path.join(d8, "shard-00000.pkl"), "wb") as f:
+        f.write(b"complete-but-uncommitted")
+
+    # 2) A manifest referencing a shard that never landed.
+    with open(ck.manifest_path(str(tmp_path), 9), "w") as f:
+        json.dump({"format": 1, "step": 9, "world_size": 1,
+                   "num_leaves": 0, "attrs": [], "attr_counts": {},
+                   "objects_shard": 0,
+                   "shards": [{"rank": 0, "file": "ckpt-0000000009/x.pkl",
+                               "leaves": [0, 0], "bytes": 10, "crc32": 0}]},
+                  f)
+
+    # 3) A newer COMMITTED checkpoint whose shard bytes rotted (same
+    # size, wrong CRC).
+    _write_world(tmp_path, _state(batch=99), step=12, size=1)
+    man12 = ck.load_manifest(ck.manifest_path(str(tmp_path), 12))
+    shard12 = os.path.join(str(tmp_path), man12["shards"][0]["file"])
+    blob = bytearray(open(shard12, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(shard12, "wb") as f:
+        f.write(bytes(blob))
+
+    st2 = _fresh_state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+    try:
+        assert m.restore_latest(st2) == 4  # fell back past 12, 9 and 8
+    finally:
+        m.stop()
+    assert st2.batch == 5
+    assert st2.params["w"].tobytes() == st.params["w"].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Disk fault injection through the manager
+
+
+def test_diskfail_counts_failure_and_never_commits(tmp_path):
+    st = _state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=1, commit_timeout=2)
+    failures0 = m._m_failures.value
+    injector.install([Rule(action="diskfail", op="write", path="shard")])
+    try:
+        m.save(st, step=1, blocking=True)
+        # The failed write is counted and no manifest references the
+        # missing shard — there is no manifest at all.
+        assert m._m_failures.value == failures0 + 1
+        assert ck.find_latest_manifest(str(tmp_path)) is None
+        assert m.status()["last_error"] is not None
+
+        # The fault clears; the next interval succeeds cleanly.
+        injector.clear()
+        assert m.save(st, step=2, blocking=True)
+        found = ck.find_latest_manifest(str(tmp_path))
+        assert found is not None and found[0] == 2
+    finally:
+        m.stop()
+
+
+def test_diskslow_write_survives(tmp_path):
+    st = _state()
+    injector.install([Rule(action="diskslow", secs=0.05, op="write",
+                           path="shard")])
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=1, commit_timeout=5)
+    writes0 = m._m_writes.value
+    try:
+        assert m.save(st, step=1, blocking=True)
+        assert m._m_writes.value == writes0 + 1
+        assert ck.find_latest_manifest(str(tmp_path))[0] == 1
+    finally:
+        m.stop()
+
+
+def test_commit_abandoned_when_a_rank_never_acks(tmp_path):
+    """Coordinator in a 2-rank world, rank 1 never writes: the commit
+    must time out, count a failure, and leave no manifest."""
+    st = _state()
+    m0 = ck.CheckpointManager(str(tmp_path), rank=0, size=2,
+                              interval_steps=1, commit_timeout=0.3)
+    failures0 = m0._m_failures.value
+    try:
+        m0.save(st, step=1, blocking=True, timeout=30)
+        assert ck.find_latest_manifest(str(tmp_path)) is None
+        assert m0._m_failures.value == failures0 + 1
+    finally:
+        m0.stop()
+
+
+# ---------------------------------------------------------------------------
+# Writer backpressure + interval
+
+
+def test_maybe_save_respects_interval(tmp_path):
+    st = _state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=3, commit_timeout=5)
+    try:
+        enq = []
+        for _ in range(7):
+            enq.append(m.maybe_save(st))
+            m.flush(timeout=30)  # keep the writer idle: no skip races
+        assert enq == [False, False, True, False, False, True, False]
+        found = ck.find_latest_manifest(str(tmp_path))
+        assert found is not None and found[0] == 6
+    finally:
+        m.stop()
+
+
+def test_busy_writer_skips_and_counts(tmp_path):
+    st = _state()
+    injector.install([Rule(action="diskslow", secs=0.5, op="write",
+                           path="shard")])
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=1, commit_timeout=5)
+    # Counters live in the process-default registry (deduped by name),
+    # so assert deltas, not absolutes.
+    skipped0 = m._m_skipped.value
+    try:
+        assert m.save(st, step=1)  # writer parks in the diskslow sleep
+        assert not m.save(st, step=2)  # single-slot backpressure: skipped
+        assert m._m_skipped.value == skipped0 + 1
+        m.flush(timeout=30)
+    finally:
+        m.stop()
+        injector.clear()
+
+
+# ---------------------------------------------------------------------------
+# GC
+
+
+def test_gc_keeps_last_k(tmp_path):
+    st = _state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=1, keep=2, commit_timeout=5)
+    try:
+        for step in (1, 2, 3, 4, 5):
+            assert m.save(st, step=step, blocking=True)
+    finally:
+        m.stop()
+    steps = [s for s, _ in ck.list_manifests(str(tmp_path))]
+    assert steps == [4, 5]
+    # Old shard dirs are gone with their manifests.
+    dirs = sorted(n for n in os.listdir(tmp_path)
+                  if n.startswith(ck.STEP_DIR_PREFIX))
+    assert dirs == [os.path.basename(ck.step_dir("", 4)),
+                    os.path.basename(ck.step_dir("", 5))]
+    # No tmp debris anywhere.
+    for root, _, files in os.walk(tmp_path):
+        assert not [f for f in files if atomic_file.is_tmp_debris(f)]
+
+
+def test_gc_sweeps_orphans_from_abandoned_commits(tmp_path):
+    st = _state()
+    # An abandoned attempt (kill-all mid-checkpoint) left a shard dir
+    # with no manifest.
+    d2 = ck.step_dir(str(tmp_path), 2)
+    os.makedirs(d2)
+    with open(os.path.join(d2, "shard-00000.pkl"), "wb") as f:
+        f.write(b"uncommitted")
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=1, keep=2, commit_timeout=5)
+    try:
+        assert m.save(st, step=5, blocking=True)
+    finally:
+        m.stop()
+    assert not os.path.exists(d2)
+    assert ck.find_latest_manifest(str(tmp_path))[0] == 5
+
+
+# ---------------------------------------------------------------------------
+# KV ack path (the control-plane leg of the two-phase commit)
+
+
+class _FakeKV:
+    """Dict-backed stand-in for backend.rendezvous.RendezvousClient."""
+
+    def __init__(self):
+        self.store = {}
+        self.lock = threading.Lock()
+
+    def put(self, scope, key, value):
+        with self.lock:
+            self.store[f"{scope}/{key}"] = value
+
+    def get(self, scope, key):
+        with self.lock:
+            return self.store.get(f"{scope}/{key}")
+
+
+def test_kv_acks_and_latest_publish(tmp_path):
+    st = _state()
+    kv = _FakeKV()
+    m1 = ck.CheckpointManager(str(tmp_path), rank=1, size=2,
+                              interval_steps=1, commit_timeout=10,
+                              rendezvous=kv)
+    m0 = ck.CheckpointManager(str(tmp_path), rank=0, size=2,
+                              interval_steps=1, commit_timeout=10,
+                              rendezvous=kv)
+    try:
+        assert m1.save(st, step=3, blocking=True)
+        assert m0.save(st, step=3, blocking=True)
+    finally:
+        m0.stop()
+        m1.stop()
+    # Both ranks acked durability over the KV...
+    for r in (0, 1):
+        meta = json.loads(kv.get(f"{ck.ACK_SCOPE_PREFIX}3", str(r)).decode())
+        assert meta["step"] == 3 and meta["rank"] == r
+        # ...and the acked CRC matches the bytes on disk.
+        payload = open(os.path.join(str(tmp_path), meta["file"]), "rb").read()
+        assert zlib.crc32(payload) == meta["crc32"]
+        assert len(payload) == meta["bytes"]
+    # Phase 2 published the committed step.
+    latest = json.loads(kv.get(ck.LATEST_SCOPE, ck.LATEST_KEY).decode())
+    assert latest["step"] == 3 and latest["world_size"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Env wiring + status
+
+
+def test_manager_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("HOROVOD_CHECKPOINT_DIR", raising=False)
+    assert ck.manager_from_env() is None
+    monkeypatch.setenv("HOROVOD_CHECKPOINT_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_CHECKPOINT_INTERVAL_STEPS", "7")
+    monkeypatch.setenv("HOROVOD_CHECKPOINT_KEEP", "5")
+    m = ck.manager_from_env(rank=2, size=4)
+    try:
+        assert m is not None
+        assert m.rank == 2 and m.size == 4
+        assert m.interval_steps == 7 and m.keep == 5
+        st = m.status()
+        assert st["directory"] == str(tmp_path)
+        assert st["last_committed_step"] is None
+    finally:
+        m.stop()
+
+
+def test_current_manager_is_status_visible(tmp_path):
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+    ck.set_current(m)
+    try:
+        assert ck.current() is m
+        assert "interval_steps" in ck.current().status()
+    finally:
+        ck.set_current(None)
+        m.stop()
+
+
+def test_restore_purges_stale_acks(tmp_path):
+    """Aborted-commit leftovers NEWER than the restore point — above
+    all their ``.meta.json`` durability acks — are swept at restore:
+    when the restarted run re-reaches the same step number, the commit
+    barrier must wait for a FRESH ack, never fill from pre-crash
+    bytes."""
+    st = _state()
+    _write_world(tmp_path, st, step=4, size=2)
+    # Kill-all at step 6 mid-commit: rank 1's shard + ack landed
+    # before the crash, the manifest did not.
+    d6 = ck.step_dir(str(tmp_path), 6)
+    os.makedirs(d6)
+    stale = os.path.join(d6, "shard-00001.pkl")
+    with open(stale, "wb") as f:
+        f.write(b"pre-crash bytes")
+    with open(stale + ".meta.json", "w") as f:
+        json.dump({"format": 1, "step": 6, "rank": 1, "world_size": 2,
+                   "file": ck.shard_file(6, 1), "leaves": [3, 6],
+                   "bytes": 15,
+                   "crc32": zlib.crc32(b"pre-crash bytes")}, f)
+
+    st2 = _fresh_state()
+    m0 = ck.CheckpointManager(str(tmp_path), rank=0, size=2,
+                              interval_steps=1, commit_timeout=0.3)
+    failures0 = m0._m_failures.value
+    try:
+        assert m0.restore_latest(st2) == 4
+        assert not os.path.exists(d6)  # the stale ack is gone
+        # The restarted run re-reaches step 6 with rank 1 slower (its
+        # write never lands): the barrier must abandon — without the
+        # sweep it would have committed a manifest referencing the
+        # pre-crash shard.
+        m0.save(st2, step=6, blocking=True, timeout=30)
+        found = ck.find_latest_manifest(str(tmp_path))
+        assert found is not None and found[0] == 4
+        assert m0._m_failures.value == failures0 + 1
+    finally:
+        m0.stop()
+
+
+def test_fresh_start_sweeps_unrestorable_debris(tmp_path):
+    """With NO complete checkpoint, restore sweeps every leftover —
+    a fresh run must not inherit stale acks at any step."""
+    d3 = ck.step_dir(str(tmp_path), 3)
+    os.makedirs(d3)
+    with open(os.path.join(d3, "shard-00000.pkl"), "wb") as f:
+        f.write(b"junk")
+    with open(os.path.join(d3, "shard-00000.pkl.meta.json"), "w") as f:
+        json.dump({"step": 3, "rank": 0, "file": ck.shard_file(3, 0),
+                   "leaves": [0, 1], "bytes": 4, "crc32": 0}, f)
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+    try:
+        assert m.restore_latest(_fresh_state()) is None
+        assert not os.path.exists(d3)
+    finally:
+        m.stop()
+
+
+def test_purge_keeps_manifested_checkpoints_but_sheds_their_acks(tmp_path):
+    """A dir WITH a manifest above the restore point is preserved
+    (complete = a concurrently-landed real checkpoint; incomplete =
+    forensics that discovery skips anyway) — but its sidecar acks are
+    shed so they can never fill a repeated commit barrier."""
+    st = _state()
+    _write_world(tmp_path, st, step=4, size=1)
+    # An incomplete newer checkpoint: manifest references a shard that
+    # never landed, but another shard + its sidecar did.
+    d9 = ck.step_dir(str(tmp_path), 9)
+    os.makedirs(d9)
+    with open(os.path.join(d9, "shard-00001.pkl"), "wb") as f:
+        f.write(b"landed")
+    side9 = os.path.join(d9, "shard-00001.pkl.meta.json")
+    with open(side9, "w") as f:
+        json.dump({"step": 9, "rank": 1, "file": ck.shard_file(9, 1),
+                   "leaves": [3, 6], "bytes": 6, "crc32": 0}, f)
+    man9 = ck.manifest_path(str(tmp_path), 9)
+    with open(man9, "w") as f:
+        json.dump({"format": 1, "step": 9, "world_size": 2,
+                   "num_leaves": 6, "attrs": [], "attr_counts": {},
+                   "objects_shard": 0,
+                   "shards": [{"rank": 0, "file": ck.shard_file(9, 0),
+                               "leaves": [0, 3], "bytes": 10, "crc32": 0}]},
+                  f)
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+    try:
+        assert m.restore_latest(_fresh_state()) == 4
+    finally:
+        m.stop()
+    assert os.path.exists(man9)                          # forensics kept
+    assert os.path.exists(os.path.join(d9, "shard-00001.pkl"))
+    assert not os.path.exists(side9)                     # ack disarmed
+
+
+def test_resync_after_reset_re_anchors_counter(tmp_path):
+    """Elastic join: the joiner's counter anchors at the restored step
+    while a survivor kept counting — drifted counters would snapshot
+    on different commits and no ack barrier would ever fill again.
+    resync_after_reset re-anchors both on the newest committed
+    manifest, and sweeps manifest-less attempt debris above it (the
+    committed manifest itself stays)."""
+    st = _state()
+    _write_world(tmp_path, st, step=40, size=1)
+    # Aborted-attempt debris above the anchor: shard + sidecar ack at
+    # step 45, no manifest (the reset interrupted the commit).
+    d45 = ck.step_dir(str(tmp_path), 45)
+    os.makedirs(d45)
+    with open(os.path.join(d45, "shard-00000.pkl"), "wb") as f:
+        f.write(b"pre-reset bytes")
+    with open(os.path.join(d45, "shard-00000.pkl.meta.json"), "w") as f:
+        json.dump({"step": 45, "rank": 0, "file": ck.shard_file(45, 0),
+                   "leaves": [0, 6], "bytes": 15, "crc32": 0}, f)
+    survivor = ck.CheckpointManager(str(tmp_path), rank=0, size=2,
+                                    interval_steps=10, commit_timeout=1)
+    joiner = ck.CheckpointManager(str(tmp_path), rank=1, size=2,
+                                  interval_steps=10, commit_timeout=1)
+    try:
+        survivor._commit_count = 57  # counted every commit since start
+        assert joiner.restore_latest(_fresh_state()) == 40
+        assert joiner._commit_count == 40
+        survivor.resync_after_reset()
+        joiner.resync_after_reset()
+        assert survivor._commit_count == joiner._commit_count == 40
+        assert not os.path.exists(d45)  # attempt debris swept
+        # ... but the committed checkpoint survives the sweep.
+        assert ck.find_latest_manifest(str(tmp_path))[0] == 40
+    finally:
+        survivor.stop()
+        joiner.stop()
+
+
+def test_resync_cancels_inflight_commit_and_cleans(tmp_path):
+    """A coordinator mid-commit at reset time is polling for acks that
+    will never come; resync must abandon it promptly (not wedge the
+    reset for commit_timeout) and remove the attempt — shards, sidecar
+    acks and all."""
+    import time
+
+    st = _state()
+    m0 = ck.CheckpointManager(str(tmp_path), rank=0, size=2,
+                              interval_steps=1, commit_timeout=60)
+    try:
+        m0.save(st, step=1)  # rank 1 never writes: _commit polls
+        d1 = ck.step_dir(str(tmp_path), 1)
+        deadline = time.monotonic() + 10
+        while not os.path.exists(d1) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        m0.resync_after_reset(flush_timeout=30)
+        assert time.monotonic() - t0 < 10  # did not wait commit_timeout
+        assert ck.find_latest_manifest(str(tmp_path)) is None
+        assert not os.path.exists(d1)  # abandoned attempt cleaned up
+        assert m0._commit_count == 0
+    finally:
+        m0.stop()
+
+
+def test_commit_rejects_ack_not_backed_by_shard(tmp_path):
+    """A stale ack whose shard file is gone (swept by the restore or
+    reset purges) — e.g. a leftover KV ack — must keep the barrier
+    waiting, never fill it: here rank 1's sidecar claims bytes that
+    are not on disk, so the commit abandons."""
+    st = _state()
+    d2 = ck.step_dir(str(tmp_path), 2)
+    os.makedirs(d2)
+    with open(os.path.join(d2, "shard-00001.pkl.meta.json"), "w") as f:
+        json.dump({"step": 2, "rank": 1, "file": ck.shard_file(2, 1),
+                   "leaves": [3, 6], "bytes": 15, "crc32": 0}, f)
+    m0 = ck.CheckpointManager(str(tmp_path), rank=0, size=2,
+                              interval_steps=1, commit_timeout=0.3)
+    failures0 = m0._m_failures.value
+    try:
+        m0.save(st, step=2, blocking=True, timeout=30)
+        assert ck.find_latest_manifest(str(tmp_path)) is None
+        assert m0._m_failures.value == failures0 + 1
+    finally:
+        m0.stop()
+
+
+def test_state_without_hooks_reports_no_durability():
+    """The elastic loop gates manager wiring on supports_durability():
+    a custom State without the hooks must neither commit (empty)
+    checkpoints nor crash a restart trying to load one back."""
+    from horovod_tpu.elastic.state import State
+
+    class Custom(State):
+        def save(self):
+            pass
+
+        def restore(self):
+            pass
+
+        def sync(self):
+            pass
+
+    assert not Custom().supports_durability()
+    assert _state().supports_durability()
+    assert ObjectState(x=1).supports_durability()
+
+
+def test_commit_integration_via_state(tmp_path, hvd_single):
+    """state.commit() drives the durability plane end to end (the
+    elastic loop's trigger point), including under mesh-mode init."""
+    st = _state()
+    m = ck.CheckpointManager(str(tmp_path), rank=0, size=1,
+                             interval_steps=2, commit_timeout=5)
+    st.set_checkpoint_manager(m)
+    try:
+        st.batch = 1
+        st.commit()  # commit 1: no checkpoint yet
+        assert ck.find_latest_manifest(str(tmp_path)) is None
+        st.batch = 2
+        st.commit()  # commit 2: checkpoint fires
+        m.flush(timeout=30)
+        found = ck.find_latest_manifest(str(tmp_path))
+        assert found is not None
+        # The checkpoint carries the committed batch value.
+        st2 = _fresh_state()
+        m2 = ck.CheckpointManager(str(tmp_path), rank=0, size=1)
+        try:
+            assert m2.restore_latest(st2) == found[0]
+        finally:
+            m2.stop()
+        assert st2.batch == 2
+    finally:
+        st.set_checkpoint_manager(None)
+        m.stop()
