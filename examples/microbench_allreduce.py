@@ -20,6 +20,14 @@ HOROVOD_RING_SEGMENT_BYTES) are read per call, so one process flips
 them between timed loops; every rank executes the same schedule, so
 the flips stay collectively consistent. Rank 0 prints a table (GB/s)
 and ONE JSON summary line.
+
+`--mode transport` is the shared-memory acceptance measurement
+(docs/running.md "Transports"): order-alternated paired rounds of the
+16MB allreduce with the route flipped tcp<->shm between
+barrier-separated timed loops (HOROVOD_TRANSPORT is read per call;
+the overlays are established at init because this mode sets `auto`
+before hvd.init()). Steady-state tensor names, so the response cache
+engages and the loops measure the data plane, not negotiation.
 """
 import os
 import sys
@@ -155,6 +163,78 @@ def _bench_pipeline(hvd, np, basics, args):
     }
 
 
+def _bench_transport(hvd, np, args, seg_bytes):
+    """The shared-memory acceptance measurement: order-alternated
+    paired rounds of the SAME segmented-ring schedule over tcp vs shm
+    on co-located ranks (the paired-round protocol PR 3/4 used — on a
+    shared box, sequential arms measure load drift, not transport
+    cost). Requires launching with HOROVOD_TRANSPORT=shm/auto so the
+    overlays exist (the mode sets auto itself before init); the route
+    flips between barrier-separated timed loops, which is exactly the
+    consistency contract the per-call knob documents."""
+    import os as _os
+    import time as _time
+
+    _set_algo_env("segring", seg_bytes)
+    x = np.ones(args.transport_count, np.float32)
+
+    def timed(transport):
+        # STEADY-STATE names (one per transport arm, reused every
+        # iteration, like training reusing its gradient tensors): the
+        # response cache engages after the warmup, so the timed loops
+        # measure the data plane, not per-op negotiation — the same
+        # protocol the PR 4 latency bench uses.
+        _os.environ["HOROVOD_TRANSPORT"] = transport
+        hvd.barrier()
+        t0 = _time.perf_counter()
+        for i in range(args.transport_iters):
+            hvd.allreduce(x, name=f"tb.{transport}", op=hvd.Sum)
+        dt = (_time.perf_counter() - t0) / args.transport_iters
+        hvd.barrier()
+        return dt
+
+    timed("tcp")  # warmup: negotiate both arms' names once
+    timed("shm")
+    # Fail loudly if the shm arm silently fell back to tcp (no
+    # co-located peers, or establishment failed): a ~1.0x "speedup"
+    # from tcp-vs-tcp is worse than an error.
+    shm_moved = hvd.metrics()["metrics"].get(
+        'horovod_transport_bytes_total{direction="sent",transport="shm"}',
+        0)
+    assert shm_moved > 0, (
+        "transport mode measured nothing on shm — are the ranks "
+        "co-located and is the shm dir writable?")
+    pairs = []
+    for r in range(args.transport_rounds):
+        if r % 2 == 0:
+            a = timed("tcp")
+            b = timed("shm")
+        else:
+            b = timed("shm")
+            a = timed("tcp")
+        pairs.append((a, b))
+    _os.environ["HOROVOD_TRANSPORT"] = "auto"
+    ratios = sorted(a / b for a, b in pairs)
+    n = hvd.size()
+    bus = x.nbytes * 2 * (n - 1) / n
+    return {
+        "bytes": int(x.nbytes),
+        "iters": args.transport_iters,
+        "pairs_ms": [[round(a * 1e3, 2), round(b * 1e3, 2)]
+                     for a, b in pairs],
+        "tcp_ms_median": round(_percentile(
+            sorted(a for a, _ in pairs), 0.5) * 1e3, 2),
+        "shm_ms_median": round(_percentile(
+            sorted(b for _, b in pairs), 0.5) * 1e3, 2),
+        "tcp_busbw_GBps": round(bus / _percentile(
+            sorted(a for a, _ in pairs), 0.5) / 1e9, 3),
+        "shm_busbw_GBps": round(bus / _percentile(
+            sorted(b for _, b in pairs), 0.5) / 1e9, 3),
+        "ratios": [round(r_, 3) for r_ in ratios],
+        "median_speedup": round(_percentile(ratios, 0.5), 3),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="16384,262144,4194304",
@@ -171,12 +251,15 @@ def main():
     p.add_argument("--segment-bytes", type=int, default=None,
                    help="HOROVOD_RING_SEGMENT_BYTES for the segmented "
                         "ring (default: the library default)")
-    p.add_argument("--mode", choices=["bw", "latency", "pipeline"],
+    p.add_argument("--mode",
+                   choices=["bw", "latency", "pipeline", "transport"],
                    default="bw",
                    help="bw: the throughput sweep (default); latency: "
                         "small-op p50/p99 enqueue-to-complete, 1-vs-N "
                         "channels; pipeline: mixed-size async window, "
-                        "channels=1 vs N paired rounds")
+                        "channels=1 vs N paired rounds; transport: "
+                        "tcp-vs-shm order-alternated paired rounds of "
+                        "the segmented ring on co-located ranks")
     p.add_argument("--channels", type=int, default=2,
                    help="the N in the 1-vs-N channel comparisons")
     p.add_argument("--lat-count", type=int, default=16384,
@@ -188,7 +271,20 @@ def main():
                    help="big-op element count (default 8MB)")
     p.add_argument("--pipe-small-count", type=int, default=16384,
                    help="small-op element count (default 64KB)")
+    p.add_argument("--transport-count", type=int, default=4194304,
+                   help="transport-mode element count (default 16MB)")
+    p.add_argument("--transport-iters", type=int, default=5,
+                   help="allreduces per timed arm in transport mode")
+    p.add_argument("--transport-rounds", type=int, default=5,
+                   help="tcp/shm paired rounds in transport mode")
     args = p.parse_args()
+
+    if args.mode == "transport":
+        # Overlay establishment happens at init; the timed loops then
+        # flip only the per-call route. Hard assignment, not
+        # setdefault: an exported HOROVOD_TRANSPORT=tcp would
+        # otherwise silently turn the measurement into tcp-vs-tcp.
+        os.environ["HOROVOD_TRANSPORT"] = "auto"
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -221,6 +317,20 @@ def main():
                     "HOROVOD_CYCLE_EVENT_DRIVEN", "1"),
                 "rows": [{k: (round(v, 1) if isinstance(v, float) else v)
                           for k, v in row.items()} for row in rows]}))
+        return
+
+    if args.mode == "transport":
+        summary = _bench_transport(hvd, np, args, seg_bytes)
+        if r == 0:
+            print(f"transport paired rounds (ms, tcp vs shm): "
+                  f"{summary['pairs_ms']}")
+            print(f"median speedup shm vs tcp: "
+                  f"{summary['median_speedup']}x  "
+                  f"(tcp {summary['tcp_busbw_GBps']} GB/s -> "
+                  f"shm {summary['shm_busbw_GBps']} GB/s busbw)")
+            print(json.dumps(dict(
+                {"metric": "eager_allreduce_transport", "np": n},
+                **summary)))
         return
 
     if args.mode == "pipeline":

@@ -36,6 +36,15 @@ CTRL_CHANNEL = 0xFF
 # into a demux inbox, never awaited, and never block a collective.
 HEALTH_CHANNEL = 0xFE
 
+def is_data_channel(channel: int) -> bool:
+    """True for executor data lanes (0..MAX_CHANNELS-1). Control-plane
+    and heartbeat frames are NOT data: they always ride the TCP mesh —
+    the socket is the liveness substrate — while data channels may be
+    routed to a per-peer overlay transport (shared memory for
+    co-located ranks, HOROVOD_TRANSPORT)."""
+    return channel < HEALTH_CHANNEL
+
+
 # The active executor channel is thread-scoped, not call-threaded: one
 # thread runs one response at a time, so a thread-local avoids plumbing
 # a channel argument through every collective signature (engine op
@@ -64,6 +73,20 @@ def channel_scope(channel: int):
             _channel_ctx.channel = prev
 
 
+def desync_message(got, want, rank: Optional[int] = None,
+                   peer: Optional[int] = None) -> str:
+    """The one place the frame-length-mismatch ("desynced peer") error
+    text and its HOROVOD_RING_SEGMENT_BYTES hint live. Ring protocols
+    are size-deterministic, so a length mismatch means the stream
+    position is unrecoverable — every transport (TCP, shm, in-process)
+    raises this same message so the hint can never drift."""
+    who = f"rank {rank}: " if rank is not None else ""
+    src = f" from peer {peer}" if peer is not None else ""
+    return (f"{who}frame length {got} != expected {want}{src} "
+            f"(desynced peer; check HOROVOD_RING_SEGMENT_BYTES matches "
+            f"on every rank)")
+
+
 class Backend(ControllerTransport):
     """Combined control-plane transport + data-plane collectives
     (the reference splits these into Controller and ops; the TCP socket
@@ -85,6 +108,22 @@ class Backend(ControllerTransport):
     # MPIHierarchicalAllgather) — set by the engine from the collectively
     # agreed topology validity.
     hier_allgather: bool = False
+    # Leader-based cross-host schedule allowed (HOROVOD_HIERARCHICAL_MODE
+    # =auto resolves through this): set by the ENGINE from a collectively
+    # AND-agreed capability bit — every co-located pair on every host has
+    # a live shared-memory overlay — so no rank can pick a different
+    # schedule. Tests may set it directly on hand-built backends.
+    leader_hier_ok: bool = False
+    # Intra-host collective arena (backend/shm.py ShmArenaSet), set by
+    # mesh backends when the WHOLE group is co-located; the eligibility
+    # predicate (backend/ring.py arena_eligible) gates on it.
+    arena_set = None
+
+    def prefers_leader_hierarchy(self) -> bool:
+        """This rank's LOCAL vote for the leader schedule (intra-host
+        bytes ~free, e.g. over shm). Folded into the engine's validity
+        agreement; never consulted directly by the data plane."""
+        return False
     # Tracing plane (common/tracing.py): the engine installs its tracer
     # here so backend phase spans (ring segment recv/reduce, star
     # gather/bcast, TCP sender dwell) land in the same flight recorder

@@ -37,7 +37,8 @@ import numpy as np
 
 from ..common.exceptions import HorovodInternalError
 from ..common.types import ReduceOp
-from .base import _reduce
+from .base import _reduce, desync_message
+from .transport import COMPLETED as _COMPLETED
 from .star import (
     StarCollectivesMixin,
     as_byte_view,
@@ -84,16 +85,9 @@ def _reduce_into(op: ReduceOp, tgt: np.ndarray, incoming: np.ndarray):
         ufunc(tgt, incoming, out=tgt)
 
 
-class _CompletedTicket:
-    """No-op ticket for transports whose send_to never blocks."""
-
-    __slots__ = ()
-
-    def wait(self):
-        pass
-
-
-_COMPLETED = _CompletedTicket()
+# _COMPLETED (imported above): the transport layer's shared no-op
+# ticket for sends that never block — one class, so an identity or
+# behavior change can never miss a copy.
 
 
 # -- eligibility predicates -------------------------------------------
@@ -134,12 +128,51 @@ def ring_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
     )
 
 
+def arena_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
+    """Intra-host arena allreduce (backend/shm.py ShmArena): highest-
+    priority plane, available only when the mesh backend established a
+    whole-world co-located arena at init AND HOROVOD_TRANSPORT still
+    routes to shared memory at call time. Every input is collectively
+    consistent: arena existence comes from rendezvous-agreed locality,
+    the env knobs are launcher-propagated (benchmarks flip them between
+    barriers), and nbytes/op are coordinator-negotiated."""
+    if getattr(backend, "arena_set", None) is None:
+        return False
+    if os.environ.get("HOROVOD_CPU_OPERATIONS", "").lower() in (
+            "star", "ring"):
+        return False
+    from ..utils import env as env_cfg
+
+    if env_cfg.transport_mode() == "tcp":
+        return False
+    return op in _RING_OPS and nbytes >= ring_threshold()
+
+
 def hierarchical_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
     return (
         ring_eligible(backend, nbytes, op)
         and backend.hierarchical
         and hierarchy_valid(backend)
     )
+
+
+def hierarchical_mode(backend) -> str:
+    """Cross-host schedule for the two-level allreduce: "slice" (every
+    local rank drives its own cross ring on its owned slice — parallel
+    inter-host streams) or "leader" (one leader per host gathers the
+    host-reduced vector over the intra-host transport and runs a single
+    segmented inter-host ring — the NCCL-hierarchical shape, the right
+    call when intra-host bytes are ~free over shared memory).
+    HOROVOD_HIERARCHICAL_MODE=auto resolves through the backend's
+    `leader_hier_ok` flag, which the ENGINE sets from a collectively
+    agreed capability bit — a per-rank local answer here could deadlock
+    the schedule."""
+    from ..utils import env as env_cfg
+
+    mode = env_cfg.hierarchical_mode()
+    if mode != "auto":
+        return mode
+    return "leader" if getattr(backend, "leader_hier_ok", False) else "slice"
 
 
 def ring_allgather_eligible(backend, nbytes: int) -> bool:
@@ -211,6 +244,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
         # the same element count and reaches the same ring/star decision
         # from its own arr.nbytes. The hierarchical toggle flips only at
         # autotune sync boundaries, collectively.
+        if arena_eligible(self, arr.nbytes, op):
+            return self._arena_allreduce(arr, op)
         if hierarchical_eligible(self, arr.nbytes, op):
             return self._hierarchical_allreduce(arr, op)
         if ring_eligible(self, arr.nbytes, op):
@@ -373,9 +408,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
         view = as_byte_view(buf)
         if len(data) != len(view):
             raise HorovodInternalError(
-                f"rank {self.rank}: frame length {len(data)} != expected "
-                f"{len(view)} from peer {peer} (desynced peer; check "
-                f"HOROVOD_RING_SEGMENT_BYTES matches on every rank)")
+                desync_message(len(data), len(view),
+                               rank=self.rank, peer=peer))
         if data:
             view[:] = data
         return len(data)
@@ -561,20 +595,79 @@ class RingCollectivesMixin(StarCollectivesMixin):
             flat = (flat / self.size).astype(arr.dtype)
         return flat.reshape(arr.shape)
 
+    def _arena_allreduce(self, arr: np.ndarray, op: ReduceOp,
+                         owned: bool = False) -> np.ndarray:
+        """Whole-world intra-host allreduce through the shared-memory
+        arena: deposit once, reduce an equal subslice straight from
+        every peer's slot, copy the shared result out. The arena is
+        keyed by the calling thread's executor channel — cross-rank
+        ordering is per-channel FIFO (PR 4's invariant), so barrier
+        generations advance in lockstep on every rank."""
+        from .base import current_channel
+
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        # No defensive input copy: unlike the in-place ring, the arena
+        # reads the input and writes a separate output, so a caller-
+        # owned tensor is never mutated — the ring path's biggest
+        # per-op memcpy simply disappears here.
+        out = flat if (owned or not np.shares_memory(flat, arr)) \
+            else np.empty_like(flat)
+        red = op if op != ReduceOp.AVERAGE else ReduceOp.SUM
+        ufunc = _INPLACE_UFUNC[red]
+        arena = self.arena_set.get(current_channel())
+        tr = self.tracer
+        try:
+            with tr.span("shm.arena_allreduce", cat="xfer",
+                         args={"bytes": int(flat.nbytes)}):
+                arena.allreduce_into(
+                    flat, lambda dst, src: ufunc(dst, src, out=dst),
+                    out=out)
+        except (OSError, TimeoutError) as exc:
+            from ..common.exceptions import TransportError
+
+            reason = None
+            get_dead = getattr(self, "_arena_dead_reason", None)
+            if get_dead is not None:
+                reason = get_dead()
+            raise TransportError(
+                reason or (f"rank {self.rank}: shm arena allreduce "
+                           f"failed: {exc}"),
+                reporter=self.rank, root_cause=reason) from exc
+        if op == ReduceOp.AVERAGE:
+            out = (out / self.size).astype(arr.dtype)
+        return out.reshape(arr.shape)
+
     def _hierarchical_allreduce(self, arr: np.ndarray, op: ReduceOp,
                                 owned: bool = False) -> np.ndarray:
+        """Two-level allreduce; the cross-host schedule is picked by
+        `hierarchical_mode` (slice-parallel or leader-based — see its
+        docstring). Both start with an intra-host ring reduce-scatter,
+        which rides the shm overlay wherever peers are co-located."""
+        L = self.local_size
+        base = self.cross_rank * L
+        local_group = list(range(base, base + L))
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        if not owned and np.shares_memory(flat, arr):
+            flat = flat.copy()
+
+        if hierarchical_mode(self) == "leader":
+            self._hierarchical_leader(local_group, flat, op)
+        else:
+            self._hierarchical_slice(local_group, flat, op)
+
+        if op == ReduceOp.AVERAGE:
+            flat = (flat / self.size).astype(arr.dtype)
+        return flat.reshape(arr.shape)
+
+    def _hierarchical_slice(self, local_group: List[int], flat: np.ndarray,
+                            op: ReduceOp):
         """Local reduce-scatter -> cross allreduce per slice -> local
         allgather (ref: NCCLHierarchicalAllreduce's ReduceScatter /
         cross-MPI_Allreduce / AllGather shape, nccl_operations.cc:190-405;
         here the cross phase rides the DCN-equivalent links while each
         local ring stays on its host's links)."""
         L = self.local_size
-        base = self.cross_rank * L
-        local_group = list(range(base, base + L))
         cross_group = [self.local_rank + h * L for h in range(self.cross_size)]
-        flat = np.ascontiguousarray(arr).reshape(-1)
-        if not owned and np.shares_memory(flat, arr):
-            flat = flat.copy()
 
         # Phase A: local reduce-scatter; position local_rank ends owning
         # local chunk (local_rank+1)%L, reduced across the host.
@@ -593,6 +686,51 @@ class RingCollectivesMixin(StarCollectivesMixin):
         # Phase C: local allgather of the fully reduced chunks.
         self._ring_allgather_chunks(local_group, flat)
 
-        if op == ReduceOp.AVERAGE:
-            flat = (flat / self.size).astype(arr.dtype)
-        return flat.reshape(arr.shape)
+    def _hierarchical_leader(self, local_group: List[int], flat: np.ndarray,
+                             op: ReduceOp):
+        """Leader-based two-level schedule: intra-host ring
+        reduce-scatter -> gather the reduced slices to the host leader
+        -> ONE segmented inter-host ring between leaders -> intra-host
+        bcast of the result. The right shape when intra-host bytes are
+        ~free (shared memory) and inter-host links favor one stream per
+        host pair; gather/bcast legs use send_async so the leader's
+        per-peer senders stream to all members concurrently."""
+        L = self.local_size
+        base = local_group[0]
+        leader = base
+        bounds = self._bounds(flat.size, L)
+
+        def owned_slice(local_rank: int) -> np.ndarray:
+            own = (local_rank + 1) % L
+            return flat[bounds[own]: bounds[own + 1]]
+
+        # Phase A: intra-host reduce-scatter (over shm when co-located).
+        self._ring_reduce_scatter(local_group, flat, op)
+
+        tr = self.tracer
+        if self.rank == leader:
+            # Phase B1: collect every member's reduced slice — the
+            # leader then holds the full host-reduced vector.
+            with tr.span("hier.leader_gather", cat="xfer",
+                         args={"bytes": int(flat.nbytes)}):
+                for i in range(1, L):
+                    seg = owned_slice(i)
+                    if seg.size:
+                        self.recv_into_from(base + i, seg)
+            # Phase B2: segmented inter-host ring between leaders only.
+            leaders = [h * L for h in range(self.cross_size)]
+            self._ring_allreduce_group(leaders, flat, op)
+            # Phase C: intra-host bcast of the finished vector.
+            with tr.span("hier.leader_bcast", cat="xfer",
+                         args={"bytes": int(flat.nbytes)}):
+                tickets = [self.send_async(base + i, flat)
+                           for i in range(1, L)]
+                for t in tickets:
+                    t.wait()
+        else:
+            with tr.span("hier.member_exchange", cat="xfer",
+                         args={"bytes": int(flat.nbytes)}):
+                seg = owned_slice(self.local_rank)
+                if seg.size:
+                    self.send_to(leader, seg)
+                self.recv_into_from(leader, flat)

@@ -1,0 +1,800 @@
+"""Intra-host shared-memory data plane: mmap'd per-peer ring buffers.
+
+The motivation is PAPER.md's hierarchical design: between co-located
+ranks, TCP-through-the-kernel has nothing to offer — loopback frames
+still pay two syscalls and two kernel copies per hop, and the PR 4
+measurements showed lane concurrency nets ~parity there because there
+is no wire latency to hide. An mmap ring buffer does not have that
+problem: a frame costs one userspace memcpy in and one out, with no
+kernel transition on the fast path.
+
+Layout: one file per co-located peer PAIR (created under
+``HOROVOD_SHM_DIR``, named by mesh scope + a rendezvous-published job
+nonce so two jobs on one host can never collide), holding two
+single-producer/single-consumer byte rings — one per direction. Each
+ring is a 64-byte header (u64 write cursor, u64 read cursor, u8
+closed flag; cursors are free-running, so ``head - tail`` is the
+unread byte count) followed by ``HOROVOD_SHM_RING_BYTES`` of data.
+Frames use the shared transport framing (u64 length + u8 channel tag)
+and **stream** through the ring: a frame larger than the capacity is
+written and consumed concurrently in bounded-buffer pipe fashion, so
+the ring bounds memory, never message size. Cursor updates are
+aligned 8-byte stores published strictly after their payload bytes
+(x86-TSO makes that ordering visible cross-process; CPython executes
+the statements in order).
+
+Waiting is futex/eventfd-free polling with bounded spin→sleep: a
+reader (or a writer stalled on a full ring — counted in
+``horovod_shm_ring_full_total``) re-checks the cursors in a short
+burst, yields the scheduler a few times (sleep(0) — GIL-releasing,
+core-donating under oversubscription), then sleeps on an exponential
+50µs→500µs backoff. The idle bound
+honors the generic transport timeout (``HOROVOD_TCP_TIMEOUT_SECONDS``,
+progress-reset like the TCP recv heartbeat), and every wait iteration
+checks the sever flag — so when the liveness plane declares the peer
+dead over TCP (heartbeats ALWAYS ride the sockets; the kernel FIN is
+the bounded-detection substrate), parked shm I/O unblocks immediately
+with the attributed verdict.
+
+Failure model (docs/fault_tolerance.md): a peer that dies is detected
+by the TCP plane (FIN/RST or heartbeat silence) and the backend severs
+the whole peer — socket and shm overlay together; a desynced stream
+(frame-length mismatch) severs exactly like TCP; the ring file of a
+SIGKILLed job is unlinked by the surviving side's close, and stale
+files from a whole-job kill are a few MB of /dev/shm reclaimed at the
+next boot or by the next run's nonce-scoped establishment.
+"""
+from __future__ import annotations
+
+import collections
+import mmap
+import os
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common import fault_injection
+from ..utils.logging import get_logger
+from .base import HEALTH_CHANNEL, desync_message
+from .star import as_byte_view
+from .transport import (
+    FRAME_HDR,
+    FRAME_HDR_LEN,
+    PeerSender,
+    Transport,
+    register_transport,
+)
+
+logger = get_logger()
+
+_U64 = struct.Struct("<Q")
+_RING_HDR = 64  # one cache line each for head/tail would be ideal;
+                # 64 bytes total keeps the math simple and false
+                # sharing negligible at these frame sizes.
+_OFF_HEAD = 0
+_OFF_TAIL = 8
+_OFF_CLOSED = 16
+
+# Spin→sleep schedule: a few cheap re-checks (a cursor load each),
+# then sched_yield (sleep(0) — releases the GIL every call, so a
+# waiting reader can never hold off its own process's other threads
+# for a switch interval, and donates the core under oversubscription),
+# then exponential real sleeps. The cap trades idle CPU for wake
+# latency; 500µs keeps a parked reader well under 1% of a core while a
+# streaming one never sleeps at all.
+_SPIN = 8
+_YIELDS = 32
+_SLEEP_MIN = 50e-6
+_SLEEP_MAX = 5e-4
+
+
+class _Waiter:
+    """One spin→yield→sleep backoff with a progress-reset idle
+    deadline — the single wait policy every shm loop shares (ring
+    reads, ring-full send stalls, arena barriers), so the schedule
+    and its timeout semantics can never drift between them.
+    ``progress()`` after each productive step; ``pause(what)`` for one
+    backoff step (raises TimeoutError past the idle bound)."""
+
+    __slots__ = ("timeout", "peer", "spin", "sleep_s", "deadline")
+
+    def __init__(self, timeout: float, peer):
+        self.timeout = timeout
+        self.peer = peer
+        self.spin = 0
+        self.sleep_s = _SLEEP_MIN
+        self.deadline = (time.monotonic() + timeout
+                         if timeout > 0 else None)
+
+    def progress(self) -> None:
+        self.spin = 0
+        self.sleep_s = _SLEEP_MIN
+        if self.deadline is not None:
+            self.deadline = time.monotonic() + self.timeout
+
+    def pause(self, what: str) -> None:
+        self.spin += 1
+        if self.spin <= _SPIN:
+            return
+        if self.spin <= _SPIN + _YIELDS:
+            time.sleep(0)
+            return
+        time.sleep(self.sleep_s)
+        self.sleep_s = min(self.sleep_s * 2, _SLEEP_MAX)
+        if self.deadline is not None \
+                and time.monotonic() > self.deadline:
+            raise TimeoutError(
+                f"shm {what} involving peer {self.peer} made no "
+                f"progress for {self.timeout:.1f}s "
+                f"(HOROVOD_TCP_TIMEOUT_SECONDS)")
+
+
+def ring_file_name(scope: str, nonce: str, a: int, b: int) -> str:
+    lo, hi = (a, b) if a < b else (b, a)
+    return f"hvd_shm_{scope}_{nonce}_{lo}x{hi}"
+
+
+class _Ring:
+    """One direction's SPSC byte ring over a shared memoryview. Bulk
+    copies go through numpy uint8 views (`data`) — numpy's contiguous
+    memcpy releases the GIL, so a 2MB ring write never holds off the
+    same process's reader thread the way a memoryview slice assignment
+    (GIL-held memcpy) would."""
+
+    __slots__ = ("mv", "data", "cap")
+
+    def __init__(self, mv: memoryview, cap: int):
+        import numpy as np
+
+        self.mv = mv          # header + data region
+        self.cap = cap
+        self.data = np.frombuffer(
+            mv[_RING_HDR:_RING_HDR + cap], dtype=np.uint8)
+
+    def head(self) -> int:
+        return _U64.unpack_from(self.mv, _OFF_HEAD)[0]
+
+    def set_head(self, v: int) -> None:
+        _U64.pack_into(self.mv, _OFF_HEAD, v & 0xFFFFFFFFFFFFFFFF)
+
+    def tail(self) -> int:
+        return _U64.unpack_from(self.mv, _OFF_TAIL)[0]
+
+    def set_tail(self, v: int) -> None:
+        _U64.pack_into(self.mv, _OFF_TAIL, v & 0xFFFFFFFFFFFFFFFF)
+
+    def closed(self) -> bool:
+        return self.mv[_OFF_CLOSED] != 0
+
+    def set_closed(self) -> None:
+        self.mv[_OFF_CLOSED] = 1
+
+
+class ShmTransport(Transport):
+    """Shared-memory Transport endpoint for one co-located peer.
+
+    Producer side is serialized by an in-process wire mutex (the
+    process is the single producer the SPSC ring needs; its threads
+    take the lock). Consumer side enforces the single-reader-at-a-time
+    demux contract with the same inbox/condition structure the TCP
+    demultiplexer uses. Sync sends fast-path the ring directly while
+    their channel has nothing queued on the persistent sender worker —
+    the same ordering rule as TCP's sender fast path."""
+
+    name = "shm"
+
+    def __init__(self, backend, peer: int, path: str, ring_bytes: int,
+                 timeout: float = 0.0, poll: float = 1.0):
+        self.backend = backend
+        self.rank = backend.rank
+        self.peer = peer
+        self.path = path
+        self.cap = int(ring_bytes)
+        self._timeout = timeout
+        self._poll = poll
+        self._injector = fault_injection.get_injector()
+        size = 2 * (_RING_HDR + self.cap)
+        # Both sides open with O_CREAT and size the file identically —
+        # ftruncate to the same length is idempotent, and a zero-filled
+        # file IS the valid initial ring state (head == tail == 0), so
+        # no initialization handshake is needed beyond the rendezvous
+        # nonce in the name.
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        mv = memoryview(self._mm)
+        half = _RING_HDR + self.cap
+        lo_first = _Ring(mv[:half], self.cap)
+        hi_first = _Ring(mv[half:], self.cap)
+        if self.rank < peer:
+            self._tx, self._rx = lo_first, hi_first
+        else:
+            self._tx, self._rx = hi_first, lo_first
+        self._mv = mv
+        self._severed = threading.Event()
+        self._closed_local = False
+        self._wire_lock = threading.Lock()
+        self._sender: Optional[PeerSender] = None
+        self._sender_lock = threading.Lock()
+        # Receive demux (single reader at a time; foreign-channel frames
+        # deposited into per-channel inboxes).
+        self._cond = threading.Condition()
+        self._inbox: Dict[int, "collections.deque"] = {}
+        self._reading = False
+        # drain_idle progress watermark: peer write-cursor position at
+        # the last sweep (progress without consuming is still evidence
+        # of life).
+        self._seen_head = self._rx.head()
+        # Telemetry, installed by the owning backend (transport byte
+        # counters + ring-full backpressure stalls). Inert by default.
+        self.m_sent = None
+        self.m_recv = None
+        self.m_ring_full = None
+        self.activity_cb = None
+        self.health_cb = None
+        # The wire-write entry point for BOTH the sync fast path and
+        # the sender worker. An owning backend rebinds it to a
+        # translating wrapper so ticket errors honor the attributed
+        # TransportError contract (never a raw ConnectionError).
+        self.send_fn = self._send_direct
+
+    # -- low-level ring I/O --------------------------------------------
+    def _check_dead(self, what: str):
+        if self._severed.is_set() or self._closed_local:
+            raise ConnectionError(
+                f"shm link to peer {self.peer} severed during {what}")
+
+    def _write_views(self, views: List[memoryview], channel: int) -> int:
+        """Stream [header, *views] into the tx ring. Caller holds the
+        wire mutex. Returns payload bytes written."""
+        total = sum(len(v) for v in views)
+        pieces = [memoryview(FRAME_HDR.pack(total, channel))]
+        pieces += [v for v in views if len(v)]
+        ring = self._tx
+        cap = self.cap
+        head = ring.head()
+        stalled = False
+        import numpy as np
+
+        waiter = _Waiter(self._timeout, self.peer)
+        for piece in pieces:
+            src = np.frombuffer(piece, dtype=np.uint8)
+            off, n = 0, len(src)
+            while off < n:
+                free = cap - (head - ring.tail())
+                if free > 0:
+                    k = min(free, n - off)
+                    pos = head % cap
+                    run = min(k, cap - pos)
+                    ring.data[pos:pos + run] = src[off:off + run]
+                    if k > run:
+                        ring.data[:k - run] = src[off + run:off + k]
+                    head += k
+                    # Publish strictly after the payload bytes land.
+                    ring.set_head(head)
+                    off += k
+                    waiter.progress()
+                    continue
+                # Ring full: backpressure. Count once per stall episode.
+                if not stalled:
+                    stalled = True
+                    if self.m_ring_full is not None:
+                        self.m_ring_full.inc()
+                self._check_dead("send")
+                if self._rx.closed():
+                    raise ConnectionError(
+                        f"peer {self.peer} closed its shm endpoint")
+                waiter.pause("send to")
+        if self.m_sent is not None:
+            self.m_sent.inc(total + FRAME_HDR_LEN)
+        return total
+
+    def _read_into(self, view: memoryview) -> None:
+        """Stream exactly len(view) bytes out of the rx ring (caller
+        holds the reading flag)."""
+        import numpy as np
+
+        ring = self._rx
+        cap = self.cap
+        tail = ring.tail()
+        dst = np.frombuffer(view, dtype=np.uint8)
+        got, n = 0, len(dst)
+        waiter = _Waiter(self._timeout, self.peer)
+        while got < n:
+            avail = ring.head() - tail
+            if avail > 0:
+                k = min(avail, n - got)
+                pos = tail % cap
+                run = min(k, cap - pos)
+                dst[got:got + run] = ring.data[pos:pos + run]
+                if k > run:
+                    dst[got + run:got + k] = ring.data[:k - run]
+                tail += k
+                # Publish consumption strictly after the copy-out: the
+                # producer may overwrite the freed span immediately.
+                ring.set_tail(tail)
+                got += k
+                waiter.progress()
+                continue
+            self._check_dead("recv")
+            if ring.closed():
+                raise ConnectionError(
+                    f"peer {self.peer} closed its shm endpoint")
+            waiter.pause("recv from")
+
+    def _read_header(self):
+        hdr = bytearray(FRAME_HDR_LEN)
+        self._read_into(memoryview(hdr))
+        return FRAME_HDR.unpack(bytes(hdr))
+
+    # -- sends ---------------------------------------------------------
+    def _send_direct(self, payload, channel: int) -> None:
+        """The single wire-write path (sync fast path and sender worker
+        both land here): fault-injection verdicts apply, then the frame
+        streams into the ring under the wire mutex."""
+        if self._injector.active:
+            if (self._injector.check_io(self.rank, self.peer, "send")
+                    == fault_injection.DROP):
+                return
+        self._check_dead("send")
+        items = payload if isinstance(payload, (list, tuple)) else (payload,)
+        views = [as_byte_view(i) for i in items]
+        with self._wire_lock:
+            self._write_views(views, channel)
+
+    def _sender_for(self) -> PeerSender:
+        with self._sender_lock:
+            snd = self._sender
+            if snd is None:
+                snd = self._sender = PeerSender(
+                    lambda payload, ch: self.send_fn(payload, ch),
+                    f"shm-{self.peer}",
+                    trace_emit=self._trace_dwell)
+            return snd
+
+    def _trace_dwell(self, channel: int, t_enq: int, trace_id) -> None:
+        tr = getattr(self.backend, "tracer", None)
+        if tr is not None and tr.enabled and channel != HEALTH_CHANNEL:
+            from ..utils import clock
+
+            tr.emit("shm.sender_dwell", "xfer", t_enq,
+                    clock.mono_ns() - t_enq, trace_id=trace_id,
+                    args={"peer": self.peer, "channel": channel})
+
+    def send(self, payload, channel: int) -> None:
+        snd = self._sender
+        if snd is None or snd.channel_idle(channel):
+            self.send_fn(payload, channel)
+            return
+        snd.send(payload, channel).wait()
+
+    def send_async(self, payload, channel: int):
+        """Async send with an inline fast path: when the frame fits in
+        the ring's current free space (and the wire mutex is free, and
+        this channel has nothing queued on the sender worker — FIFO
+        within a channel is the ordering contract), write it NOW and
+        return a completed ticket. The ring buffer itself is the async
+        buffer, so this cannot block — and it keeps the hot ring-
+        allreduce path free of thread ping-pong, which on an
+        oversubscribed box costs more than the copies do. Anything
+        that could block falls back to the persistent sender worker."""
+        from .transport import COMPLETED
+
+        self._check_dead("send")
+        snd = self._sender
+        if snd is None or snd.channel_idle(channel):
+            items = (payload if isinstance(payload, (list, tuple))
+                     else (payload,))
+            views = [as_byte_view(i) for i in items]
+            need = sum(len(v) for v in views) + FRAME_HDR_LEN
+            if need <= self.cap and self._wire_lock.acquire(blocking=False):
+                try:
+                    ring = self._tx
+                    if self.cap - (ring.head() - ring.tail()) >= need:
+                        if self._injector.active:
+                            if (self._injector.check_io(
+                                    self.rank, self.peer, "send")
+                                    == fault_injection.DROP):
+                                return COMPLETED
+                        self._write_views(views, channel)
+                        return COMPLETED
+                finally:
+                    self._wire_lock.release()
+        return self._sender_for().send(payload, channel)
+
+    # -- receives ------------------------------------------------------
+    def _demux_recv(self, channel: int,
+                    view: Optional[memoryview]) -> Optional[bytearray]:
+        """Same structure as the TCP per-peer demultiplexer: one reader
+        at a time; foreign-channel frames deposited; health frames
+        consumed on the spot."""
+        while True:
+            with self._cond:
+                while True:
+                    q = self._inbox.get(channel)
+                    if q:
+                        buf = q.popleft()
+                        if view is None:
+                            return buf
+                        if len(buf) != len(view):
+                            raise OSError(desync_message(
+                                len(buf), len(view), peer=self.peer))
+                        view[:] = buf
+                        return None
+                    if self._severed.is_set():
+                        raise ConnectionError(
+                            f"shm link to peer {self.peer} severed")
+                    if not self._reading:
+                        self._reading = True
+                        break
+                    self._cond.wait(self._poll)
+            deposit = None
+            got_mine = False
+            try:
+                n, ch = self._read_header()
+                if ch == channel:
+                    if view is not None:
+                        if n != len(view):
+                            raise OSError(desync_message(
+                                n, len(view), peer=self.peer))
+                        self._read_into(view)
+                        result = None
+                    else:
+                        result = bytearray(n)
+                        self._read_into(memoryview(result))
+                    got_mine = True
+                elif ch == HEALTH_CHANNEL:
+                    payload = bytearray(n)
+                    self._read_into(memoryview(payload))
+                    hb = self.health_cb
+                    if hb is not None:
+                        hb(self.peer, bytes(payload))
+                else:
+                    payload = bytearray(n)
+                    self._read_into(memoryview(payload))
+                    deposit = (ch, payload)
+                if self.m_recv is not None:
+                    self.m_recv.inc(n + FRAME_HDR_LEN)
+                cb = self.activity_cb
+                if cb is not None:
+                    cb(self.peer)
+            finally:
+                with self._cond:
+                    self._reading = False
+                    if deposit is not None:
+                        self._inbox.setdefault(
+                            deposit[0], collections.deque()
+                        ).append(deposit[1])
+                    self._cond.notify_all()
+            if got_mine:
+                return result
+
+    def recv(self, channel: int) -> bytearray:
+        return self._demux_recv(channel, None)
+
+    def recv_into(self, view: memoryview, channel: int) -> int:
+        self._demux_recv(channel, view)
+        return len(view)
+
+    # -- liveness ------------------------------------------------------
+    def drain_idle(self, max_frames: int = 64) -> int:
+        """Progress observation without consuming: the peer's write
+        cursor advancing since the last sweep proves it is alive even
+        if no reader is currently parked on this ring — the shm
+        analogue of the TCP idle drain, minus the consuming (there is
+        no kernel buffer to free here, so observation suffices)."""
+        head = self._rx.head()
+        if head != self._seen_head:
+            self._seen_head = head
+            cb = self.activity_cb
+            if cb is not None:
+                cb(self.peer)
+        return 0
+
+    def sever(self) -> None:
+        self._severed.set()
+        # Tell the peer too: its parked reads/writes see our closed
+        # flag and unblock into their own sever path.
+        if not self._closed_local:
+            try:
+                self._tx.set_closed()
+            except (ValueError, IndexError):  # pragma: no cover - unmapped
+                pass
+        with self._cond:
+            self._cond.notify_all()
+        snd = self._sender
+        if snd is not None:
+            snd.stop()
+
+    @property
+    def alive(self) -> bool:
+        return not (self._severed.is_set() or self._closed_local)
+
+    def status(self) -> dict:
+        return {
+            "transport": self.name,
+            "alive": self.alive,
+            "path": self.path,
+            "ring_bytes": self.cap,
+            "tx_backlog_bytes": self._tx.head() - self._tx.tail(),
+            "rx_backlog_bytes": self._rx.head() - self._rx.tail(),
+        }
+
+    def close(self) -> None:
+        """Orderly local teardown: stop the sender, mark both the
+        shared closed flag and the local sever, and unlink the ring
+        file (both sides try; first wins, the mapping stays valid for
+        any straggler thread until process exit — munmap under a
+        racing reader would be a segfault, so we deliberately leak the
+        map until GC)."""
+        self.sever()
+        snd = self._sender
+        if snd is not None:
+            snd.thread.join(timeout=5)
+        self._closed_local = True
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def _shm_factory(backend, peer: int, *, path: str, ring_bytes: int,
+                 timeout: float = 0.0, poll: float = 1.0) -> ShmTransport:
+    return ShmTransport(backend, peer, path=path, ring_bytes=ring_bytes,
+                        timeout=timeout, poll=poll)
+
+
+register_transport("shm", _shm_factory)
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory ARENA: true intra-host collectives for fully co-located
+# groups. The per-pair rings above move bytes rank-to-rank — which, on
+# one host, still costs the same aggregate memcpy a kernel socket does.
+# The arena is the structural win shared memory uniquely enables: every
+# rank deposits its flat buffer into a per-rank SLOT of one shared
+# region, and each rank then reduces an equal SUBSLICE directly from
+# every peer's slot into a shared result — no per-step neighbor
+# ordering (2 data movements + 3 barriers per chunk, vs 2(n-1)
+# scheduled segment exchanges for the ring), and the reduction reads
+# peers' bytes IN PLACE instead of copying them through a private
+# scratch first. This is the MPI-3 shared-memory-window / NCCL
+# intra-node shape from the reference's hierarchical design.
+#
+# Concurrency contract: ONE arena instance serves ONE executor channel.
+# Channel executors run collectives concurrently, and cross-rank
+# ordering is only guaranteed WITHIN a channel (PR 4's invariant), so
+# the owning backend keys arenas by channel — barrier generations then
+# advance in lockstep on every rank by construction.
+_ARENA_HDR_MIN = 4096  # u64 seq counter per rank at a 64-byte stride
+_ARENA_SEQ_STRIDE = 64
+
+
+def _arena_header_bytes(size: int) -> int:
+    """Seq-counter region, page-rounded and sized from the GROUP so a
+    co-located group larger than 64 ranks can never overflow into slot
+    0's payload. Deterministic from `size` alone — every member
+    computes the same layout."""
+    need = _ARENA_SEQ_STRIDE * size
+    return max(_ARENA_HDR_MIN, (need + 4095) // 4096 * 4096)
+
+
+class ShmArena:
+    """One channel's intra-host collective arena (see block comment)."""
+
+    def __init__(self, path: str, index: int, size: int, slot_bytes: int,
+                 timeout: float = 0.0):
+        import numpy as np
+
+        self.path = path
+        self.index = index          # my position in the co-located group
+        self.size = size            # group size
+        self.slot_bytes = (int(slot_bytes) + 63) // 64 * 64
+        self._timeout = timeout
+        self._gen = 0
+        self._severed: Optional[str] = None
+        # Backend-installed: returns a root-cause string when any group
+        # member has been declared dead (the TCP liveness plane's
+        # verdict), bounding barrier waits without any shm-side
+        # heartbeat.
+        self.dead_cb = None
+        # Transport byte counters (shm): deposit counts as "sent",
+        # copy-out as "recv" — the arena's two private<->shared moves.
+        self.m_sent = None
+        self.m_recv = None
+        self._hdr = _arena_header_bytes(size)
+        file_size = self._hdr + (size + 1) * self.slot_bytes
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, file_size)
+            self._mm = mmap.mmap(fd, file_size)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._mm)
+        self._u8 = np.frombuffer(self._mm, dtype=np.uint8)
+
+    # -- seq-counter barrier -------------------------------------------
+    def _publish(self, value: int) -> None:
+        _U64.pack_into(self._mv, _ARENA_SEQ_STRIDE * self.index, value)
+
+    def _seq(self, r: int) -> int:
+        return _U64.unpack_from(self._mv, _ARENA_SEQ_STRIDE * r)[0]
+
+    def _wait_all(self, value: int, what: str) -> None:
+        waiter = _Waiter(self._timeout, "arena group")
+        while True:
+            laggard = -1
+            for r in range(self.size):
+                if r != self.index and self._seq(r) < value:
+                    laggard = r
+                    break
+            if laggard < 0:
+                return
+            if self._severed is not None:
+                raise ConnectionError(
+                    f"shm arena severed during {what}: {self._severed}")
+            cb = self.dead_cb
+            if cb is not None:
+                reason = cb()
+                if reason is not None:
+                    raise ConnectionError(
+                        f"shm arena {what} aborted: {reason}")
+            waiter.pause(f"arena {what} (waiting on rank {laggard})")
+
+    # -- regions -------------------------------------------------------
+    def _slot(self, r: int):
+        off = self._hdr + r * self.slot_bytes
+        return self._u8[off:off + self.slot_bytes]
+
+    @property
+    def _result(self):
+        return self._slot(self.size)
+
+    # -- collectives ---------------------------------------------------
+    def allreduce_into(self, flat, reduce_fn, out=None) -> None:
+        """Allreduce of a contiguous 1-D numpy array: reads ``flat``,
+        writes ``out`` (defaults to ``flat`` — in place). Separate
+        src/dst is what lets the caller skip the ring path's defensive
+        input copy: the arena never mutates ``flat`` when given a
+        fresh ``out``. ``reduce_fn(dst, src)`` accumulates src into
+        dst in place (the caller picks the ufunc for the op; AVERAGE
+        divides outside). Chunks of ``slot_bytes`` stream through the
+        arena: deposit → barrier → every rank reduces its equal
+        subslice straight from all slots into the shared result →
+        barrier → copy out → barrier (so the next chunk can never
+        clobber a result a laggard is still reading)."""
+        import numpy as np
+
+        if out is None:
+            out = flat
+        itemsize = flat.itemsize
+        chunk_elems = max(self.slot_bytes // itemsize, 1)
+        total = flat.size
+        src_u8 = flat.view(np.uint8).reshape(-1)
+        dst_u8 = out.view(np.uint8).reshape(-1)
+        g = self._gen
+        for start in range(0, max(total, 1), chunk_elems):
+            n = min(chunk_elems, total - start)
+            nbytes = n * itemsize
+            # Phase 1: deposit my chunk.
+            self._slot(self.index)[:nbytes] = \
+                src_u8[start * itemsize:start * itemsize + nbytes]
+            self._publish(g + 1)
+            self._wait_all(g + 1, "deposit barrier")
+            # Phase 2: reduce my subslice from every slot into the
+            # shared result (rank-ordered accumulation — every rank
+            # computes its subslice in the same order, so results are
+            # bitwise identical everywhere).
+            base, rem = divmod(n, self.size)
+            lo = self.index * base + min(self.index, rem)
+            hi = lo + base + (1 if self.index < rem else 0)
+            if hi > lo:
+                span = slice(lo * itemsize, hi * itemsize)
+                res = np.frombuffer(self._result[span], dtype=flat.dtype)
+                res[:] = np.frombuffer(
+                    self._slot(0)[span], dtype=flat.dtype)
+                for r in range(1, self.size):
+                    reduce_fn(res, np.frombuffer(
+                        self._slot(r)[span], dtype=flat.dtype))
+            self._publish(g + 2)
+            self._wait_all(g + 2, "reduce barrier")
+            # Phase 3: copy the finished chunk out and PUBLISH the
+            # drain generation — but never wait on it. Publishes are
+            # program-ordered per rank, so the next chunk's deposit
+            # barrier (all >= g+4) implies every rank already published
+            # g+3, i.e. finished reading this result — the fence the
+            # drain wait would have provided, for one less global sync
+            # per chunk. (Slot overwrites are likewise fenced by the
+            # reduce barrier: all >= g+2 means nobody still reads the
+            # slots.)
+            dst_u8[start * itemsize:start * itemsize + nbytes] = \
+                self._result[:nbytes]
+            self._publish(g + 3)
+            g += 3
+            if self.m_sent is not None:
+                self.m_sent.inc(nbytes)
+            if self.m_recv is not None:
+                self.m_recv.inc(nbytes)
+        self._gen = g
+
+    def sever(self, reason: str = "severed") -> None:
+        self._severed = reason
+
+    @property
+    def alive(self) -> bool:
+        return self._severed is None
+
+    def status(self) -> dict:
+        return {
+            "path": self.path,
+            "group_size": self.size,
+            "slot_bytes": self.slot_bytes,
+            "generation": self._gen,
+            "alive": self.alive,
+        }
+
+    def close(self) -> None:
+        self.sever("closed")
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ShmArenaSet:
+    """Per-channel lazy arena factory for one backend (see the
+    concurrency contract above). All ranks materialize channel c's
+    arena from the same deterministic path on first use, so creation
+    needs no extra coordination beyond the establishment-time nonce."""
+
+    def __init__(self, base_dir: str, scope: str, nonce: str, index: int,
+                 size: int, slot_bytes: int, timeout: float = 0.0):
+        self._dir = base_dir
+        self._scope = scope
+        self._nonce = nonce
+        self.index = index
+        self.size = size
+        self._slot_bytes = slot_bytes
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._arenas: Dict[int, ShmArena] = {}
+        self.dead_cb = None
+        self.m_sent = None
+        self.m_recv = None
+
+    def get(self, channel: int) -> ShmArena:
+        with self._lock:
+            a = self._arenas.get(channel)
+            if a is None:
+                path = os.path.join(
+                    self._dir,
+                    f"hvd_shm_{self._scope}_{self._nonce}_arena_c{channel}")
+                a = ShmArena(path, self.index, self.size,
+                             self._slot_bytes, timeout=self._timeout)
+                a.dead_cb = self.dead_cb
+                a.m_sent = self.m_sent
+                a.m_recv = self.m_recv
+                self._arenas[channel] = a
+            return a
+
+    def sever(self, reason: str = "severed") -> None:
+        with self._lock:
+            arenas = list(self._arenas.values())
+        for a in arenas:
+            a.sever(reason)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {str(ch): a.status()
+                    for ch, a in sorted(self._arenas.items())}
+
+    def close(self) -> None:
+        with self._lock:
+            arenas = list(self._arenas.values())
+            self._arenas.clear()
+        for a in arenas:
+            a.close()

@@ -49,12 +49,20 @@ reading), never deposited, never awaited. Every received frame stamps
 per-peer activity, `declare_dead(peer, reason)` latches a liveness
 verdict as the peer's root cause and severs it, and every
 TransportError carries peer/reporter/root-cause attribution.
+
+Pluggable transports (backend/transport.py, docs/running.md
+"Transports"): every peer's bytes flow through a Transport object —
+the socket machinery above wrapped as TcpTransport by default, plus a
+shared-memory overlay (backend/shm.py: per-pair mmap rings and, for
+fully co-located jobs, the arena) for co-located data-channel
+traffic. Control and heartbeat frames ALWAYS stay on the sockets:
+their FIN/RST + silence detection is what bounds failure detection,
+and a verdict severs the peer's socket and shm lanes together.
 """
 from __future__ import annotations
 
 import collections
 import os
-import queue
 import select
 import socket
 import struct
@@ -62,22 +70,37 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..common import fault_injection, tracing
+from ..common import fault_injection
 from ..common.exceptions import HorovodInternalError, TransportError
 from ..utils import clock
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
 from ..utils.retry import call_with_retry
-from .base import CTRL_CHANNEL, HEALTH_CHANNEL, current_channel
+from .base import (
+    CTRL_CHANNEL,
+    HEALTH_CHANNEL,
+    current_channel,
+    desync_message,
+    is_data_channel,
+)
 from .rendezvous import RendezvousClient
 from .ring import RingCollectivesMixin
 from .star import as_byte_view, join_buffers
+from .transport import (
+    FRAME_HDR,
+    PeerSender,
+    SendTicket,
+    Transport,
+    create_transport,
+    register_transport,
+)
 
 logger = get_logger()
 
-# Frame header: u64 payload length + u8 channel tag. The tag is what
-# lets concurrent executor channels share one peer socket safely.
-_HDR = struct.Struct("<QB")
+# Frame header: u64 payload length + u8 channel tag — the framing every
+# transport shares (backend/transport.py). The tag is what lets
+# concurrent executor channels share one peer socket safely.
+_HDR = FRAME_HDR
 _HDR_LEN = _HDR.size
 # try_drain_idle reads already-buffered bytes in chunks of this size,
 # and consumes at most _DRAIN_MAX_BYTES per call — liveness evidence,
@@ -227,132 +250,45 @@ def _recv_exact_bounded(sock: socket.socket, n: int,
     return buf
 
 
-class _SendTicket:
-    """Completion handle for one frame queued on a persistent peer
-    sender; `wait()` re-raises the sender thread's TransportError on
-    the caller's thread."""
-
-    __slots__ = ("_event", "_error")
-
-    def __init__(self):
-        self._event = threading.Event()
-        self._error: Optional[BaseException] = None
-
-    def _done(self, error: Optional[BaseException] = None):
-        self._error = error
-        self._event.set()
-
-    def wait(self):
-        self._event.wait()
-        if self._error is not None:
-            raise self._error
+# Completion ticket for queued sends — the extracted transport-layer
+# machinery (backend/transport.py); the alias keeps the historical name
+# importable.
+_SendTicket = SendTicket
 
 
-_SENDER_STOP = object()
-
-
-class _PeerSender:
-    """Persistent queue-fed sender worker for one peer socket. Replaces
-    the thread-per-ring-step `_sendrecv` helper: created lazily at the
-    first p2p send to the peer, reused for the backend's lifetime,
-    drained on shutdown/sever. The queue holds memoryviews — enqueueing
-    a ring segment costs no copy. Fault-injection verdicts (drop/delay/
-    sever) apply inside the worker via `_peer_send_direct`, so a delay
-    rule stalls the queue and a sever fails the ticket exactly like the
-    old inline send path did."""
+class _PeerSender(PeerSender):
+    """Persistent queue-fed sender worker for one peer socket — the
+    generic transport-layer PeerSender bound to this backend's
+    `_peer_send_direct` (so fault-injection verdicts apply inside the
+    worker: a delay rule stalls the queue and a sever fails the ticket
+    exactly like the old inline send path did) and to the tracing
+    plane's `tcp.sender_dwell` span. Created lazily at the first p2p
+    send to the peer, reused for the backend's lifetime, drained on
+    shutdown/sever. The queue holds memoryviews — enqueueing a ring
+    segment costs no copy."""
 
     def __init__(self, backend: "TcpBackend", peer: int):
         self._backend = backend
         self.peer = peer
-        self.queue: "queue.Queue" = queue.Queue()
-        # _closed is flipped under _lock BEFORE the stop sentinel is
-        # queued, and send() checks it under the same lock — so a put
-        # either lands ahead of the sentinel (FIFO: the worker still
-        # processes it) or fails fast. Without this a send racing
-        # stop() could enqueue after the worker's final drain and park
-        # its waiter forever.
-        self._lock = threading.Lock()
-        self._closed = False
-        # Frames accepted but not yet fully written, per channel tag.
-        # The synchronous-send fast path may write the socket directly
-        # (skipping two thread hops) only while ITS channel has nothing
-        # pending here — same-channel order is the only order the
-        # receive demultiplexer cannot restore.
-        self.pending: Dict[int, int] = {}
-        self.thread = threading.Thread(
-            target=self._loop, name=f"hvd-sender-{peer}", daemon=True)
-        self.thread.start()
+        super().__init__(
+            send_fn=lambda payload, ch: backend._peer_send_direct(
+                peer, payload, ch),
+            label=f"peer {peer}",
+            trace_emit=self._emit_dwell,
+        )
 
     def send(self, payload, channel: int = CTRL_CHANNEL) -> _SendTicket:
-        ticket = _SendTicket()
-        # Tracing: dwell = enqueue to wire-complete, measured across
-        # the thread hop. The trace id is captured on the CALLER's
-        # thread (the sender worker has no trace scope of its own),
-        # exactly like the channel tag.
-        t_enq = clock.mono_ns()
-        trace_id = tracing.current_trace()
-        with self._lock:
-            if self._closed:
-                ticket._done(TransportError(
-                    f"sender for peer {self.peer} shut down"))
-                return ticket
-            self.pending[channel] = self.pending.get(channel, 0) + 1
-            self.queue.put((payload, channel, ticket, t_enq, trace_id))
-        return ticket
+        return super().send(payload, channel)
 
-    def channel_idle(self, channel: int) -> bool:
-        with self._lock:
-            return not self._closed and self.pending.get(channel, 0) == 0
-
-    def _frame_done(self, channel: int):
-        with self._lock:
-            n = self.pending.get(channel, 1) - 1
-            if n <= 0:
-                self.pending.pop(channel, None)
-            else:
-                self.pending[channel] = n
-
-    def stop(self):
-        with self._lock:
-            if self._closed:
-                return
-            self._closed = True
-            self.queue.put(_SENDER_STOP)
-
-    def _loop(self):
-        while True:
-            item = self.queue.get()
-            if item is _SENDER_STOP:
-                break
-            payload, channel, ticket, t_enq, trace_id = item
-            try:
-                self._backend._peer_send_direct(self.peer, payload, channel)
-            except BaseException as e:
-                self._frame_done(channel)
-                ticket._done(e)
-            else:
-                # Decrement strictly AFTER the frame hit the wire (the
-                # write ran under the peer's wire mutex): a fast-path
-                # sender that then observes pending == 0 can only order
-                # itself after this frame.
-                self._frame_done(channel)
-                ticket._done()
-                tr = self._backend.tracer
-                if tr.enabled and channel != HEALTH_CHANNEL:
-                    tr.emit("tcp.sender_dwell", "xfer", t_enq,
-                            clock.mono_ns() - t_enq, trace_id=trace_id,
-                            args={"peer": self.peer, "channel": channel})
-        # Belt-and-braces drain: _closed guarantees nothing lands after
-        # the sentinel, but fail anything unexpectedly left anyway
-        # rather than leave a waiter parked.
-        while True:
-            try:
-                item = self.queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not _SENDER_STOP:  # pragma: no cover - _closed gates
-                item[2]._done(TransportError(
-                    f"sender for peer {self.peer} shut down"))
+    def _emit_dwell(self, channel: int, t_enq: int, trace_id):
+        # Dwell = enqueue to wire-complete, measured across the thread
+        # hop; the trace id was captured on the CALLER's thread (the
+        # worker has no trace scope of its own), like the channel tag.
+        tr = self._backend.tracer
+        if tr.enabled and channel != HEALTH_CHANNEL:
+            tr.emit("tcp.sender_dwell", "xfer", t_enq,
+                    clock.mono_ns() - t_enq, trace_id=trace_id,
+                    args={"peer": self.peer, "channel": channel})
 
 
 class _PeerDemux:
@@ -380,6 +316,51 @@ class _PeerDemux:
     def take(self, channel: int) -> Optional[bytearray]:
         q = self.inbox.get(channel)
         return q.popleft() if q else None
+
+
+class TcpTransport(Transport):
+    """The socket mesh's per-peer endpoint, as a Transport: a thin
+    binding of the backend's framing / channel-demux / persistent-
+    sender machinery to one peer. The mesh backend routes every byte
+    through a Transport object (this one by default; the shm overlay
+    for co-located data lanes), so the conformance suite exercises the
+    same interface against every implementation."""
+
+    name = "tcp"
+
+    def __init__(self, backend: "TcpBackend", peer: int):
+        self.backend = backend
+        self.peer = peer
+
+    def send(self, payload, channel: int) -> None:
+        self.backend._tcp_send(self.peer, payload, channel)
+
+    def send_async(self, payload, channel: int):
+        return self.backend._sender_for(self.peer).send(payload, channel)
+
+    def recv(self, channel: int) -> bytearray:
+        return self.backend._demux_recv(self.peer, channel, None)
+
+    def recv_into(self, view: memoryview, channel: int) -> int:
+        self.backend._demux_recv(self.peer, channel, view)
+        return len(view)
+
+    def sever(self) -> None:
+        self.backend._sever(self.peer)
+
+    @property
+    def alive(self) -> bool:
+        return self.peer in self.backend.peers
+
+    def drain_idle(self, max_frames: int = 64) -> int:
+        return self.backend._tcp_drain_idle(self.peer, max_frames)
+
+    def status(self) -> dict:
+        return {"transport": self.name, "alive": self.alive}
+
+
+register_transport(
+    "tcp", lambda backend, peer, **kw: TcpTransport(backend, peer))
 
 
 class TcpBackend(RingCollectivesMixin):
@@ -446,6 +427,24 @@ class TcpBackend(RingCollectivesMixin):
         # dict only — routing runs under each demux's own condition).
         self._demux: Dict[int, _PeerDemux] = {}
         self._demux_lock = threading.Lock()
+        # Pluggable transport layer (backend/transport.py): every peer
+        # gets a base TcpTransport over its mesh socket; co-located
+        # peers additionally get a shared-memory overlay when the
+        # launch-time HOROVOD_TRANSPORT allows it. Data-channel frames
+        # route per call (env read each time, so paired benchmarks can
+        # flip tcp<->shm between barrier-separated rounds); control
+        # and heartbeat frames ALWAYS ride the sockets — the FIN/RST
+        # is what keeps dead-peer detection bounded.
+        self._transports: Dict[int, Transport] = {}
+        self._overlays: Dict[int, Transport] = {}
+        self.arena_set = None
+        self._m_tbytes: Dict[Tuple[str, str], object] = {}
+        self._m_shm_ring_full = None
+        # Hot-path per-transport byte counters, bound ONCE like their
+        # siblings above — the socket send/recv paths must not pay a
+        # dict lookup per frame.
+        self._m_tcp_sent = self._transport_counter("tcp", "sent")
+        self._m_tcp_recv = self._transport_counter("tcp", "recv")
         self.rank = rank
         self.size = size
         if scope is None:
@@ -470,6 +469,15 @@ class TcpBackend(RingCollectivesMixin):
             rendezvous = RendezvousClient(addr, port)
         self._rendezvous = rendezvous
         self._connect_full_mesh(scope)
+        for peer in self.peers:
+            self._transports[peer] = create_transport("tcp", self, peer)
+        if env_cfg.transport_mode() in ("shm", "auto"):
+            # Local shm failures degrade to tcp via the pairwise ok-bit
+            # vote inside; a rendezvous failure here propagates like
+            # any other bootstrap KV failure — it must, because a rank
+            # that published its ok bit but could not read its peers'
+            # would otherwise route asymmetrically.
+            self._setup_shm_overlays(scope)
 
     # ------------------------------------------------------------------
     def _connect_full_mesh(self, scope: str):
@@ -607,6 +615,230 @@ class TcpBackend(RingCollectivesMixin):
         logger.debug("rank %d: TCP mesh connected (%d peers)", self.rank, len(self.peers))
 
     # ------------------------------------------------------------------
+    # pluggable transport layer: base tcp per peer + shm overlay for
+    # co-located peers (backend/transport.py registry; docs/running.md
+    # "Transports").
+    def _locality_token(self) -> str:
+        """Machine identity for transport selection: the LOGICAL
+        hostname (HOROVOD_HOSTNAME — so multi-host simulations on one
+        box are honored) plus the kernel boot id (so two real machines
+        that happen to share a hostname are never mistaken for
+        co-located)."""
+        host = (os.environ.get(env_cfg.HOSTNAME)
+                or socket.gethostname() or "?")
+        boot = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                boot = f.read().strip()
+        except OSError:  # pragma: no cover - non-Linux
+            pass
+        return f"{host}|{boot}"
+
+    def _transport_counter(self, transport: str, direction: str):
+        key = (transport, direction)
+        m = self._m_tbytes.get(key)
+        if m is None:
+            m = self._registry.counter(
+                "horovod_transport_bytes_total",
+                "Bytes moved by the data plane per transport and "
+                "direction (frame headers included)",
+                labels={"transport": transport, "direction": direction})
+            self._m_tbytes[key] = m
+        return m
+
+    def _setup_shm_overlays(self, scope: str):
+        """Establish mmap ring-buffer overlays with every co-located
+        peer. Locality is agreed through the rendezvous KV (each rank
+        publishes its token; ranks compare pairwise), and ring files
+        are named by mesh scope + a rank-0-published nonce so two jobs
+        on one host can never collide. Establishment is PAIRWISE
+        AGREED: each rank publishes an ok bit after its local attempt,
+        and a pair uses its overlay only when BOTH sides succeeded —
+        a rank whose shm dir is unwritable degrades the whole pair to
+        tcp, never half of it (a one-sided route would park the other
+        side's recv on a ring nobody writes, forever under unbounded
+        timeouts). Runs once at mesh init; the per-call route decision
+        is `_route`."""
+        from . import shm as shm_mod  # registers the "shm" factory
+
+        my_loc = self._locality_token()
+        self._rendezvous.put(scope, f"loc{self.rank}", my_loc.encode())
+        if self.rank == 0:
+            self._rendezvous.put(scope, "shm_nonce",
+                                 os.urandom(6).hex().encode())
+        nonce = self._rendezvous.wait_get(scope, "shm_nonce").decode()
+        ring_bytes = env_cfg.shm_ring_bytes()
+        base_dir = env_cfg.shm_dir()
+        overlays: Dict[int, Transport] = {}
+        arena = None
+        ok = True
+        try:
+            colocated = []
+            for peer in sorted(self.peers):
+                loc = self._rendezvous.wait_get(
+                    scope, f"loc{peer}").decode()
+                if loc != my_loc:
+                    continue
+                colocated.append(peer)
+                path = os.path.join(
+                    base_dir, shm_mod.ring_file_name(scope, nonce,
+                                                     self.rank, peer))
+                t = create_transport(
+                    "shm", self, peer, path=path, ring_bytes=ring_bytes,
+                    timeout=self._timeout, poll=self._poll)
+                t.activity_cb = self._note_activity
+                t.health_cb = self._route_health
+                # Ticket errors from the overlay's sender worker must
+                # honor the attributed TransportError contract, exactly
+                # like the socket sender's do (translated inside
+                # _peer_send_direct).
+                t.send_fn = (
+                    lambda payload, ch, _t=t, _p=peer: self._overlay_call(
+                        _p, "send to", _t._send_direct, payload, ch))
+                t.m_sent = self._transport_counter("shm", "sent")
+                t.m_recv = self._transport_counter("shm", "recv")
+                if self._m_shm_ring_full is None:
+                    self._m_shm_ring_full = self._registry.counter(
+                        "horovod_shm_ring_full_total",
+                        "Send stalls on a full shared-memory ring "
+                        "(backpressure episodes)")
+                t.m_ring_full = self._m_shm_ring_full
+                overlays[peer] = t
+            # The intra-host ARENA (backend/shm.py ShmArena): when the
+            # WHOLE world is co-located, big allreduces skip the
+            # per-pair rings entirely — every rank deposits once into
+            # a shared slot and reduces its subslice straight from
+            # every peer's bytes. Group membership comes from the same
+            # KV locality rows on every rank, so arena existence is
+            # collectively consistent (given the ok bits below).
+            if len(colocated) == self.size - 1 and self.size > 1:
+                arena = shm_mod.ShmArenaSet(
+                    base_dir, scope, nonce, index=self.rank,
+                    size=self.size, slot_bytes=env_cfg.shm_slot_bytes(),
+                    timeout=self._timeout)
+                arena.dead_cb = self._arena_dead_reason
+                arena.m_sent = self._transport_counter("shm", "sent")
+                arena.m_recv = self._transport_counter("shm", "recv")
+        except Exception as exc:
+            # Local failure (unwritable shm dir, ENOSPC, mmap): unwind
+            # EVERYTHING and vote not-ok — partial overlay sets must
+            # never survive, the warning's "staying on tcp" has to be
+            # literally true.
+            ok = False
+            for t in overlays.values():
+                try:
+                    t.close()
+                except Exception:  # pragma: no cover - unwind
+                    pass
+            overlays = {}
+            arena = None
+            logger.warning(
+                "rank %d: shm establishment failed locally, voting "
+                "tcp-only: %s", self.rank, exc)
+        self._rendezvous.put(scope, f"shmok{self.rank}",
+                             b"1" if ok else b"0")
+        # Pairwise agreement: drop overlays to peers whose OWN
+        # establishment failed — both ends of a pair decide from the
+        # same two bits, so the route stays symmetric by construction.
+        peer_ok: Dict[int, bool] = {}
+        for peer in list(overlays):
+            bit = self._rendezvous.wait_get(scope, f"shmok{peer}")
+            peer_ok[peer] = bit == b"1"
+            if not peer_ok[peer]:
+                overlays.pop(peer).close()
+        self._overlays.update(overlays)
+        # The arena's group is the whole world: any rank voting not-ok
+        # disables it everywhere (every rank sees the same bits).
+        if arena is not None and (not ok or not all(peer_ok.values())
+                                  or len(overlays) != self.size - 1):
+            arena.close()
+            arena = None
+        self.arena_set = arena
+        if self._overlays:
+            logger.debug(
+                "rank %d: shm overlays established with peers %s "
+                "(ring %d bytes, arena %s, dir %s)", self.rank,
+                sorted(self._overlays), ring_bytes,
+                self.arena_set is not None, base_dir)
+
+    def _arena_dead_reason(self) -> Optional[str]:
+        """Bound for arena barrier waits: the first liveness verdict —
+        or any severed peer — in the co-located group (== the world,
+        by construction). Heartbeats ride TCP, so a wedged or killed
+        rank surfaces here within the detection window and every rank
+        parked on an arena barrier unblocks with the attributed
+        root cause."""
+        with self._death_lock:
+            if self._death_reasons:
+                return next(iter(self._death_reasons.values()))
+        if len(self.peers) != self.size - 1:
+            return (f"rank {self.rank}: a peer connection was severed "
+                    f"(surviving peers: {sorted(self.peers)})")
+        return None
+
+    def _route(self, peer: int, channel: int) -> Optional[Transport]:
+        """The per-call transport decision: data-channel frames ride
+        the shm overlay when one exists and HOROVOD_TRANSPORT currently
+        allows it; control/heartbeat frames and everything else stay on
+        the socket. Returns the overlay transport, or None for the
+        built-in tcp path. Symmetric by construction: both ends hold
+        the same overlay set (KV-agreed locality) and read the same
+        env, so a frame's sender and receiver always pick the same
+        lane."""
+        if not self._overlays or not is_data_channel(channel):
+            return None
+        if env_cfg.transport_mode() == "tcp":
+            return None
+        t = self._overlays.get(peer)
+        return t if t is not None and t.alive else None
+
+    def _overlay_call(self, peer: int, what: str, fn, *args):
+        """Run one overlay-transport op under the same sever+translate
+        contract as the socket paths (TransportError passes through
+        already attributed)."""
+        try:
+            return fn(*args)
+        except (OSError, TimeoutError) as exc:
+            if isinstance(exc, (socket.timeout, TimeoutError)):
+                self._m_timeouts.inc()
+            self._sever(peer)
+            raise self._transport_error(peer, what, exc) from exc
+
+    def transport_status(self) -> dict:
+        """Live transport view for /status (docs/metrics.md)."""
+        mode = env_cfg.transport_mode()
+        peers = {}
+        for peer in sorted(set(self.peers) | set(self._overlays)):
+            ov = self._overlays.get(peer)
+            peers[str(peer)] = {
+                "base": "tcp",
+                "connected": peer in self.peers,
+                "overlay": ov.status() if ov is not None else None,
+            }
+        st = {"mode": mode, "peers": peers}
+        if self.arena_set is not None:
+            st["arena"] = self.arena_set.status()
+        return st
+
+    def prefers_leader_hierarchy(self) -> bool:
+        """True when the leader-based two-level allreduce is the right
+        cross-host schedule HERE: every co-located peer (the local
+        group from the negotiated topology) is reachable over a live
+        shm overlay, making the intra-host leader gather/bcast nearly
+        free. Collective consistency comes from the engine's validity
+        agreement (a bitwise AND across ranks), not from this local
+        answer."""
+        if env_cfg.transport_mode() == "tcp" or not self._overlays:
+            return False
+        L = self.local_size
+        base = self.cross_rank * L
+        return all(
+            base + i == self.rank
+            or (base + i in self._overlays and self._overlays[base + i].alive)
+            for i in range(L)
+        )
+
+    # ------------------------------------------------------------------
     # bounded, chaos-aware peer I/O. Every byte to or from a peer flows
     # through _peer_send/_peer_recv: fault-injection verdicts apply, any
     # OSError (dead peer, refused, reset) or deadline overrun is
@@ -649,6 +881,16 @@ class TcpBackend(RingCollectivesMixin):
             # stop() only enqueues the sentinel, so this is safe from
             # the sender's own thread (its error path calls _sever).
             snd.stop()
+        # The peer is severed as a whole: its shm overlay dies with its
+        # socket, unblocking any I/O parked on either lane NOW — and a
+        # hole in the group makes the arena unusable, so barrier waits
+        # unblock too (with the death verdict via _arena_dead_reason).
+        ov = self._overlays.pop(peer, None)
+        if ov is not None:
+            ov.sever()
+        if self.arena_set is not None:
+            cause = self.death_reason(peer) or f"peer {peer} severed"
+            self.arena_set.sever(cause)
         s = self.peers.pop(peer, None)
         if s is not None:
             self._m_severed.inc()
@@ -704,6 +946,21 @@ class TcpBackend(RingCollectivesMixin):
                 logger.exception("health callback failed")
 
     def try_drain_idle(self, peer: int, max_frames: int = 64) -> int:
+        """Liveness sweep over EVERY transport to `peer`: drain the
+        socket's kernel buffer (below) and observe shm overlay
+        progress — the peer's ring write-cursor advancing proves life
+        without consuming (there is no kernel buffer to free there),
+        so a peer streaming a collective over shared memory while the
+        control plane is quiet never reads as silence."""
+        ov = self._overlays.get(peer)
+        if ov is not None:
+            try:
+                ov.drain_idle(max_frames)
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("shm drain for peer %d failed", peer)
+        return self._tcp_drain_idle(peer, max_frames)
+
+    def _tcp_drain_idle(self, peer: int, max_frames: int = 64) -> int:
         """Opportunistically consume frames parked in `peer`'s kernel
         buffer while NO other thread is reading its socket. The control
         plane's sequential gather parks on one rank while the other
@@ -833,10 +1090,15 @@ class TcpBackend(RingCollectivesMixin):
         and return a completion ticket (ring data-plane primitive:
         the send of one segment overlaps the caller's recv+reduce).
         The channel tag is captured on the CALLER's thread — the sender
-        worker has no channel scope of its own."""
+        worker has no channel scope of its own. Routes to the shm
+        overlay for co-located data-channel traffic."""
         self._peer_sock(peer)  # fail fast on a severed peer
         if channel is None:
             channel = current_channel()
+        t = self._route(peer, channel)
+        if t is not None:
+            return self._overlay_call(peer, "send to",
+                                      t.send_async, payload, channel)
         return self._sender_for(peer).send(payload, channel)
 
     def _wire_lock(self, peer: int) -> threading.Lock:
@@ -847,7 +1109,18 @@ class TcpBackend(RingCollectivesMixin):
             return lk
 
     def _peer_send(self, peer: int, data):
-        """Synchronous framed send. Fast path: when this channel has no
+        """Synchronous framed send, routed per call: shm overlay for
+        co-located data-channel traffic, socket otherwise."""
+        channel = current_channel()
+        t = self._route(peer, channel)
+        if t is not None:
+            self._peer_sock(peer)  # fail fast on a severed peer
+            self._overlay_call(peer, "send to", t.send, data, channel)
+            return
+        self._tcp_send(peer, data, channel)
+
+    def _tcp_send(self, peer: int, data, channel: Optional[int] = None):
+        """Socket-path sync send. Fast path: when this channel has no
         frames pending on the peer's sender worker, write the socket
         directly under the wire mutex — two thread hops cheaper, which
         is most of a control round's latency on an idle mesh. Frames of
@@ -856,7 +1129,8 @@ class TcpBackend(RingCollectivesMixin):
         send queues behind them (FIFO within a channel is the ordering
         contract)."""
         self._peer_sock(peer)  # fail fast on a severed peer
-        channel = current_channel()
+        if channel is None:
+            channel = current_channel()
         # No sender worker for this peer yet ⇒ nothing can be pending:
         # write directly (under the wire mutex) without spawning one —
         # a pure control-plane mesh stays thread-free.
@@ -881,6 +1155,7 @@ class TcpBackend(RingCollectivesMixin):
                 try:
                     sent = _send_all(sock, data, channel)
                     self._m_bytes_sent.inc(sent + _HDR_LEN)
+                    self._m_tcp_sent.inc(sent + _HDR_LEN)
                     self._m_frames_sent.inc()
                 finally:
                     if self._timeout > 0:
@@ -904,6 +1179,7 @@ class TcpBackend(RingCollectivesMixin):
 
     def _count_frame(self, channel: int, nbytes: int):
         self._m_bytes_recv.inc(nbytes + _HDR_LEN)
+        self._m_tcp_recv.inc(nbytes + _HDR_LEN)
         m = self._m_channel_frames.get(channel)
         if m is None:
             label = ("ctrl" if channel == CTRL_CHANNEL
@@ -936,10 +1212,7 @@ class TcpBackend(RingCollectivesMixin):
                             return buf
                         if len(buf) != len(view):
                             raise OSError(
-                                f"frame length {len(buf)} != expected "
-                                f"{len(view)} (desynced peer; check "
-                                f"HOROVOD_RING_SEGMENT_BYTES matches on "
-                                f"every rank)")
+                                desync_message(len(buf), len(view)))
                         view[:] = buf
                         return None
                     if not d.reading:
@@ -971,11 +1244,7 @@ class TcpBackend(RingCollectivesMixin):
                 if ch == channel:
                     if view is not None:
                         if n != len(view):
-                            raise OSError(
-                                f"frame length {n} != expected {len(view)} "
-                                f"(desynced peer; check "
-                                f"HOROVOD_RING_SEGMENT_BYTES matches on "
-                                f"every rank)")
+                            raise OSError(desync_message(n, len(view)))
                         _recv_into_bounded(sock, view, self._timeout,
                                            self._poll)
                         result = None
@@ -1009,7 +1278,11 @@ class TcpBackend(RingCollectivesMixin):
             if self._injector.active:
                 self._injector.check_io(self.rank, peer, "recv")
             self._peer_sock(peer)  # fail fast on a severed peer
-            return self._demux_recv(peer, current_channel(), None)
+            channel = current_channel()
+            t = self._route(peer, channel)
+            if t is not None:
+                return t.recv(channel)
+            return self._demux_recv(peer, channel, None)
         except (OSError, TimeoutError) as exc:
             if isinstance(exc, (socket.timeout, TimeoutError)):
                 self._m_timeouts.inc()
@@ -1028,7 +1301,11 @@ class TcpBackend(RingCollectivesMixin):
             if self._injector.active:
                 self._injector.check_io(self.rank, peer, "recv")
             self._peer_sock(peer)  # fail fast on a severed peer
-            self._demux_recv(peer, current_channel(), view)
+            channel = current_channel()
+            t = self._route(peer, channel)
+            if t is not None:
+                return t.recv_into(view, channel)
+            self._demux_recv(peer, channel, view)
             return len(view)
         except (OSError, TimeoutError) as exc:
             if isinstance(exc, (socket.timeout, TimeoutError)):
@@ -1138,6 +1415,22 @@ class TcpBackend(RingCollectivesMixin):
             self._senders.clear()
         for snd in senders:
             snd.stop()
+        # Overlay transports close before the sockets: close() stops
+        # their sender workers, marks the shared closed flag (the
+        # peer's parked I/O unblocks) and unlinks the ring file.
+        overlays = list(self._overlays.values())
+        self._overlays.clear()
+        for ov in overlays:
+            try:
+                ov.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                logger.exception("shm overlay close failed")
+        if self.arena_set is not None:
+            try:
+                self.arena_set.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                logger.exception("shm arena close failed")
+            self.arena_set = None
         self._close_all_peers()
         for snd in senders:
             snd.thread.join(timeout=5)

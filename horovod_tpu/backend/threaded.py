@@ -42,19 +42,20 @@ class _ChannelQueues:
     def __init__(self, size: int):
         self.up = [queue.Queue() for _ in range(size)]    # rank -> root
         self.down = [queue.Queue() for _ in range(size)]  # root -> rank
-        # Point-to-point channels keyed (src, dst) — the queue analogue
-        # of the TCP mesh's per-pair sockets (ring/hierarchical planes).
-        self.p2p = {
-            (s_, d): queue.Queue()
-            for s_ in range(size) for d in range(size) if s_ != d
-        }
 
 
 class ThreadedGroup:
     def __init__(self, size: int):
+        from .transport import InprocMesh
+
         self.size = size
         self._lock = threading.Lock()
         self._channels: Dict[int, _ChannelQueues] = {}
+        # Point-to-point plane (ring/hierarchical collectives): the
+        # in-process Transport from the pluggable transport layer —
+        # same framing/channel-demux contract as the TCP mesh and the
+        # shm overlay, exercised by the same conformance suite.
+        self.mesh = InprocMesh(size)
 
     def chan(self, channel: int) -> _ChannelQueues:
         with self._lock:
@@ -113,10 +114,14 @@ class ThreadedBackend(RingCollectivesMixin):
         return ch.down[self.rank].get(timeout=60)
 
     # -- p2p primitives (ring/hierarchical data planes) ----------------
+    # Ride the in-process transport: send flattens to immutable bytes
+    # at the "wire" (the same aliasing contract _blob enforces for the
+    # star queues), recv hands back a fresh exclusively-owned bytearray
+    # per frame — the owned-buffer contract every transport shares.
     def send_to(self, peer: int, payload):
-        self.group.chan(current_channel()).p2p[(self.rank, peer)].put(
-            _blob(payload))
+        self.group.mesh.transport(self.rank, peer).send(
+            payload, current_channel())
 
-    def recv_from(self, peer: int) -> bytes:
-        return self.group.chan(current_channel()).p2p[(peer, self.rank)].get(
-            timeout=60)
+    def recv_from(self, peer: int) -> bytearray:
+        return self.group.mesh.transport(self.rank, peer).recv(
+            current_channel())

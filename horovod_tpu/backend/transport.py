@@ -1,0 +1,633 @@
+"""Pluggable per-peer transport layer for the eager data plane.
+
+PAPER.md's L1 layer makes backends interchangeable behind one op
+interface (``AllreduceOp::Execute``); this module does the same one
+level down, for the bytes themselves: a ``Transport`` is one peer
+connection's framed byte plane — send / send_async / recv / recv_into
+with channel tags — and the mesh backend (``backend/tcp.py``) composes
+one per peer from a registry keyed by **peer locality**:
+
+* ``tcp``  — the socket mesh (always present; bootstrap, control plane
+  and heartbeats ride it unconditionally — the FIN/RST is what makes
+  dead-peer detection bounded);
+* ``shm``  — mmap'd shared-memory ring buffers for co-located ranks
+  (``backend/shm.py``): data-channel frames cross the host without
+  touching the kernel network stack;
+* ``inproc`` — an in-process pair for tests: the same framing, channel
+  demux, sever and fault-injection surface with no sockets at all
+  (``InprocMesh`` below; the threaded test backend's p2p plane rides
+  it).
+
+Frame model (shared by every transport): a u64 payload length + u8
+channel tag header, then the payload — exactly the TCP wire framing,
+so the conformance suite (tests/test_transport.py) can run the same
+checks against all three. Channel demultiplexing (per-channel inboxes,
+single reader at a time) is the transport's job; FIFO-per-channel is
+the ordering contract, cross-channel overtaking is allowed.
+
+Selection is dynamic: ``HOROVOD_TRANSPORT`` is read per send/recv (see
+utils/env.py), so a paired benchmark can flip the route between
+barrier-separated rounds; ring *establishment* happens once, at mesh
+init, and only when the launch-time value allowed shm.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import tracing
+from ..common.exceptions import TransportError
+from ..utils import clock
+
+# Frame header shared by every transport: u64 payload length + u8
+# channel tag (backend/tcp.py aliases this for its wire format).
+FRAME_HDR = struct.Struct("<QB")
+FRAME_HDR_LEN = FRAME_HDR.size
+
+
+class SendTicket:
+    """Completion handle for one frame queued on a persistent peer
+    sender; ``wait()`` re-raises the sender thread's TransportError on
+    the caller's thread."""
+
+    __slots__ = ("_event", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def _done(self, error: Optional[BaseException] = None):
+        self._error = error
+        self._event.set()
+
+    def wait(self):
+        self._event.wait()
+        if self._error is not None:
+            raise self._error
+
+
+class CompletedTicket:
+    """No-op ticket for transports whose sends never block."""
+
+    __slots__ = ()
+
+    def wait(self):
+        pass
+
+
+COMPLETED = CompletedTicket()
+
+_SENDER_STOP = object()
+
+
+class PeerSender:
+    """Persistent queue-fed sender worker for one peer link. Created
+    lazily at the first async send to the peer, reused for the owner's
+    lifetime, drained on shutdown/sever. The queue holds memoryviews —
+    enqueueing a ring segment costs no copy. ``send_fn(payload,
+    channel)`` does the actual wire write (under the owner's wire
+    mutex), so fault-injection verdicts (drop/delay/sever) apply inside
+    the worker: a delay rule stalls the queue and a sever fails the
+    ticket exactly like a synchronous send would."""
+
+    def __init__(self, send_fn: Callable, label: str,
+                 trace_emit: Optional[Callable] = None):
+        self._send_fn = send_fn
+        self._trace_emit = trace_emit
+        self.label = label
+        self.queue: "_queue.Queue" = _queue.Queue()
+        # _closed is flipped under _lock BEFORE the stop sentinel is
+        # queued, and send() checks it under the same lock — so a put
+        # either lands ahead of the sentinel (FIFO: the worker still
+        # processes it) or fails fast.
+        self._lock = threading.Lock()
+        self._closed = False
+        # Frames accepted but not yet fully written, per channel tag.
+        # The synchronous-send fast path may bypass the worker only
+        # while ITS channel has nothing pending here — same-channel
+        # order is the only order a receive demux cannot restore.
+        self.pending: Dict[int, int] = {}
+        self.thread = threading.Thread(
+            target=self._loop, name=f"hvd-sender-{label}", daemon=True)
+        self.thread.start()
+
+    def send(self, payload, channel: int) -> SendTicket:
+        ticket = SendTicket()
+        # The trace id is captured on the CALLER's thread (the sender
+        # worker has no trace scope of its own), like the channel tag.
+        t_enq = clock.mono_ns()
+        trace_id = tracing.current_trace()
+        with self._lock:
+            if self._closed:
+                ticket._done(TransportError(
+                    f"sender for {self.label} shut down"))
+                return ticket
+            self.pending[channel] = self.pending.get(channel, 0) + 1
+            self.queue.put((payload, channel, ticket, t_enq, trace_id))
+        return ticket
+
+    def channel_idle(self, channel: int) -> bool:
+        with self._lock:
+            return not self._closed and self.pending.get(channel, 0) == 0
+
+    def _frame_done(self, channel: int):
+        with self._lock:
+            n = self.pending.get(channel, 1) - 1
+            if n <= 0:
+                self.pending.pop(channel, None)
+            else:
+                self.pending[channel] = n
+
+    def stop(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self.queue.put(_SENDER_STOP)
+
+    def _loop(self):
+        while True:
+            item = self.queue.get()
+            if item is _SENDER_STOP:
+                break
+            payload, channel, ticket, t_enq, trace_id = item
+            try:
+                self._send_fn(payload, channel)
+            except BaseException as e:
+                self._frame_done(channel)
+                ticket._done(e)
+            else:
+                # Decrement strictly AFTER the frame hit the wire: a
+                # fast-path sender that then observes pending == 0 can
+                # only order itself after this frame.
+                self._frame_done(channel)
+                ticket._done()
+                if self._trace_emit is not None:
+                    self._trace_emit(channel, t_enq, trace_id)
+        # Belt-and-braces drain: _closed guarantees nothing lands after
+        # the sentinel, but fail anything unexpectedly left anyway
+        # rather than leave a waiter parked.
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not _SENDER_STOP:  # pragma: no cover - _closed gates
+                item[2]._done(TransportError(
+                    f"sender for {self.label} shut down"))
+
+
+class Transport:
+    """One peer connection's framed byte plane. Implementations must
+    preserve FIFO order within a channel, demultiplex frames by channel
+    tag, and translate their failure modes to OSError/TimeoutError —
+    the owning backend severs the peer and wraps them in the attributed
+    TransportError contract.
+
+    ``name`` keys the registry and the
+    horovod_transport_bytes_total{transport=} telemetry label."""
+
+    name = "base"
+
+    def send(self, payload, channel: int) -> None:
+        """Synchronous framed send; accepts bytes | memoryview | numpy
+        buffer | list of buffers (scatter-gather)."""
+        raise NotImplementedError
+
+    def send_async(self, payload, channel: int):
+        """Queue a framed send; returns a ticket with .wait()."""
+        self.send(payload, channel)
+        return COMPLETED
+
+    def recv(self, channel: int) -> bytearray:
+        """Next frame tagged `channel`, as an exclusively-owned
+        writable buffer."""
+        raise NotImplementedError
+
+    def recv_into(self, view: memoryview, channel: int) -> int:
+        """Next frame tagged `channel` directly into `view`; the frame
+        length must match len(view) exactly (desynced peer otherwise —
+        raise OSError with base.desync_message)."""
+        raise NotImplementedError
+
+    def sever(self) -> None:
+        """Hard-close: every parked or future op on this transport must
+        unblock/fail promptly. Idempotent."""
+        raise NotImplementedError
+
+    @property
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    def drain_idle(self, max_frames: int = 64) -> int:
+        """Opportunistic liveness sweep while nobody is reading:
+        consume (or observe) peer progress without blocking. Returns
+        frames drained; transports where progress is observable without
+        consuming (shm write cursors) may return 0 yet still stamp
+        activity."""
+        return 0
+
+    def status(self) -> dict:
+        return {"transport": self.name, "alive": self.alive}
+
+    def close(self) -> None:
+        self.sever()
+
+
+# ---------------------------------------------------------------------------
+# registry, keyed by transport name; the mesh backend picks names by
+# peer locality (co-located -> shm overlay, remote -> tcp).
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_transport(name: str, factory: Callable) -> None:
+    """factory(backend, peer, **kw) -> Transport."""
+    _REGISTRY[name] = factory
+
+
+def transport_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def create_transport(name: str, backend, peer: int, **kw) -> Transport:
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown transport {name!r} (registered: {transport_names()})"
+        ) from None
+    return factory(backend, peer, **kw)
+
+
+# ---------------------------------------------------------------------------
+# In-process transport: the same framing/demux/sever surface with no
+# sockets. Used by the conformance suite and by the threaded test
+# backend's p2p plane; also handy as a reference implementation of the
+# Transport contract.
+class _InprocEndpointState:
+    """Shared state for one DIRECTED edge a->b: the frames a sent that
+    b has not yet consumed, keyed by channel."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.inbox: Dict[int, "collections.deque"] = {}
+        self.severed = False
+        self.deposited_at: Optional[float] = None
+
+
+class InprocMesh:
+    """Process-global mesh of in-process transports for `size` ranks.
+    ``transport(rank, peer)`` returns rank's endpoint of the (rank,
+    peer) link; both directions share this mesh's state, so severing
+    one end unblocks the other."""
+
+    def __init__(self, size: int, timeout: float = 60.0):
+        self.size = size
+        self.timeout = timeout
+        self._edges: Dict[Tuple[int, int], _InprocEndpointState] = {}
+        self._lock = threading.Lock()
+        self._transports: Dict[Tuple[int, int], "InprocTransport"] = {}
+
+    def edge(self, src: int, dst: int) -> _InprocEndpointState:
+        with self._lock:
+            e = self._edges.get((src, dst))
+            if e is None:
+                e = self._edges[(src, dst)] = _InprocEndpointState()
+            return e
+
+    def transport(self, rank: int, peer: int) -> "InprocTransport":
+        # Construct OUTSIDE the lock: __init__ re-enters edge(), which
+        # takes it too. Double-checked insert keeps one instance per
+        # directed pair.
+        with self._lock:
+            t = self._transports.get((rank, peer))
+        if t is None:
+            t = InprocTransport(self, rank, peer)
+            with self._lock:
+                t = self._transports.setdefault((rank, peer), t)
+        return t
+
+
+class InprocTransport(Transport):
+    """In-process Transport endpoint: rank's side of the (rank, peer)
+    link inside an InprocMesh. Payloads are flattened to immutable
+    bytes at the send boundary (the \"wire\"), so a memoryview of a
+    sender-side numpy chunk can never alias mutable state across
+    \"ranks\" — recv hands back a fresh bytearray per frame, keeping
+    the owned-buffer contract every transport shares."""
+
+    name = "inproc"
+
+    def __init__(self, mesh: InprocMesh, rank: int, peer: int):
+        self.mesh = mesh
+        self.rank = rank
+        self.peer = peer
+        self._tx = mesh.edge(rank, peer)   # frames I send
+        self._rx = mesh.edge(peer, rank)   # frames I receive
+        self.activity_cb: Optional[Callable] = None
+        self.health_cb: Optional[Callable] = None
+        self.injector = None  # set by owners that want chaos hooks
+
+    # -- helpers -------------------------------------------------------
+    @staticmethod
+    def _flatten(payload) -> bytes:
+        # star.join_buffers is the one scatter-gather coalescer; the
+        # bytes() wrap makes the "wire" copy immutable so a sender-side
+        # memoryview can never alias mutable state across "ranks".
+        from .star import join_buffers
+
+        return bytes(join_buffers(payload))
+
+    def _check_io(self, op: str):
+        inj = self.injector
+        if inj is not None and inj.active:
+            return inj.check_io(self.rank, self.peer, op)
+        return None
+
+    # -- Transport interface -------------------------------------------
+    def send(self, payload, channel: int) -> None:
+        from ..common import fault_injection
+
+        if self._check_io("send") == fault_injection.DROP:
+            return
+        blob = self._flatten(payload)
+        with self._tx.cond:
+            if self._tx.severed:
+                raise ConnectionError(
+                    f"inproc link {self.rank}->{self.peer} severed")
+            self._tx.inbox.setdefault(
+                channel, collections.deque()).append(blob)
+            self._tx.deposited_at = time.monotonic()
+            self._tx.cond.notify_all()
+
+    def recv(self, channel: int) -> bytearray:
+        self._check_io("recv")
+        deadline = time.monotonic() + self.mesh.timeout
+        with self._rx.cond:
+            while True:
+                q = self._rx.inbox.get(channel)
+                if q:
+                    buf = bytearray(q.popleft())
+                    cb = self.activity_cb
+                    if cb is not None:
+                        cb(self.peer)
+                    return buf
+                if self._rx.severed:
+                    raise ConnectionError(
+                        f"inproc link {self.peer}->{self.rank} severed")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"inproc recv from peer {self.peer} timed out "
+                        f"after {self.mesh.timeout:.1f}s")
+                self._rx.cond.wait(min(remaining, 1.0))
+
+    def recv_into(self, view: memoryview, channel: int) -> int:
+        from .base import desync_message
+
+        data = self.recv(channel)
+        if len(data) != len(view):
+            raise OSError(desync_message(len(data), len(view),
+                                         rank=self.rank, peer=self.peer))
+        view[:len(data)] = data
+        return len(data)
+
+    def sever(self) -> None:
+        for edge in (self._tx, self._rx):
+            with edge.cond:
+                edge.severed = True
+                edge.cond.notify_all()
+
+    @property
+    def alive(self) -> bool:
+        return not (self._rx.severed or self._tx.severed)
+
+    def drain_idle(self, max_frames: int = 64) -> int:
+        """Health frames deposited by the peer are consumed here;
+        anything else stays for its reader. A deposit since the last
+        sweep counts as activity evidence.
+        """
+        consumed = 0
+        cb = self.activity_cb
+        with self._rx.cond:
+            from .base import HEALTH_CHANNEL
+
+            q = self._rx.inbox.get(HEALTH_CHANNEL)
+            frames = []
+            while q and consumed < max_frames:
+                frames.append(q.popleft())
+                consumed += 1
+            fresh = self._rx.deposited_at
+            self._rx.deposited_at = None
+        hb = self.health_cb
+        for payload in frames:
+            if hb is not None:
+                hb(self.peer, bytes(payload))
+        if (frames or fresh is not None) and cb is not None:
+            cb(self.peer)
+        return consumed
+
+
+# ---------------------------------------------------------------------------
+# In-process mesh backend: the full TcpBackend peer surface (p2p +
+# star primitives + liveness + fault injection + sever semantics) over
+# InprocTransport links. The conformance suite runs the same checks
+# against this, the socket mesh and the shm overlay; it is also the
+# cheapest way to exercise sever/attribution paths in-process.
+def _inproc_factory(backend, peer: int, **kw) -> InprocTransport:
+    t = backend.mesh.transport(backend.rank, peer)
+    t.activity_cb = backend._note_activity
+    t.health_cb = backend._route_health
+    t.injector = backend._injector
+    return t
+
+
+register_transport("inproc", _inproc_factory)
+
+
+class InprocBackend:
+    """One rank of an in-process mesh (see module docstring). Mixed in
+    with the collectives mixins lazily in `make_inproc_backends` to
+    avoid a module-import cycle with backend/ring.py."""
+
+
+def make_inproc_backends(size: int, timeout: float = 60.0):
+    """Build a `size`-rank in-process mesh; returns the backends. Each
+    one supports the same data-plane + liveness surface the TCP mesh
+    does (send_to/recv_from/recv_into_from/send_async, gather/bcast/
+    scatter, declare_dead/death_reason/peer_activity/try_drain_idle/
+    set_health_callback), so tests exercise identical contracts."""
+    from ..common import fault_injection
+    from .ring import RingCollectivesMixin
+
+    mesh = InprocMesh(size, timeout=timeout)
+
+    class _InprocMeshBackend(RingCollectivesMixin, InprocBackend):
+        def __init__(self, rank: int):
+            self.mesh = mesh
+            self.rank = rank
+            self.size = size
+            self._injector = fault_injection.get_injector()
+            self._death_lock = threading.Lock()
+            self._death_reasons: Dict[int, str] = {}
+            self._health_cb = None
+            self._last_activity: Dict[int, float] = {}
+            self._transports: Dict[int, InprocTransport] = {
+                p: create_transport("inproc", self, p)
+                for p in range(size) if p != rank
+            }
+
+        # -- liveness surface (mirrors backend/tcp.py) -----------------
+        def set_health_callback(self, cb) -> None:
+            self._health_cb = cb
+
+        def _route_health(self, peer: int, payload) -> None:
+            self._note_activity(peer)
+            cb = self._health_cb
+            if cb is not None:
+                cb(peer, bytes(payload))
+
+        def _note_activity(self, peer: int) -> None:
+            self._last_activity[peer] = time.monotonic()
+
+        def peer_activity(self, peer: int):
+            return self._last_activity.get(peer)
+
+        def death_reason(self, peer: int):
+            with self._death_lock:
+                return self._death_reasons.get(peer)
+
+        def declare_dead(self, peer: int, reason: str) -> None:
+            with self._death_lock:
+                self._death_reasons.setdefault(peer, reason)
+            self._sever(peer)
+
+        def try_drain_idle(self, peer: int, max_frames: int = 64) -> int:
+            t = self._transports.get(peer)
+            return t.drain_idle(max_frames) if t is not None else 0
+
+        def _sever(self, peer: int):
+            t = self._transports.get(peer)
+            if t is not None:
+                t.sever()
+
+        def _transport_error(self, peer: int, what: str,
+                             exc) -> TransportError:
+            cause = self.death_reason(peer)
+            if cause is not None:
+                return TransportError(cause, peer=peer, reporter=self.rank,
+                                      root_cause=cause)
+            return TransportError(
+                f"rank {self.rank}: {what} peer {peer} failed: {exc}",
+                peer=peer, reporter=self.rank,
+            )
+
+        def _check_alive(self, peer: int):
+            t = self._transports[peer]
+            if not t.alive:
+                raise self._transport_error(
+                    peer, "use of severed link to", "severed")
+
+        # -- p2p primitives --------------------------------------------
+        def send_to(self, peer: int, payload):
+            from .base import current_channel
+
+            t = self._transports[peer]
+            try:
+                self._check_alive(peer)
+                t.send(payload, current_channel())
+            except (OSError, TimeoutError) as exc:
+                self._sever(peer)
+                raise self._transport_error(peer, "send to", exc) from exc
+
+        def recv_from(self, peer: int) -> bytearray:
+            from .base import current_channel
+
+            t = self._transports[peer]
+            try:
+                return t.recv(current_channel())
+            except (OSError, TimeoutError) as exc:
+                self._sever(peer)
+                raise self._transport_error(peer, "recv from", exc) from exc
+
+        def recv_into_from(self, peer: int, buf) -> int:
+            from .base import current_channel
+            from .star import as_byte_view
+
+            t = self._transports[peer]
+            try:
+                return t.recv_into(as_byte_view(buf), current_channel())
+            except (OSError, TimeoutError) as exc:
+                self._sever(peer)
+                raise self._transport_error(peer, "recv from", exc) from exc
+
+        def send_async(self, peer: int, payload, channel: Optional[int]
+                       = None):
+            from .base import current_channel
+
+            t = self._transports[peer]
+            ch = current_channel() if channel is None else channel
+            try:
+                self._check_alive(peer)
+                return t.send_async(payload, ch)
+            except (OSError, TimeoutError) as exc:
+                self._sever(peer)
+                raise self._transport_error(peer, "send to", exc) from exc
+
+        # -- star primitives over p2p ----------------------------------
+        def gather_bytes(self, payload):
+            if self.size == 1:
+                return [InprocTransport._flatten(payload)]
+            if self.rank == 0:
+                out = [InprocTransport._flatten(payload)]
+                for r in range(1, self.size):
+                    out.append(self.recv_from(r))
+                return out
+            self.send_to(0, payload)
+            return None
+
+        def bcast_bytes(self, payload):
+            if self.size == 1:
+                assert payload is not None
+                return payload
+            if self.rank == 0:
+                assert payload is not None
+                first_error: Optional[TransportError] = None
+                for r in range(1, self.size):
+                    try:
+                        self.send_to(r, payload)
+                    except TransportError as exc:
+                        if first_error is None:
+                            first_error = exc
+                if first_error is not None:
+                    raise first_error
+                return payload
+            return self.recv_from(0)
+
+        def scatter_bytes(self, payloads):
+            if self.size == 1:
+                assert payloads is not None
+                return InprocTransport._flatten(payloads[0])
+            if self.rank == 0:
+                assert payloads is not None
+                for r in range(1, self.size):
+                    self.send_to(r, payloads[r])
+                return InprocTransport._flatten(payloads[0])
+            return self.recv_from(0)
+
+        def transport_status(self) -> dict:
+            return {
+                "mode": "inproc",
+                "peers": {str(p): t.status()
+                          for p, t in sorted(self._transports.items())},
+            }
+
+        def shutdown(self):
+            for t in self._transports.values():
+                t.sever()
+
+    return [_InprocMeshBackend(r) for r in range(size)]

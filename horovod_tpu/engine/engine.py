@@ -367,6 +367,15 @@ class Engine:
                 "executing": list(cur) if cur else [],
             }
         st["channels"] = channels
+        # Transport plane (docs/running.md "Transports"): per-peer
+        # route view — which peers have a live shm overlay and what the
+        # current HOROVOD_TRANSPORT route is.
+        backend = self.backend
+        if backend is not None and hasattr(backend, "transport_status"):
+            try:
+                st["transports"] = backend.transport_status()
+            except Exception:  # pragma: no cover - status best-effort
+                pass
         # Tracing plane: recorder depth / drop count / last dump — the
         # "is the flight recorder actually capturing" view.
         trace = self.tracer.status()
@@ -474,15 +483,28 @@ class Engine:
             if self.size > 1:
                 from ..backend.ring import hierarchical_capable
 
-                word = 1 if hierarchical_capable(self.backend) else 0
-                self._hier_valid = bool(
-                    self.backend.allreduce_words([word], "and")[0] & 1
-                )
+                # Bit 0: hierarchical topology valid; bit 1: this rank
+                # votes for the leader-based cross schedule (all its
+                # local peers reachable over a live shm overlay). Both
+                # AND-agreed in one word so every rank lands on the
+                # same schedule — HOROVOD_HIERARCHICAL_MODE=auto
+                # resolves through leader_hier_ok.
+                word = 0
+                if hierarchical_capable(self.backend):
+                    word |= 1
+                if self.backend.prefers_leader_hierarchy():
+                    word |= 2
+                agreed = self.backend.allreduce_words([word], "and")[0]
+                self._hier_valid = bool(agreed & 1)
+                self.backend.leader_hier_ok = bool(agreed & 1) and bool(
+                    agreed & 2)
             # Static toggle (ref: HOROVOD_HIERARCHICAL_ALLREDUCE,
-            # operations.cc:468-478); autotune may flip it later at
+            # operations.cc:468-478; =auto engages exactly when the
+            # agreed topology is hierarchical — co-located ranks on
+            # more than one host); autotune may flip it later at
             # parameter-sync boundaries.
-            self.backend.hierarchical = self._hier_valid and env_cfg.get_bool(
-                env_cfg.HIERARCHICAL_ALLREDUCE, False
+            self.backend.hierarchical = self._hier_valid and (
+                env_cfg.hierarchical_allreduce_setting() != "off"
             )
             self.backend.hier_allgather = (
                 self._hier_valid

@@ -78,6 +78,13 @@ def build_default(backend) -> OperationManager:
         ))
     else:
         mgr.register(ResponseType.ALLREDUCE, OpEntry(
+            "SHM_ARENA_ALLREDUCE",
+            lambda nbytes, reduce_op: ring_mod.arena_eligible(
+                backend, nbytes, reduce_op),
+            lambda buf, rop, owned=False: backend._arena_allreduce(
+                buf, rop, owned=owned),
+        ))
+        mgr.register(ResponseType.ALLREDUCE, OpEntry(
             "HIERARCHICAL_RING_ALLREDUCE",
             lambda nbytes, reduce_op: ring_mod.hierarchical_eligible(
                 backend, nbytes, reduce_op),

@@ -85,8 +85,8 @@ class ParameterManager:
         self._window_start = time.monotonic()
         self.fusion_threshold = env_cfg.fusion_threshold_bytes()
         self.cycle_time_ms = env_cfg.cycle_time_ms()
-        self.hierarchical = env_cfg.get_bool(
-            env_cfg.HIERARCHICAL_ALLREDUCE, False
+        self.hierarchical = (
+            env_cfg.hierarchical_allreduce_setting() != "off"
         )
         self.cache_enabled = env_cfg.cache_enabled()
         # Categorical arms: (hierarchical, cache_enabled) combos, each

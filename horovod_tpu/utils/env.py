@@ -17,6 +17,15 @@ CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
 HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+# Cross-host schedule of the two-level hierarchical allreduce
+# (docs/running.md): "slice" — every local rank drives its own
+# cross-host ring on its owned slice (parallel inter-host streams);
+# "leader" — one leader per host gathers the host-reduced vector over
+# the intra-host transport and runs a single segmented inter-host ring
+# (the NCCL-hierarchical shape; one stream per host pair); "auto"
+# (default) — leader when the intra-host data plane is shared memory
+# on every host (agreed collectively at engine init), slice otherwise.
+HIERARCHICAL_MODE = "HOROVOD_HIERARCHICAL_MODE"
 AUTOTUNE = "HOROVOD_AUTOTUNE"
 AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 TIMELINE = "HOROVOD_TIMELINE"
@@ -58,6 +67,40 @@ TCP_POLL = "HOROVOD_TCP_POLL_SECONDS"
 CONNECT_ATTEMPTS = "HOROVOD_CONNECT_ATTEMPTS"
 CONNECT_BACKOFF = "HOROVOD_CONNECT_BACKOFF_SECONDS"
 CONNECT_BACKOFF_CAP = "HOROVOD_CONNECT_BACKOFF_CAP_SECONDS"
+
+# -- transport selection knobs (docs/running.md "Transports") ----------
+# Which data-plane transport moves collective payloads between ranks:
+#   tcp  (default) — every byte rides the TCP mesh sockets, co-located
+#          ranks included (loopback through the kernel).
+#   shm  — co-located ranks (same host, agreed via the rendezvous KV
+#          locality rows) exchange data-channel frames over mmap'd
+#          shared-memory ring buffers; remote peers stay on TCP.
+#   auto — like shm where peers are co-located, tcp otherwise (the
+#          recommended setting; it is what `shm` degrades to anyway).
+# Control-plane and heartbeat frames ALWAYS ride the TCP mesh — the
+# socket FIN/RST is what makes dead-peer detection bounded, and a
+# wedged peer's shm ring going quiet is attributed by the same
+# heartbeat verdict. The knob is read per send/recv, so benchmarks may
+# flip tcp<->shm between barrier-separated rounds; establishment (ring
+# creation) happens once at init and only when the LAUNCH value was
+# shm/auto.
+TRANSPORT = "HOROVOD_TRANSPORT"
+# Per-direction shared-memory ring capacity in bytes. Frames larger
+# than the ring stream through it (bounded-buffer pipe semantics), so
+# this bounds memory, not message size.
+SHM_RING_BYTES = "HOROVOD_SHM_RING_BYTES"
+# Directory for the ring files; default /dev/shm when present (true
+# page-cache-backed tmpfs), else the system temp dir.
+SHM_DIR = "HOROVOD_SHM_DIR"
+# Per-rank slot size of the intra-host arena (the fully-co-located
+# allreduce path): tensors up to this size move in one chunk; larger
+# ones stream through in slot-sized passes. Memory cost per arena is
+# (local_size + 1) x slot_bytes of tmpfs, materialized lazily per
+# executor channel.
+SHM_SLOT_BYTES = "HOROVOD_SHM_SLOT_BYTES"
+
+DEFAULT_SHM_RING_BYTES = 4 << 20
+DEFAULT_SHM_SLOT_BYTES = 16 << 20
 
 # -- liveness plane knobs (docs/fault_tolerance.md) --------------------
 # Cadence of the always-on heartbeat plane: workers beat the coordinator
@@ -249,8 +292,41 @@ def cache_enabled() -> bool:
 
 
 def tcp_timeout_seconds() -> float:
-    """0 = unbounded (the recv loop still polls for dead-peer FINs)."""
+    """0 = unbounded (the recv loop still polls for dead-peer FINs).
+    Also the generic transport idle bound: the shm rings apply it to
+    ring-full send stalls and empty-ring recv waits the same way."""
     return get_float(TCP_TIMEOUT, 0.0)
+
+
+def transport_mode() -> str:
+    """HOROVOD_TRANSPORT, normalized to tcp|shm|auto (unknown values
+    fall back to tcp — never crash the data plane over a typo; the
+    value is logged at establishment). Read per call so paired
+    benchmarks can flip the ROUTE between barrier-separated rounds."""
+    v = get_str(TRANSPORT, "tcp").lower()
+    return v if v in ("tcp", "shm", "auto") else "tcp"
+
+
+def shm_ring_bytes() -> int:
+    """Per-direction shm ring capacity; floor 64KB so tiny settings
+    cannot degenerate into a byte-at-a-time pipe."""
+    return max(get_int(SHM_RING_BYTES, DEFAULT_SHM_RING_BYTES), 1 << 16)
+
+
+def shm_slot_bytes() -> int:
+    """Arena per-rank slot capacity; floor 64KB."""
+    return max(get_int(SHM_SLOT_BYTES, DEFAULT_SHM_SLOT_BYTES), 1 << 16)
+
+
+def shm_dir() -> str:
+    d = get_str(SHM_DIR, "")
+    if d:
+        return d
+    if os.path.isdir("/dev/shm"):
+        return "/dev/shm"
+    import tempfile
+
+    return tempfile.gettempdir()
 
 
 def tcp_poll_seconds() -> float:
@@ -365,6 +441,27 @@ def checkpoint_commit_timeout() -> float:
 
 def checkpoint_fsync() -> bool:
     return get_bool(CHECKPOINT_FSYNC, True)
+
+
+def hierarchical_allreduce_setting() -> str:
+    """HOROVOD_HIERARCHICAL_ALLREDUCE as off|on|auto. `auto` enables
+    the two-level path exactly when the collectively-agreed topology is
+    hierarchical (co-located ranks on >1 host) — which is also the only
+    time `on` can engage — so the two differ only in intent: `on`
+    documents an expectation, `auto` an allowance. Falsey values
+    (0/false/no/off/empty-default) are off; anything else is on, which
+    keeps the historical get_bool contract."""
+    v = get_str(HIERARCHICAL_ALLREDUCE, "").lower()
+    if v in ("", "0", "false", "no", "off"):
+        return "off"
+    return "auto" if v == "auto" else "on"
+
+
+def hierarchical_mode() -> str:
+    """Cross-host schedule knob: slice|leader|auto (see
+    HIERARCHICAL_MODE above). Read per call like the ring knobs."""
+    v = get_str(HIERARCHICAL_MODE, "auto").lower()
+    return v if v in ("slice", "leader", "auto") else "auto"
 
 
 def metrics_sync_seconds() -> float:

@@ -110,6 +110,13 @@ def main() -> int:
     ap.add_argument("--interval", type=int, default=2,
                     help="HOROVOD_CHECKPOINT_INTERVAL_STEPS "
                          "(killall mode)")
+    ap.add_argument("--transport", choices=["tcp", "shm"], default="tcp",
+                    help="shm: data-plane frames between the co-located "
+                         "workers ride the shared-memory overlay "
+                         "(HOROVOD_TRANSPORT=auto) while heartbeats "
+                         "stay on TCP — proves kill/wedge detection "
+                         "and root-cause attribution hold when the "
+                         "dead peer is reached over shared memory")
     args = ap.parse_args()
 
     if args.killall:
@@ -142,6 +149,8 @@ def main() -> int:
                     td, f"verdict_{slot.rank}")
                 env["CHAOS_VERDICT_FILE"] = verdict_files[slot.rank]
                 env.pop("HOROVOD_FAULT_INJECT", None)
+                if args.transport == "shm":
+                    env["HOROVOD_TRANSPORT"] = "auto"
                 if args.wedge:
                     # The headline scenario: UNBOUNDED socket I/O — only
                     # the liveness plane bounds detection.

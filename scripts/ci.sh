@@ -17,8 +17,11 @@ python scripts/telemetry_smoke.py
 echo "=== tracing smoke (merged /trace + post-mortem on injected sever) ==="
 python scripts/trace_smoke.py
 
-echo "=== data-plane perf smoke (2-worker loopback, exact byte accounting) ==="
+echo "=== data-plane perf smoke (tcp + shm + hierarchical, exact byte accounting per transport) ==="
 python scripts/perf_smoke.py
+
+echo "=== chaos smoke over shared memory (wedge detection while data rides shm) ==="
+python scripts/chaos_smoke.py --transport shm --wedge
 
 echo "=== elastic recovery smoke (wedge 1 of 4, survivors resume at np=3) ==="
 python scripts/elastic_smoke.py

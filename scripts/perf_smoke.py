@@ -119,7 +119,108 @@ def worker():
     return checks
 
 
+def worker_shm():
+    """Shared-memory transport smoke (HOROVOD_TRANSPORT=auto at
+    launch): star over shm p2p, ring over the per-pair shm rings, and
+    the intra-host arena — engine byte accounting stays EXACT on every
+    path, and the per-transport counters let main() assert exact
+    conservation: every shm byte one rank sent, the other received."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    expect_bytes = 0
+    schedules = [
+        ("star", {"HOROVOD_CPU_OPERATIONS": "star"}),
+        # CPU_OPERATIONS=ring pins the per-pair shm RINGS (the arena
+        # would otherwise win the op registry).
+        ("shmring", {"HOROVOD_CPU_OPERATIONS": "ring",
+                     "HOROVOD_RING_THRESHOLD": "0",
+                     "HOROVOD_RING_SEGMENT_BYTES": "0"}),
+        ("arena", {"HOROVOD_RING_THRESHOLD": "0"}),
+    ]
+    for name, env in schedules:
+        os.environ.pop("HOROVOD_CPU_OPERATIONS", None)
+        os.environ.update(env)
+        for i in range(ITERS):
+            x = np.full(COUNT, float(hvd.rank() + 1), np.float32)
+            out = np.asarray(hvd.allreduce(
+                x, name=f"ps.{name}.{i}", op=hvd.Sum))
+            assert out.shape == (COUNT,), out.shape
+            assert float(out[0]) == sum(range(1, n + 1)), (name, out[0])
+            expect_bytes += x.nbytes
+    hvd.barrier()
+    snap = hvd.metrics()["metrics"]
+    got = snap["horovod_allreduce_bytes_total"]
+    assert got == expect_bytes, (
+        f"allreduce_bytes_total drifted on shm: got {got}, "
+        f"expected exactly {expect_bytes}")
+    shm_sent = snap.get(
+        'horovod_transport_bytes_total{direction="sent",transport="shm"}',
+        0)
+    shm_recv = snap.get(
+        'horovod_transport_bytes_total{direction="recv",transport="shm"}',
+        0)
+    assert shm_sent > 0 and shm_recv > 0, (
+        "data plane never rode shared memory", sorted(
+            k for k in snap if "transport_bytes" in k))
+    checks = {"rank": hvd.rank(), "bytes": got,
+              "shm_sent": shm_sent, "shm_recv": shm_recv}
+    hvd.shutdown()
+    return checks
+
+
+def worker_hier():
+    """Two-level hierarchical allreduce over a SIMULATED 2-host x
+    2-slot topology (distinct HOROVOD_HOSTNAME per host): intra-host
+    legs ride shm, inter-host legs ride tcp, in BOTH cross-schedule
+    modes (slice-parallel and leader). Byte accounting stays exact."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    expect_bytes = 0
+    os.environ["HOROVOD_RING_THRESHOLD"] = "0"
+    for mode in ("slice", "leader"):
+        os.environ["HOROVOD_HIERARCHICAL_MODE"] = mode
+        for i in range(ITERS):
+            x = np.full(COUNT, float(hvd.rank() + 1), np.float32)
+            out = np.asarray(hvd.allreduce(
+                x, name=f"ph.{mode}.{i}", op=hvd.Sum))
+            assert float(out[0]) == sum(range(1, n + 1)), (mode, out[0])
+            expect_bytes += x.nbytes
+    hvd.barrier()
+    snap = hvd.metrics()["metrics"]
+    got = snap["horovod_allreduce_bytes_total"]
+    assert got == expect_bytes, (
+        f"allreduce_bytes_total drifted (hier): got {got}, "
+        f"expected exactly {expect_bytes}")
+    shm_sent = snap.get(
+        'horovod_transport_bytes_total{direction="sent",transport="shm"}',
+        0)
+    tcp_sent = snap.get(
+        'horovod_transport_bytes_total{direction="sent",transport="tcp"}',
+        0)
+    # Both planes must have carried data: intra-host over shm,
+    # inter-host over tcp.
+    assert shm_sent > 0, "intra-host legs never rode shm"
+    assert tcp_sent > 0, "inter-host legs never rode tcp"
+    checks = {"rank": hvd.rank(), "bytes": got,
+              "shm_sent": shm_sent,
+              "shm_recv": snap.get(
+                  'horovod_transport_bytes_total'
+                  '{direction="recv",transport="shm"}', 0)}
+    hvd.shutdown()
+    return checks
+
+
 def main():
+    import json
+
     from horovod_tpu.runner import run
 
     results = run(worker, np=2, extra_env={
@@ -129,7 +230,52 @@ def main():
     })
     assert len(results) == 2, results
     assert all(r["bytes"] == results[0]["bytes"] for r in results), results
-    print("perf smoke OK:", results)
+    print("perf smoke OK (tcp):", results)
+
+    shm_results = run(worker_shm, np=2, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_TCP_TIMEOUT_SECONDS": "60",
+        "HOROVOD_TRANSPORT": "auto",
+    })
+    assert len(shm_results) == 2, shm_results
+    assert all(r["bytes"] == shm_results[0]["bytes"]
+               for r in shm_results), shm_results
+    # Exact shm conservation: every byte (headers included) one rank
+    # wrote into a ring or arena, its peer consumed.
+    total_sent = sum(r["shm_sent"] for r in shm_results)
+    total_recv = sum(r["shm_recv"] for r in shm_results)
+    assert total_sent == total_recv, (
+        f"shm byte conservation broken: sent {total_sent} != "
+        f"recv {total_recv}")
+    print("perf smoke OK (shm):", shm_results)
+
+    # The simulated hosts are spawned locally: the LAUNCHER consults
+    # HVDRUN_FORCE_LOCAL from its own env (extra_env only reaches the
+    # workers).
+    os.environ["HVDRUN_FORCE_LOCAL"] = "1"
+    hier_results = run(worker_hier, np=4, hosts="hostA:2,hostB:2",
+                       extra_env={
+                           "JAX_PLATFORMS": "cpu",
+                           "HOROVOD_CYCLE_TIME": "1",
+                           "HOROVOD_TCP_TIMEOUT_SECONDS": "120",
+                           "HOROVOD_TRANSPORT": "auto",
+                           "HOROVOD_HIERARCHICAL_ALLREDUCE": "auto",
+                           "HVDRUN_FORCE_LOCAL": "1",
+                       })
+    assert len(hier_results) == 4, hier_results
+    assert all(r["bytes"] == hier_results[0]["bytes"]
+               for r in hier_results), hier_results
+    assert (sum(r["shm_sent"] for r in hier_results)
+            == sum(r["shm_recv"] for r in hier_results)), hier_results
+    print("perf smoke OK (hier):", hier_results)
+    print(json.dumps({
+        "metric": "perf_smoke",
+        "tcp_bytes": results[0]["bytes"],
+        "shm_bytes": shm_results[0]["bytes"],
+        "shm_conserved": total_sent,
+        "hier_bytes": hier_results[0]["bytes"],
+    }))
 
 
 if __name__ == "__main__":

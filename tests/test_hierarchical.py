@@ -380,3 +380,98 @@ def test_hierarchical_allgather_scalar_falls_back(monkeypatch):
     for o in results:
         np.testing.assert_allclose(np.ravel(o),
                                    np.arange(4, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# leader-based two-level schedule (HOROVOD_HIERARCHICAL_MODE=leader):
+# intra-host reduce-scatter -> gather to the host leader -> ONE
+# segmented inter-host ring between leaders -> intra-host bcast.
+@pytest.mark.parametrize("size,topo", [
+    (4, lambda r: (r % 2, 2, r // 2, 2)),
+    (6, lambda r: (r % 3, 3, r // 3, 2)),
+    (8, lambda r: (r % 2, 2, r // 2, 4)),
+])
+def test_leader_hierarchical_matches_sum(size, topo, monkeypatch):
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    n = 4099
+
+    def fn(b, r):
+        arr = np.arange(n, dtype=np.float64) + r * 10.0
+        return b._hierarchical_allreduce(arr, ReduceOp.SUM)
+
+    results = _run_backend_ranks(size, topo, fn)
+    want = (np.arange(n, dtype=np.float64) * size
+            + 10.0 * sum(range(size)))
+    for r in range(size):
+        np.testing.assert_allclose(results[r], want)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5])
+def test_leader_hierarchical_tiny_and_average(n, monkeypatch):
+    """Element counts below the group size exercise empty owned slices
+    on both the member-send and leader-gather sides — the skip logic
+    must agree or the exchange deadlocks."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+
+    def fn(b, r):
+        return b._hierarchical_allreduce(
+            np.full(n, float(r + 1)), ReduceOp.AVERAGE)
+
+    results = _run_backend_ranks(4, _topo_2x2, fn)
+    for r in range(4):
+        np.testing.assert_allclose(results[r], 2.5)
+
+
+def test_hierarchical_mode_resolution(monkeypatch):
+    """auto resolves through the ENGINE-agreed leader_hier_ok flag
+    (never a per-rank local answer); explicit values win outright."""
+    from horovod_tpu.backend.ring import hierarchical_mode
+
+    class B:
+        leader_hier_ok = False
+
+    b = B()
+    monkeypatch.delenv("HOROVOD_HIERARCHICAL_MODE", raising=False)
+    assert hierarchical_mode(b) == "slice"
+    b.leader_hier_ok = True
+    assert hierarchical_mode(b) == "leader"
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "slice")
+    assert hierarchical_mode(b) == "slice"
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    b.leader_hier_ok = False
+    assert hierarchical_mode(b) == "leader"
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "bogus")
+    assert hierarchical_mode(b) == "slice"  # auto fallback, flag off
+
+
+def test_hierarchical_allreduce_setting(monkeypatch):
+    from horovod_tpu.utils import env as env_cfg
+
+    for v, want in [("", "off"), ("0", "off"), ("false", "off"),
+                    ("off", "off"), ("1", "on"), ("true", "on"),
+                    ("auto", "auto")]:
+        if v:
+            monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", v)
+        else:
+            monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE",
+                               raising=False)
+        assert env_cfg.hierarchical_allreduce_setting() == want, v
+
+
+def test_hierarchical_auto_engages_on_valid_topology(monkeypatch):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE=auto turns the two-level path on
+    exactly when the agreed topology is hierarchical: the engine's
+    allreduce dispatch must pick the hierarchical plane."""
+    from horovod_tpu.backend.ring import hierarchical_eligible
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "auto")
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+
+    def fn(b, r):
+        # Engine wiring equivalent: valid topology + setting != off.
+        from horovod_tpu.utils import env as env_cfg
+
+        b.hierarchical = env_cfg.hierarchical_allreduce_setting() != "off"
+        return hierarchical_eligible(b, 1 << 20, ReduceOp.SUM)
+
+    assert all(_run_backend_ranks(4, _topo_2x2, fn))

@@ -61,8 +61,8 @@ def test_build_default_priority(monkeypatch):
     b = _topo(g.backend(0), 0, 2, 0, 2, hier=True)
     mgr = build_default(b)
     names = [e.name for e in mgr.entries(ResponseType.ALLREDUCE)]
-    assert names == ["HIERARCHICAL_RING_ALLREDUCE", "RING_ALLREDUCE",
-                     "STAR_ALLREDUCE"]
+    assert names == ["SHM_ARENA_ALLREDUCE", "HIERARCHICAL_RING_ALLREDUCE",
+                     "RING_ALLREDUCE", "STAR_ALLREDUCE"]
 
     pick = lambda n: mgr.select(ResponseType.ALLREDUCE, nbytes=n,
                                 reduce_op=ReduceOp.SUM).name

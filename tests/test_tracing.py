@@ -424,21 +424,31 @@ def test_status_trace_view_single_engine():
 def test_straggler_gauges_name_the_last_rank(monkeypatch):
     engines, regs = _start_engines(2)
     try:
-        barrier = threading.Barrier(2)
+        # Up to 3 attempts: the scenario depends on rank 0's request
+        # genuinely arriving first, and on a loaded 2-core CI box the
+        # scheduler can occasionally delay rank 0's enqueue past rank
+        # 1's deliberate 0.25s lag — that inversion is box noise, not
+        # a gauge bug. Each attempt uses a fresh tensor name, so the
+        # gauges re-stamp from a fresh negotiation.
+        for attempt in range(3):
+            barrier = threading.Barrier(2)
 
-        def work(eng, r):
-            barrier.wait()
-            if r == 1:
-                time.sleep(0.25)  # rank 1 is deliberately late
-            eng.synchronize(eng.enqueue_allreduce(
-                np.ones(8, np.float32), name="lag"), timeout=30)
+            def work(eng, r, a=attempt):
+                barrier.wait()
+                if r == 1:
+                    time.sleep(0.25)  # rank 1 is deliberately late
+                eng.synchronize(eng.enqueue_allreduce(
+                    np.ones(8, np.float32), name=f"lag.{a}"), timeout=30)
 
-        _all(engines, work)
-        snap = regs[0].snapshot()
+            _all(engines, work)
+            snap = regs[0].snapshot()
+            w1 = snap['horovod_negotiation_wait_seconds{rank="1"}']
+            w0 = snap['horovod_negotiation_wait_seconds{rank="0"}']
+            if (snap["horovod_straggler_rank"] == 1
+                    and w1 > 0.15 and w0 == 0.0):
+                break
         assert snap["horovod_straggler_rank"] == 1, snap.get(
             "horovod_straggler_rank")
-        w1 = snap['horovod_negotiation_wait_seconds{rank="1"}']
-        w0 = snap['horovod_negotiation_wait_seconds{rank="0"}']
         assert w1 > 0.15 and w0 == 0.0, (w0, w1)
     finally:
         _all(engines, lambda e, r: e.shutdown(), timeout=90)

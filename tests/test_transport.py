@@ -1,0 +1,601 @@
+"""Transport conformance suite: the SAME contract checks run against
+every data-plane transport — the TCP socket mesh, the shared-memory
+overlay (rings + arena), and the in-process test transport.
+
+What "conformance" pins down (backend/transport.py):
+
+* framing round-trip — bytes | bytearray | memoryview | numpy | list of
+  buffers | empty frames all arrive intact, as exclusively-owned
+  writable buffers;
+* channel demux — frames on different channel tags never steal each
+  other's payloads, whatever order they are consumed in;
+* recv_into exact-length contract — a length mismatch is a desynced
+  peer: sever + TransportError with the shared
+  HOROVOD_RING_SEGMENT_BYTES hint (base.desync_message — the text can
+  no longer drift between transports);
+* sever propagation — declare_dead unblocks parked I/O NOW and every
+  later op carries the attributed verdict;
+* activity evidence — received frames (and the idle drain / progress
+  sweep) feed peer_activity, the liveness plane's food;
+* fault injection — sever / delay / drop rules fire identically via
+  the shared injector hooks (wedge is process-level and exercised by
+  scripts/chaos_smoke.py --transport shm and tests/test_health.py).
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.backend.base import channel_scope, desync_message
+from horovod_tpu.common import fault_injection
+from horovod_tpu.common.exceptions import TransportError
+from horovod_tpu.common.fault_injection import Rule
+from horovod_tpu.common.telemetry import MetricsRegistry
+
+KINDS = ["inproc", "tcp", "shm"]
+
+# Data-plane channel used by every check: shm routing only engages for
+# data channels (control/heartbeat frames always ride the sockets), so
+# running the whole suite inside this scope exercises the overlay on
+# the "shm" kind and plain sockets on "tcp".
+DATA_CH = 0
+
+
+class _Pair:
+    def __init__(self, kind, b0, b1, regs, server):
+        self.kind = kind
+        self.b0 = b0
+        self.b1 = b1
+        self.regs = regs
+        self.server = server
+
+    def close(self):
+        for b in (self.b0, self.b1):
+            try:
+                b.shutdown()
+            except Exception:
+                pass
+        if self.server is not None:
+            self.server.stop()
+
+
+def _make_pair(kind, scope, monkeypatch) -> _Pair:
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+    if kind == "inproc":
+        from horovod_tpu.backend.transport import make_inproc_backends
+
+        b0, b1 = make_inproc_backends(2, timeout=10.0)
+        return _Pair(kind, b0, b1, None, None)
+
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+    from horovod_tpu.backend.tcp import TcpBackend
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    monkeypatch.setenv("HVDRUN_FORCE_LOCAL", "1")
+    if kind == "shm":
+        monkeypatch.setenv("HOROVOD_TRANSPORT", "auto")
+    else:
+        monkeypatch.delenv("HOROVOD_TRANSPORT", raising=False)
+    server = RendezvousServer()
+    port = server.start()
+    rdv = RendezvousClient("127.0.0.1", port)
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    backends = [None, None]
+    errs = []
+
+    def build(rank):
+        try:
+            backends[rank] = TcpBackend(rank, 2, rendezvous=rdv,
+                                        scope=scope, registry=regs[rank])
+        except BaseException as e:  # pragma: no cover - bootstrap bug
+            errs.append(e)
+
+    threads = [threading.Thread(target=build, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs, errs
+    assert backends[0] is not None and backends[1] is not None
+    if kind == "shm":
+        # Establishment must actually have happened — a silent fallback
+        # to tcp would make the whole suite vacuous.
+        assert 1 in backends[0]._overlays and 0 in backends[1]._overlays
+    return _Pair(kind, backends[0], backends[1], regs, server)
+
+
+@pytest.fixture(params=KINDS)
+def pair(request, monkeypatch):
+    scope = f"t_conform_{request.param}_{request.node.name[:24]}"
+    scope = "".join(c if c.isalnum() or c == "_" else "_" for c in scope)
+    p = _make_pair(request.param, scope, monkeypatch)
+    try:
+        yield p
+    finally:
+        fault_injection.injector.clear()
+        p.close()
+
+
+def _both(fn0, fn1, timeout=30):
+    out = [None, None]
+    errs = [None, None]
+
+    def run(i, fn):
+        try:
+            out[i] = fn()
+        except BaseException as e:  # noqa: BLE001
+            errs[i] = e
+
+    ts = [threading.Thread(target=run, args=(i, f))
+          for i, f in enumerate((fn0, fn1))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+# ---------------------------------------------------------------------------
+def test_framing_roundtrip_all_buffer_shapes(pair):
+    payloads = [
+        (b"plain", b"plain"),
+        (bytearray(b"ba"), b"ba"),
+        (memoryview(b"mv"), b"mv"),
+        (np.array([1, 2], np.uint8), b"\x01\x02"),
+        ([b"x", b"", b"y"], b"xy"),
+        (b"", b""),
+        (np.arange(1000, dtype=np.float32),
+         np.arange(1000, dtype=np.float32).tobytes()),
+    ]
+
+    def sender():
+        with channel_scope(DATA_CH):
+            for data, _ in payloads:
+                pair.b0.send_to(1, data)
+
+    def receiver():
+        got = []
+        with channel_scope(DATA_CH):
+            for _ in payloads:
+                got.append(pair.b1.recv_from(0))
+        return got
+
+    _, got = _both(sender, receiver)
+    for (_, expect), buf in zip(payloads, got):
+        assert bytes(buf) == expect
+        # Owned-buffer contract: every received frame is writable and
+        # exclusively the receiver's (unpack_array aliases it).
+        if len(buf):
+            view = memoryview(buf)
+            assert not view.readonly
+
+
+def test_channel_demux_out_of_order_consumption(pair):
+    def sender():
+        with channel_scope(3):
+            pair.b0.send_to(1, b"ch3-first")
+        with channel_scope(5):
+            pair.b0.send_to(1, b"ch5-second")
+
+    def receiver():
+        with channel_scope(5):
+            five = pair.b1.recv_from(0)
+        with channel_scope(3):
+            three = pair.b1.recv_from(0)
+        return bytes(five), bytes(three)
+
+    _, (five, three) = _both(sender, receiver)
+    assert five == b"ch5-second" and three == b"ch3-first"
+
+
+def test_recv_into_exact_and_desync_severs(pair):
+    src = np.arange(256, dtype=np.float32)
+
+    def sender():
+        with channel_scope(DATA_CH):
+            pair.b0.send_to(1, src)
+            pair.b0.send_to(1, b"runt")
+
+    def receiver():
+        with channel_scope(DATA_CH):
+            dst = np.empty_like(src)
+            n = pair.b1.recv_into_from(0, dst)
+            assert n == src.nbytes
+            np.testing.assert_array_equal(dst, src)
+            # Second frame: 4 bytes against a 1KB buffer = desynced.
+            with pytest.raises(
+                    (TransportError, Exception),
+                    match="HOROVOD_RING_SEGMENT_BYTES") as ei:
+                pair.b1.recv_into_from(0, np.empty_like(src))
+            return ei
+
+    _both(sender, receiver)
+
+
+def test_desync_message_is_the_single_source_of_truth():
+    msg = desync_message(4, 1024, rank=1, peer=0)
+    assert "frame length 4 != expected 1024" in msg
+    assert "HOROVOD_RING_SEGMENT_BYTES" in msg
+    assert "desynced peer" in msg
+
+
+def test_sever_unblocks_parked_recv_with_verdict(pair):
+    reason = "rank 0 declared dead by rank 1: no heartbeat (test)"
+    errs = {}
+
+    def receiver():
+        try:
+            with channel_scope(DATA_CH):
+                pair.b1.recv_from(0)
+        except TransportError as e:
+            errs["e"] = e
+
+    t = threading.Thread(target=receiver)
+    t.start()
+    time.sleep(0.3)
+    pair.b1.declare_dead(0, reason)
+    t.join(timeout=10)
+    assert not t.is_alive(), "sever did not unblock the parked recv"
+    assert reason in str(errs["e"])
+    # Later ops fail fast with the same latched root cause.
+    with pytest.raises(TransportError, match="no heartbeat"):
+        with channel_scope(DATA_CH):
+            pair.b1.recv_from(0)
+    assert pair.b1.death_reason(0) == reason
+
+
+def test_send_async_ticket_completes_and_fails_after_sever(pair):
+    def sender():
+        with channel_scope(DATA_CH):
+            t1 = pair.b0.send_async(1, b"ticketed")
+            t1.wait()
+
+    def receiver():
+        with channel_scope(DATA_CH):
+            return bytes(pair.b1.recv_from(0))
+
+    _, got = _both(sender, receiver)
+    assert got == b"ticketed"
+    pair.b0.declare_dead(1, "peer 1 is gone (test)")
+    with pytest.raises(TransportError):
+        with channel_scope(DATA_CH):
+            pair.b0.send_async(1, b"late").wait()
+
+
+def test_activity_evidence_from_frames_and_idle_drain(pair):
+    assert pair.b1.peer_activity(0) is None
+
+    def sender():
+        with channel_scope(DATA_CH):
+            pair.b0.send_to(1, b"proof-of-life")
+
+    def receiver():
+        with channel_scope(DATA_CH):
+            pair.b1.recv_from(0)
+
+    _both(sender, receiver)
+    t0 = pair.b1.peer_activity(0)
+    assert t0 is not None
+
+    # A frame nobody receives still surfaces as evidence through the
+    # liveness sweep (consumed into an inbox on tcp/inproc; observed
+    # as ring write-cursor progress on shm).
+    with channel_scope(DATA_CH):
+        pair.b0.send_to(1, b"unclaimed")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        pair.b1.try_drain_idle(0)
+        t1 = pair.b1.peer_activity(0)
+        if t1 is not None and t1 > t0:
+            break
+        time.sleep(0.05)
+    assert pair.b1.peer_activity(0) > t0, \
+        "idle drain produced no activity evidence"
+
+
+def test_injected_sever_translates(pair):
+    fault_injection.injector.install(
+        [Rule(action="sever", peer=0, rank=1, op="recv")])
+    try:
+        with pytest.raises(TransportError, match="severed"):
+            with channel_scope(DATA_CH):
+                pair.b1.recv_from(0)
+    finally:
+        fault_injection.injector.clear()
+
+
+def test_injected_delay_applies(pair):
+    fault_injection.injector.install(
+        [Rule(action="delay", peer=1, rank=0, secs=0.4, op="send")])
+    try:
+        def sender():
+            t0 = time.monotonic()
+            with channel_scope(DATA_CH):
+                pair.b0.send_to(1, b"slow")
+            return time.monotonic() - t0
+
+        def receiver():
+            with channel_scope(DATA_CH):
+                return bytes(pair.b1.recv_from(0))
+
+        elapsed, got = _both(sender, receiver)
+        assert got == b"slow"
+        assert elapsed >= 0.4
+    finally:
+        fault_injection.injector.clear()
+
+
+def test_injected_drop_starves_receiver_into_timeout(pair, monkeypatch):
+    # Diskless drop: the send silently vanishes; the receiver's idle
+    # bound must fire (bounded-time detection, not a hang).
+    fault_injection.injector.install(
+        [Rule(action="drop", peer=1, rank=0, op="send")])
+    try:
+        def sender():
+            with channel_scope(DATA_CH):
+                pair.b0.send_to(1, b"dropped")
+
+        def receiver():
+            with pytest.raises(TransportError):
+                with channel_scope(DATA_CH):
+                    pair.b1.recv_from(0)
+
+        _both(sender, receiver, timeout=60)
+    finally:
+        fault_injection.injector.clear()
+
+
+# ---------------------------------------------------------------------------
+# transport-specific conformance extras
+def test_shm_route_actually_moves_bytes_over_shm(monkeypatch):
+    p = _make_pair("shm", "t_shm_counters", monkeypatch)
+    try:
+        payload = np.arange(4096, dtype=np.float32)
+
+        def sender():
+            with channel_scope(DATA_CH):
+                p.b0.send_to(1, payload)
+
+        def receiver():
+            with channel_scope(DATA_CH):
+                return p.b1.recv_from(0)
+
+        _both(sender, receiver)
+        sent = p.regs[0].snapshot().get(
+            'horovod_transport_bytes_total'
+            '{direction="sent",transport="shm"}', 0)
+        recv = p.regs[1].snapshot().get(
+            'horovod_transport_bytes_total'
+            '{direction="recv",transport="shm"}', 0)
+        # Exact per-transport accounting: payload + 9-byte frame header.
+        assert sent == payload.nbytes + 9, sent
+        assert recv == payload.nbytes + 9, recv
+
+        # Control-plane bytes must NOT ride shm: a ctrl round moves tcp
+        # counters only.
+        before = sent
+
+        def words0():
+            return p.b0.allreduce_words([3], "and")
+
+        def words1():
+            return p.b1.allreduce_words([1], "and")
+
+        w0, _ = _both(words0, words1)
+        assert w0 == [1]
+        assert p.regs[0].snapshot().get(
+            'horovod_transport_bytes_total'
+            '{direction="sent",transport="shm"}', 0) == before
+        assert p.regs[0].snapshot().get(
+            'horovod_transport_bytes_total'
+            '{direction="sent",transport="tcp"}', 0) > 0
+    finally:
+        p.close()
+
+
+def test_shm_ring_backpressure_counted(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SHM_RING_BYTES", str(1 << 16))
+    p = _make_pair("shm", "t_shm_backpressure", monkeypatch)
+    try:
+        big = np.zeros(1 << 18, dtype=np.float32)  # 1MB through 64KB ring
+
+        def sender():
+            with channel_scope(DATA_CH):
+                p.b0.send_to(1, big)
+
+        def receiver():
+            time.sleep(0.2)  # let the ring fill before draining
+            with channel_scope(DATA_CH):
+                return p.b1.recv_from(0)
+
+        _, got = _both(sender, receiver)
+        assert len(got) == big.nbytes
+        stalls = p.regs[0].snapshot().get("horovod_shm_ring_full_total", 0)
+        assert stalls >= 1, "a 1MB frame through a 64KB ring never stalled?"
+    finally:
+        p.close()
+
+
+def test_shm_transport_route_flips_per_call(monkeypatch):
+    p = _make_pair("shm", "t_shm_flip", monkeypatch)
+    try:
+        key = ('horovod_transport_bytes_total'
+               '{direction="sent",transport="shm"}')
+
+        def xfer():
+            def s():
+                with channel_scope(DATA_CH):
+                    p.b0.send_to(1, b"x" * 64)
+
+            def r():
+                with channel_scope(DATA_CH):
+                    return p.b1.recv_from(0)
+
+            _both(s, r)
+
+        xfer()
+        after_shm = p.regs[0].snapshot().get(key, 0)
+        assert after_shm > 0
+        os.environ["HOROVOD_TRANSPORT"] = "tcp"
+        try:
+            xfer()
+            assert p.regs[0].snapshot().get(key, 0) == after_shm
+        finally:
+            os.environ["HOROVOD_TRANSPORT"] = "auto"
+        xfer()
+        assert p.regs[0].snapshot().get(key, 0) > after_shm
+    finally:
+        p.close()
+
+
+def test_shm_arena_allreduce_and_sever(monkeypatch):
+    from horovod_tpu.common.types import ReduceOp
+
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    p = _make_pair("shm", "t_shm_arena", monkeypatch)
+    try:
+        assert p.b0.arena_set is not None and p.b1.arena_set is not None
+        n = 100001
+
+        def r0():
+            with channel_scope(DATA_CH):
+                return p.b0.allreduce(
+                    np.arange(n, dtype=np.float64), ReduceOp.SUM)
+
+        def r1():
+            with channel_scope(DATA_CH):
+                return p.b1.allreduce(
+                    np.arange(n, dtype=np.float64) * 2, ReduceOp.SUM)
+
+        out0, out1 = _both(r0, r1)
+        want = np.arange(n, dtype=np.float64) * 3
+        np.testing.assert_array_equal(out0, want)
+        np.testing.assert_array_equal(out1, want)
+        # Arena bytes count under the shm transport label.
+        assert p.regs[0].snapshot().get(
+            'horovod_transport_bytes_total'
+            '{direction="sent",transport="shm"}', 0) >= n * 8
+
+        # A death verdict unblocks a parked arena barrier with the
+        # attributed reason (heartbeats ride TCP; the verdict severs).
+        reason = "rank 1 declared dead by rank 0: wedged (test)"
+        errs = {}
+
+        def stuck():
+            try:
+                with channel_scope(DATA_CH):
+                    p.b0.allreduce(np.ones(1024, np.float32),
+                                   ReduceOp.SUM)
+            except TransportError as e:
+                errs["e"] = e
+
+        t = threading.Thread(target=stuck)
+        t.start()
+        time.sleep(0.3)
+        p.b0.declare_dead(1, reason)
+        t.join(timeout=10)
+        assert not t.is_alive(), "arena barrier did not unblock on sever"
+        assert reason in str(errs["e"])
+    finally:
+        p.close()
+
+
+def test_tcp_base_transport_objects_cover_every_peer(monkeypatch):
+    p = _make_pair("tcp", "t_base_transports", monkeypatch)
+    try:
+        from horovod_tpu.backend.tcp import TcpTransport
+
+        assert set(p.b0._transports) == {1}
+        assert isinstance(p.b0._transports[1], TcpTransport)
+        assert p.b0._transports[1].alive
+        st = p.b0.transport_status()
+        assert st["mode"] == "tcp"
+        assert st["peers"]["1"]["overlay"] is None
+    finally:
+        p.close()
+
+
+def test_transport_registry_rejects_unknown_names():
+    from horovod_tpu.backend.transport import (
+        create_transport,
+        transport_names,
+    )
+
+    assert {"tcp", "inproc"} <= set(transport_names())
+    with pytest.raises(ValueError, match="unknown transport"):
+        create_transport("carrier-pigeon", None, 0)
+
+
+def test_one_sided_shm_failure_degrades_whole_pair_to_tcp(monkeypatch):
+    """Establishment is pairwise agreed: if one side cannot set up its
+    rings (unwritable shm dir), BOTH sides must stay on tcp — a
+    one-sided route would park the succeeding side's recv on a ring
+    nobody writes, forever under unbounded timeouts."""
+    from horovod_tpu.backend import shm as shm_mod
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+    from horovod_tpu.backend.tcp import TcpBackend
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    monkeypatch.setenv("HVDRUN_FORCE_LOCAL", "1")
+    monkeypatch.setenv("HOROVOD_TRANSPORT", "auto")
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "10")
+
+    orig_init = shm_mod.ShmTransport.__init__
+
+    def failing_init(self, backend, peer, **kw):
+        if backend.rank == 1:
+            raise OSError("simulated unwritable shm dir")
+        orig_init(self, backend, peer, **kw)
+
+    monkeypatch.setattr(shm_mod.ShmTransport, "__init__", failing_init)
+
+    server = RendezvousServer()
+    port = server.start()
+    rdv = RendezvousClient("127.0.0.1", port)
+    backends = [None, None]
+    errs = []
+
+    def build(rank):
+        try:
+            backends[rank] = TcpBackend(rank, 2, rendezvous=rdv,
+                                        scope="t_one_sided_shm")
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=build, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    try:
+        assert not errs, errs
+        b0, b1 = backends
+        # Rank 1's local failure votes the PAIR down on both sides.
+        assert b0._overlays == {} and b1._overlays == {}
+        assert b0.arena_set is None and b1.arena_set is None
+        # ...and data-channel traffic still flows, over the sockets.
+        got = {}
+
+        def sender():
+            with channel_scope(DATA_CH):
+                b0.send_to(1, b"over tcp after all")
+
+        def receiver():
+            with channel_scope(DATA_CH):
+                got["v"] = bytes(b1.recv_from(0))
+
+        _both(sender, receiver)
+        assert got["v"] == b"over tcp after all"
+    finally:
+        for b in backends:
+            if b is not None:
+                b.shutdown()
+        server.stop()
