@@ -85,5 +85,6 @@ from .parallel.step import wrap_step
 
 from . import elastic
 from . import callbacks
+from . import serving
 
 __all__ = [k for k in dir() if not k.startswith("_")]

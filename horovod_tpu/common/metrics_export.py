@@ -172,22 +172,26 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path.startswith("/metrics"):
                 self._send(200, to_prometheus(srv.registry),
                            "text/plain; version=0.0.4; charset=utf-8")
-            elif self.path.startswith("/status"):
-                status = srv.status_fn() if srv.status_fn else {}
-                self._send(200, json.dumps(status, indent=1, sort_keys=True),
-                           "application/json")
-            elif self.path.startswith("/trace"):
-                # Merged Perfetto/Chrome trace: one process lane per
-                # rank, clock-aligned (docs/tracing.md). Save the body
-                # as a .json and open it in ui.perfetto.dev.
-                if srv.trace_fn is None:
-                    self._send(404, "tracing not served on this rank\n",
-                               "text/plain")
-                else:
-                    self._send(200, srv.trace_fn(), "application/json")
             else:
-                self._send(404, "not found: try /metrics, /metrics.json, "
-                           "/status, /trace\n", "text/plain")
+                # Registered views (add_view): /<name> serves whatever
+                # the provider returns — dicts render as JSON, strings
+                # pass through verbatim (pre-rendered documents like the
+                # merged Perfetto /trace body).
+                name = self.path.lstrip("/").split("?")[0].split("/")[0]
+                fn = srv.get_view(name)
+                if fn is None:
+                    views = ", ".join("/" + v for v in srv.view_names())
+                    self._send(404, f"not found: try /metrics, "
+                               f"/metrics.json{', ' + views if views else ''}"
+                               "\n", "text/plain")
+                else:
+                    body = fn()
+                    if isinstance(body, str):
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(200, json.dumps(body, indent=1,
+                                                   sort_keys=True),
+                                   "application/json")
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper hung up mid-response; nothing left to answer
         except Exception as e:  # a broken provider must not kill the server
@@ -201,7 +205,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MetricsHTTPServer:
-    """Daemon-thread HTTP server for /metrics, /metrics.json and /status.
+    """Daemon-thread HTTP server for /metrics and /metrics.json plus
+    pluggable views: each `add_view(name, fn)` registration serves the
+    provider's result at `/<name>` (dicts as JSON, strings verbatim).
+    The engine registers "status" and "trace"; planes that come and go
+    (serving, future workloads) register and remove their own views
+    instead of threading constructor kwargs through this module.
     `port=0` binds an ephemeral port (tests); read it back via `.port`."""
 
     def __init__(self, port: int,
@@ -212,8 +221,13 @@ class MetricsHTTPServer:
                  trace_fn: Optional[Callable[[], str]] = None):
         self.registry = registry or telemetry.default_registry()
         self.fleet = fleet
-        self.status_fn = status_fn
-        self.trace_fn = trace_fn
+        self._views: dict = {}
+        self._views_lock = threading.Lock()
+        # Constructor sugar kept for the two original views.
+        if status_fn is not None:
+            self.add_view("status", status_fn)
+        if trace_fn is not None:
+            self.add_view("trace", trace_fn)
         self._httpd = ThreadingHTTPServer((addr, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self  # type: ignore[attr-defined]
@@ -222,6 +236,39 @@ class MetricsHTTPServer:
             target=self._httpd.serve_forever, name="hvd-metrics-http",
             daemon=True,
         )
+
+    # -- pluggable views -------------------------------------------------
+    def add_view(self, name: str, fn: Callable[[], object]
+                 ) -> "MetricsHTTPServer":
+        """Serve `fn()` at `/<name>`. Reserved names (the metrics
+        renderers) are rejected; re-registering a name replaces the
+        previous provider (latest owner wins, like Gauge.set_function)."""
+        if not name or not all(c.isalnum() or c in "_-" for c in name):
+            raise ValueError(f"invalid view name {name!r}")
+        # "metrics.json" needs no reservation: dots already fail the
+        # charset check above.
+        if name == "metrics":
+            raise ValueError(f"view name {name!r} is reserved")
+        with self._views_lock:
+            self._views[name] = fn
+        return self
+
+    def remove_view(self, name: str, fn: Optional[Callable] = None):
+        """Detach a view — the teardown contract for owners going away.
+        Pass the provider you registered to detach only if you are still
+        the current owner (a replacement may have taken the name over);
+        None detaches unconditionally."""
+        with self._views_lock:
+            if fn is None or self._views.get(name) == fn:
+                self._views.pop(name, None)
+
+    def get_view(self, name: str) -> Optional[Callable[[], object]]:
+        with self._views_lock:
+            return self._views.get(name)
+
+    def view_names(self) -> list:
+        with self._views_lock:
+            return sorted(self._views)
 
     def start(self) -> "MetricsHTTPServer":
         self._thread.start()

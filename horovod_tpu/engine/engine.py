@@ -234,6 +234,18 @@ class Engine:
         # the process-default registry a scraper can sample mid-__init__
         # (elastic shutdown+init window), and a callback hitting a
         # not-yet-assigned attribute would report NaN instead of 0.
+        # Every pull callback this engine registers on the (possibly
+        # process-default) registry, remembered so shutdown can detach
+        # CONDITIONALLY: clear_function(fn) only detaches if this engine
+        # is still the current owner. An unconditional clear would
+        # freeze a REPLACEMENT engine's gauges whenever teardown of the
+        # old engine overlaps init of the new one (the bug
+        # HeartbeatMonitor.stop already fixed for the heartbeat-age
+        # gauges).
+        self._gauge_fns: Dict[str, object] = {
+            "horovod_tensor_queue_depth": self.tensor_queue_depth,
+            "horovod_last_cycle_age_seconds": self._last_cycle_age,
+        }
         self.registry.gauge(
             "horovod_tensor_queue_depth",
             "Tensors currently pending in the queue",
@@ -289,10 +301,12 @@ class Engine:
                 labels={"reason": reason})
             for reason in ("enqueue", "timeout", "spin", "shutdown")
         }
+        self._gauge_fns["horovod_inflight_responses"] = (
+            lambda: self._inflight)
         self.registry.gauge(
             "horovod_inflight_responses",
             "Responses dispatched to channel executors and not yet done",
-        ).set_function(lambda: self._inflight)
+        ).set_function(self._gauge_fns["horovod_inflight_responses"])
         self._op_counter: Dict[str, int] = {}
         self._counter_lock = threading.Lock()
         # Cycles that carried at least one negotiated response — the
@@ -395,6 +409,16 @@ class Engine:
         ckpt_mgr = _ckpt.current()
         if ckpt_mgr is not None:
             st["checkpoint"] = ckpt_mgr.status()
+        # Serving plane (docs/serving.md): role, rounds, weight step,
+        # eviction verdicts — live while serve() runs in this process,
+        # like `checkpoint` above. The replica set is process-global,
+        # not engine-owned: it survives the engine swap an eviction's
+        # subset re-mesh performs.
+        from ..serving import replicas as _serving
+
+        plane = _serving.current()
+        if plane is not None:
+            st["serving"] = plane.status()
         ctrl = self.controller
         if ctrl is not None and ctrl.is_coordinator:
             now = time.monotonic()
@@ -1220,7 +1244,11 @@ class Engine:
         # Detach the pull-gauges' bound methods: on the process-default
         # registry they would otherwise pin this dead Engine (fusion
         # buffers included) for process lifetime and report its frozen
-        # state as live after an elastic shutdown+init cycle.
-        self.registry.gauge("horovod_tensor_queue_depth").clear_function()
-        self.registry.gauge("horovod_last_cycle_age_seconds").clear_function()
-        self.registry.gauge("horovod_inflight_responses").clear_function()
+        # state as live after an elastic shutdown+init cycle. Passing
+        # OUR callbacks makes the detach conditional — a replacement
+        # engine that already re-registered keeps its live callbacks
+        # instead of having them silently cleared (the stale-gauge leak:
+        # the restarted owner re-registers, the dying one then wipes the
+        # registration, and the gauge reports NaN/0 forever).
+        for name, fn in self._gauge_fns.items():
+            self.registry.gauge(name).clear_function(fn)

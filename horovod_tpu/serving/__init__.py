@@ -1,0 +1,171 @@
+"""Serving plane: continuous-batching inference over the mesh
+(docs/serving.md).
+
+Every rank calls :func:`serve` after ``hvd.init()``. Rank 0 opens the
+HTTP front door (``HOROVOD_SERVING_PORT``), admits requests into a
+bounded queue (full → 429 backpressure), coalesces them with the
+event-driven continuous batcher, and drives the mesh in rounds over
+the engine's collectives; every rank runs the model forward on its
+slice of each batch. Weight hot-swap rides the durability plane
+(checkpoint manifests + the rendezvous KV); wedged replicas are
+evicted through the liveness plane's verdicts and traffic reroutes to
+the survivors.
+
+    def model_fn(weights, payloads):          # list in, list out
+        return [weights["w"] * p for p in payloads]
+
+    hvd.init()
+    report = hvd.serving.serve(model_fn, weights={"w": 2.0})
+
+Programmatic (no HTTP) use: build an `InferenceFrontend` with
+``port=None``... or just call `serve(..., max_requests=N)` and drive
+requests through `frontend.submit` from another thread.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..common import basics
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .batcher import (AdmissionQueue, ContinuousBatcher,  # noqa: F401
+                      InferenceRequest)
+from .frontend import InferenceFrontend  # noqa: F401
+from .replicas import (ReplicaSet, ServingCoordinator,  # noqa: F401
+                       current, failed_rank_from_error, follower_loop)
+from .weights import (BackgroundLoader, CheckpointWeightSource,  # noqa: F401
+                      StaticWeightSource, WeightSource)
+
+logger = get_logger()
+
+
+def _rendezvous_from_env():
+    """The launcher's rendezvous KV when configured — the same control
+    plane the durability plane publishes `ckpt/latest` on and the
+    liveness plane publishes verdicts on."""
+    addr = env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR, "")
+    port = env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0)
+    if not addr or port <= 0:
+        return None
+    from ..backend.rendezvous import RendezvousClient
+
+    return RendezvousClient(addr, port)
+
+
+def serve(model_fn: Callable, weights=None,
+          weight_source: Optional[WeightSource] = None,
+          frontend: Optional[InferenceFrontend] = None,
+          port: Optional[int] = None,
+          tick_seconds: float = 0.25,
+          max_requests: Optional[int] = None,
+          registry=None) -> dict:
+    """Run this rank as a serving replica until STOP; returns the
+    rank's final status dict (rounds, batches, verdicts, weight step).
+
+    SPMD: every rank of the initialized mesh must call this. `weights`
+    is whatever `model_fn` understands; `weight_source` defaults to a
+    `CheckpointWeightSource` over ``HOROVOD_CHECKPOINT_DIR`` when that
+    is set (hot-swap on), else static weights. `max_requests` stops the
+    plane after that many requests reached a terminal status (tests /
+    bounded smokes); production stops via ``POST /admin/stop``."""
+    from .replicas import _set_current
+
+    if not basics.is_initialized():
+        raise RuntimeError("hvd.init() must run before serving")
+    rendezvous = _rendezvous_from_env()
+    if weight_source is None:
+        ckpt_dir = env_cfg.checkpoint_dir()
+        if ckpt_dir:
+            weight_source = CheckpointWeightSource(ckpt_dir)
+    rs = ReplicaSet(model_fn, weights=weights,
+                    weight_source=weight_source, registry=registry)
+    _set_current(rs)
+    own_frontend = False
+    try:
+        if basics.rank() == 0:
+            if frontend is None:
+                own_frontend = True
+                frontend = InferenceFrontend(
+                    port=port, registry=rs.registry,
+                    status_fn=rs.status).start()
+            _register_view(rs, frontend)
+            if max_requests is not None:
+                _arm_request_cap(frontend, rs, max_requests)
+            coord = ServingCoordinator(
+                rs, frontend, tick_seconds=tick_seconds,
+                rendezvous=rendezvous,
+                # An eviction re-inits the engine (new exporters); the
+                # /serving view must follow it onto the new endpoint.
+                on_remesh=lambda: _register_view(rs, frontend))
+            report = coord.run()
+            report["port"] = frontend.port
+            return report
+        return follower_loop(rs)
+    finally:
+        _set_current(None)
+        _unregister_view()
+        if own_frontend and frontend is not None:
+            frontend.stop()
+
+
+def _register_view(rs: ReplicaSet, frontend: InferenceFrontend):
+    """Serve the serving status at `/serving` on the rank-0 metrics
+    endpoint via the extensible view registry (no constructor kwargs
+    through metrics_export). The engine's `/status` body embeds the
+    same snapshot under a `serving` key (engine/engine.py)."""
+    eng = basics.engine()
+    if eng is None:
+        return
+    from ..common.metrics_export import MetricsHTTPServer
+
+    def view():
+        st = rs.status()
+        st["frontend"] = frontend.basic_status()
+        return st
+
+    for exp in getattr(eng, "_exporters", []):
+        if isinstance(exp, MetricsHTTPServer):
+            exp.add_view("serving", view)
+
+
+def _unregister_view():
+    """Detach `/serving` when serve() exits — a stale view would pin
+    the dead replica set (staged weights included) for process lifetime
+    and keep answering with frozen state instead of 404."""
+    eng = basics.engine()
+    if eng is None:
+        return
+    from ..common.metrics_export import MetricsHTTPServer
+
+    for exp in getattr(eng, "_exporters", []):
+        if isinstance(exp, MetricsHTTPServer):
+            exp.remove_view("serving")
+
+
+def _arm_request_cap(frontend: InferenceFrontend, rs: ReplicaSet,
+                     max_requests: int):
+    """Stop the plane once `max_requests` requests reached a terminal
+    status — a bounded-run harness for tests and smokes. Polls the
+    status counters off-thread (cheap; the serving loop ticks anyway)."""
+    reg = rs.registry
+
+    def total() -> float:
+        n = 0.0
+        for m in reg.metrics():
+            if m.name == "horovod_serving_requests_total":
+                n += m.value
+        return n
+
+    base = total()
+
+    def watch():
+        while not frontend.stopping:
+            if total() - base >= max_requests:
+                frontend.request_stop()
+                return
+            time.sleep(0.05)
+
+    threading.Thread(target=watch, name="hvd-serving-cap",
+                     daemon=True).start()

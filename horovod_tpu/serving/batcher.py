@@ -1,0 +1,249 @@
+"""Continuous batcher: bounded admission + event-driven coalescing.
+
+The serving plane's answer to Orca-style iteration-level batching
+(docs/serving.md): many small concurrent requests coalesce into one
+mesh-wide dispatch. Two pieces:
+
+* `AdmissionQueue` — the bounded front-door queue. `offer()` either
+  admits a request or refuses it (the frontend turns a refusal into
+  HTTP 429 backpressure); admission wakes the batcher NOW via the
+  queue's condition, so an idle mesh dispatches a lone request with no
+  schedule-tick latency.
+* `ContinuousBatcher.next_batch()` — blocks for the first admissible
+  request, then coalesces until the batch holds
+  ``HOROVOD_SERVING_MAX_BATCH`` requests, the summed token budget
+  reaches ``HOROVOD_SERVING_MAX_BATCH_TOKENS``, or
+  ``HOROVOD_SERVING_MAX_DELAY_MS`` elapses — whichever comes FIRST.
+  Like ``HOROVOD_CYCLE_TIME`` after PR 4, the delay is a max-coalescing
+  bound, never a latency floor: a full batch dispatches immediately and
+  new arrivals wake the wait instead of being found by polling.
+
+Deadline-expired requests are dropped at dequeue time, BEFORE dispatch:
+they are completed with status ``deadline`` (the frontend answers 504)
+and counted in ``horovod_serving_requests_total{status="deadline"}``,
+and never consume replica forward capacity.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from ..common import telemetry
+
+# Request terminal statuses (also the `status` label values of
+# horovod_serving_requests_total, plus "rejected" which never makes a
+# Request object).
+STATUS_OK = "ok"
+STATUS_DEADLINE = "deadline"
+STATUS_ERROR = "error"
+STATUS_SHUTDOWN = "shutdown"
+
+_req_ids = itertools.count(1)
+
+
+class InferenceRequest:
+    """One admitted request: payload + token estimate + deadline + the
+    future the HTTP handler thread parks on."""
+
+    __slots__ = ("id", "payload", "tokens", "enqueued", "deadline",
+                 "result", "status", "error", "dispatched", "_done")
+
+    def __init__(self, payload, tokens: int = 1,
+                 timeout_s: float = 30.0):
+        self.id = next(_req_ids)
+        self.payload = payload
+        self.tokens = max(int(tokens), 1)
+        self.enqueued = time.monotonic()
+        self.deadline = self.enqueued + max(timeout_s, 0.001)
+        self.result = None
+        self.status: Optional[str] = None
+        self.error: Optional[str] = None
+        # Set by the batcher the moment the request joins a batch: the
+        # frontend's deadline handling differs for queued (504 NOW)
+        # vs in-flight (grace for the reply) requests.
+        self.dispatched = False
+        self._done = threading.Event()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (now if now is not None else time.monotonic()) >= self.deadline
+
+    def complete(self, result, status: str = STATUS_OK,
+                 error: Optional[str] = None) -> bool:
+        """First completion wins (a deadline drop racing a late reply
+        must not flip an already-answered request). Returns whether
+        THIS call settled the request — callers count terminal statuses
+        only on a True return, so racing completers never double-count
+        one request."""
+        if self._done.is_set():
+            return False
+        self.result = result
+        self.status = status
+        self.error = error
+        self._done.set()
+        return True
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class AdmissionQueue:
+    """Bounded FIFO with wake-on-enqueue. `offer` never blocks — a full
+    queue is the backpressure signal, not a parking lot."""
+
+    def __init__(self, maxsize: int,
+                 registry: Optional[telemetry.MetricsRegistry] = None):
+        self.maxsize = max(int(maxsize), 1)
+        self._q: deque = deque()
+        self.cond = threading.Condition()
+        registry = registry or telemetry.default_registry()
+        self._depth_fn = self.depth
+        registry.gauge(
+            "horovod_serving_queue_depth",
+            "Admitted inference requests waiting for dispatch",
+        ).set_function(self._depth_fn)
+        self._registry = registry
+
+    def close(self):
+        self._registry.gauge(
+            "horovod_serving_queue_depth").clear_function(self._depth_fn)
+
+    def depth(self) -> int:
+        return len(self._q)
+
+    def offer(self, req: InferenceRequest) -> bool:
+        with self.cond:
+            if len(self._q) >= self.maxsize:
+                return False
+            self._q.append(req)
+            self.cond.notify_all()
+        return True
+
+    def requeue_front(self, reqs: List[InferenceRequest]):
+        """Put a failed dispatch's requests back at the HEAD (oldest
+        first), past the maxsize bound — rerouted work was already
+        admitted once and must not be 429'd by its own retry."""
+        with self.cond:
+            for r in reversed(reqs):
+                self._q.appendleft(r)
+            self.cond.notify_all()
+
+    def _pop_locked(self) -> Optional[InferenceRequest]:
+        return self._q.popleft() if self._q else None
+
+    def _peek_locked(self) -> Optional[InferenceRequest]:
+        return self._q[0] if self._q else None
+
+
+class ContinuousBatcher:
+    """Event-driven coalescing over an AdmissionQueue (module doc)."""
+
+    def __init__(self, queue: AdmissionQueue, max_batch: int,
+                 max_tokens: int, max_delay_s: float,
+                 registry: Optional[telemetry.MetricsRegistry] = None):
+        self.queue = queue
+        self.max_batch = max(int(max_batch), 1)
+        self.max_tokens = max(int(max_tokens), 1)
+        self.max_delay_s = max(float(max_delay_s), 0.0)
+        registry = registry or telemetry.default_registry()
+        self._m_requests = {
+            status: registry.counter(
+                "horovod_serving_requests_total",
+                "Inference requests by terminal status",
+                labels={"status": status})
+            for status in (STATUS_OK, STATUS_DEADLINE, STATUS_ERROR,
+                           STATUS_SHUTDOWN, "rejected")
+        }
+        self._m_batch_size = registry.histogram(
+            "horovod_serving_batch_size",
+            "Requests per dispatched batch", min_exp=0, max_exp=12)
+        self._m_batch_tokens = registry.histogram(
+            "horovod_serving_batch_tokens",
+            "Summed request tokens per dispatched batch",
+            min_exp=0, max_exp=24)
+        self._m_coalesce = registry.histogram(
+            "horovod_serving_coalesce_seconds",
+            "Time next_batch spent coalescing after the first request")
+
+    def count(self, status: str, n: int = 1):
+        self._m_requests[status].inc(n)
+
+    def _drop_expired_head(self, now: float) -> int:
+        """Drop expired requests from the queue head (under the queue
+        lock). Only the head needs checking each pass — FIFO admission
+        means deadlines are (approximately) ordered; stragglers deeper
+        in the queue get caught when they surface."""
+        dropped = []
+        while True:
+            head = self.queue._peek_locked()
+            if head is None or not head.expired(now):
+                break
+            dropped.append(self.queue._pop_locked())
+        for r in dropped:
+            if r.complete(None, STATUS_DEADLINE,
+                          "deadline expired before dispatch"):
+                self._m_requests[STATUS_DEADLINE].inc()
+        return len(dropped)
+
+    def next_batch(self, wait_timeout: float
+                   ) -> Optional[List[InferenceRequest]]:
+        """Return the next batch, or None after `wait_timeout` seconds
+        with nothing admissible. Never returns an empty list."""
+        cond = self.queue.cond
+        batch: List[InferenceRequest] = []
+        tokens = 0
+        with cond:
+            # Phase 1: wait for the first admissible request.
+            arm_deadline = time.monotonic() + max(wait_timeout, 0.0)
+            while True:
+                now = time.monotonic()
+                self._drop_expired_head(now)
+                head = self.queue._peek_locked()
+                if head is not None:
+                    break
+                remaining = arm_deadline - now
+                if remaining <= 0:
+                    return None
+                cond.wait(remaining)
+            # Phase 2: coalesce. The window opens at the first TAKE, so
+            # a request that waited in the queue behind a slow dispatch
+            # is not double-charged its queue dwell.
+            t0 = time.monotonic()
+            close = t0 + self.max_delay_s
+            while True:
+                now = time.monotonic()
+                self._drop_expired_head(now)
+                head = self.queue._peek_locked()
+                if head is not None:
+                    would = tokens + head.tokens
+                    if batch and would > self.max_tokens:
+                        break  # token budget: leave it for the next batch
+                    taken = self.queue._pop_locked()
+                    taken.dispatched = True
+                    batch.append(taken)
+                    tokens += head.tokens
+                    if (len(batch) >= self.max_batch
+                            or tokens >= self.max_tokens):
+                        break  # size/token cap: dispatch NOW
+                    continue
+                if not batch:
+                    # Everything we held expired mid-coalesce; re-arm.
+                    remaining = arm_deadline - now
+                    if remaining <= 0:
+                        return None
+                    cond.wait(remaining)
+                    continue
+                remaining = close - now
+                if remaining <= 0:
+                    break  # max delay: dispatch what we have
+                cond.wait(remaining)
+        self._m_coalesce.observe(time.monotonic() - t0)
+        self._m_batch_size.observe(len(batch))
+        self._m_batch_tokens.observe(tokens)
+        return batch

@@ -1,0 +1,290 @@
+"""HTTP front door for the serving plane (rank 0 / standalone).
+
+Reuses the `metrics_export` server plumbing — the same daemon-thread
+``ThreadingHTTPServer`` shape, one handler thread per in-flight client
+connection — so the front door costs nothing new architecturally. The
+endpoint is unauthenticated and binds loopback by default
+(``HOROVOD_SERVING_ADDR``), the `HOROVOD_METRICS_ADDR` posture.
+
+Routes:
+
+* ``POST /v1/infer`` — body ``{"inputs": <json>, "tokens": <int>?,
+  "timeout_s": <float>?}`` (or any bare JSON document, taken as the
+  inputs). Admission: a full queue answers **429** with ``Retry-After``
+  (backpressure — the queue bound is ``HOROVOD_SERVING_QUEUE_DEPTH``);
+  an admitted request parks the handler thread on the request future
+  and answers **200** ``{"output": ..., "weight_step": ...}``, **504**
+  when the per-request deadline expired (before OR after dispatch), or
+  **500**/**503** on replica error / shutdown.
+* ``GET /healthz`` — liveness + the serving status snapshot.
+* ``POST /admin/stop`` — graceful stop (drain admitted work, then the
+  coordinator broadcasts STOP to every replica). Loopback-guarded by
+  the default bind address like everything else here.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..common import telemetry
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .batcher import (
+    STATUS_DEADLINE, STATUS_ERROR, STATUS_OK, STATUS_SHUTDOWN,
+    AdmissionQueue, ContinuousBatcher, InferenceRequest,
+)
+
+logger = get_logger()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hvd-serving"
+    # Keep-alive lets a looping client reuse its connection (and its
+    # handler thread) across requests.
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code: int, doc: dict, extra_headers=()):
+        payload = json.dumps(doc).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        fe: "InferenceFrontend" = self.server.owner  # type: ignore[attr-defined]
+        try:
+            if self.path.startswith("/healthz"):
+                self._send(200, fe.status())
+            else:
+                self._send(404, {"error": "try POST /v1/infer, "
+                                 "GET /healthz, POST /admin/stop"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        fe: "InferenceFrontend" = self.server.owner  # type: ignore[attr-defined]
+        try:
+            if self.path.startswith("/admin/stop"):
+                fe.request_stop()
+                self._send(200, {"stopping": True})
+                return
+            if not self.path.startswith("/v1/infer"):
+                self._send(404, {"error": "try POST /v1/infer"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                doc = json.loads(self.rfile.read(n) or b"null")
+            except (ValueError, OSError) as e:
+                self._send(400, {"error": f"bad request body: {e}"})
+                return
+            code, out = fe.infer(doc)
+            hdrs = (("Retry-After", "1"),) if code == 429 else ()
+            self._send(code, out, hdrs)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up; the request future just gets dropped
+        except Exception as e:  # a broken provider must not kill the server
+            try:
+                self._send(500, {"error": str(e)})
+            except OSError:  # pragma: no cover - peer gone during the 500
+                pass
+
+    def log_message(self, fmt, *args):
+        logger.debug("serving http: " + fmt, *args)
+
+
+class InferenceFrontend:
+    """Admission + HTTP surface. Owns the bounded queue and the
+    batcher; the replica coordinator (serving/replicas.py) pulls batches
+    out of it and completes the request futures."""
+
+    def __init__(self, port: Optional[int] = None,
+                 addr: Optional[str] = None,
+                 registry: Optional[telemetry.MetricsRegistry] = None,
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 stop_fn: Optional[Callable[[], None]] = None):
+        self.registry = registry or telemetry.default_registry()
+        self.queue = AdmissionQueue(env_cfg.serving_queue_depth(),
+                                    registry=self.registry)
+        self.batcher = ContinuousBatcher(
+            self.queue,
+            max_batch=env_cfg.serving_max_batch(),
+            max_tokens=env_cfg.serving_max_batch_tokens(),
+            max_delay_s=env_cfg.serving_max_delay_ms() / 1000.0,
+            registry=self.registry)
+        self.default_timeout = env_cfg.serving_request_timeout()
+        self._status_fn = status_fn
+        self._stop_fn = stop_fn
+        self._stopping = threading.Event()
+        self._m_latency = self.registry.histogram(
+            "horovod_serving_request_seconds",
+            "End-to-end request latency, admission to reply")
+        # Admitted-and-not-yet-answered, derived from the request
+        # futures themselves (pruned on read): the programmatic
+        # `submit()` path has no infer() handler to pair a decrement
+        # with, so a counter would only ever go up.
+        self._open: dict = {}
+        self._inflight_lock = threading.Lock()
+        self._inflight_fn = self._inflight_count
+        self.registry.gauge(
+            "horovod_serving_inflight_requests",
+            "Admitted requests not yet answered",
+        ).set_function(self._inflight_fn)
+        self._httpd = None
+        self._thread = None
+        self.port = None
+        if port is None:
+            port = env_cfg.serving_port()
+        if port >= 0:
+            self._httpd = ThreadingHTTPServer(
+                (addr if addr is not None else env_cfg.serving_addr(),
+                 port), _Handler)
+            self._httpd.daemon_threads = True
+            self._httpd.owner = self  # type: ignore[attr-defined]
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="hvd-serving-http",
+                daemon=True)
+
+    def start(self) -> "InferenceFrontend":
+        if self._thread is not None:
+            self._thread.start()
+            logger.info("serving front door on :%d (/v1/infer)", self.port)
+        return self
+
+    def stop(self):
+        self._stopping.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+            self._httpd = None
+            self._thread = None
+        self.queue.close()
+        self.registry.gauge(
+            "horovod_serving_inflight_requests",
+        ).clear_function(self._inflight_fn)
+
+    # -- admission -------------------------------------------------------
+    def request_stop(self):
+        self._stopping.set()
+        if self._stop_fn is not None:
+            self._stop_fn()
+
+    @property
+    def stopping(self) -> bool:
+        return self._stopping.is_set()
+
+    def submit(self, inputs, tokens: int = 1,
+               timeout_s: Optional[float] = None
+               ) -> Optional[InferenceRequest]:
+        """Programmatic admission (the HTTP route and tests both land
+        here). None = rejected (queue full or stopping)."""
+        if self._stopping.is_set():
+            return None
+        # A client may lower its deadline below the server default,
+        # never raise it past it (the server bound is the operator's
+        # overload guarantee).
+        t = self.default_timeout if timeout_s is None else min(
+            max(float(timeout_s), 0.001), self.default_timeout)
+        req = InferenceRequest(inputs, tokens=tokens, timeout_s=t)
+        if not self.queue.offer(req):
+            self.batcher.count("rejected")
+            return None
+        with self._inflight_lock:
+            self._open[req.id] = req
+        self._trace_admit(req)
+        return req
+
+    def _trace_admit(self, req: InferenceRequest):
+        """`serve.admit` instant in the flight recorder — pairs with
+        the coordinator's serve.batch/forward/reply spans so one trace
+        shows a request's whole life (docs/serving.md)."""
+        try:
+            from ..common import basics
+
+            eng = basics.engine() if basics.is_initialized() else None
+            if eng is not None:
+                eng.tracer.instant("serve.admit", cat="serve",
+                                   args={"req": req.id,
+                                         "tokens": req.tokens})
+        except Exception:  # tracing must never fail admission
+            pass
+
+    def _inflight_count(self) -> int:
+        with self._inflight_lock:
+            done = [rid for rid, r in self._open.items() if r.done]
+            for rid in done:
+                del self._open[rid]
+            return len(self._open)
+
+    def infer(self, doc) -> "tuple[int, dict]":
+        """Blocking request → (http_code, body). Runs on the handler
+        thread; parks on the request future until completion or
+        deadline."""
+        if isinstance(doc, dict) and ("inputs" in doc or "tokens" in doc
+                                      or "timeout_s" in doc):
+            inputs = doc.get("inputs")
+            tokens = doc.get("tokens", 1)
+            timeout_s = doc.get("timeout_s")
+        else:
+            inputs, tokens, timeout_s = doc, 1, None
+        if self._stopping.is_set():
+            return 503, {"error": "serving is stopping"}
+        req = self.submit(inputs, tokens=tokens, timeout_s=timeout_s)
+        if req is None:
+            if self._stopping.is_set():
+                return 503, {"error": "serving is stopping"}
+            return 429, {"error": "admission queue full; retry"}
+        # Park until the deadline. A request STILL QUEUED at its
+        # deadline is answered 504 right here (first-completion-wins
+        # settles the race with a batcher take at the same instant);
+        # one already dispatched gets a grace window for the in-flight
+        # reply. The last-resort error completion only fires if the
+        # serving loop itself died.
+        req.wait(max(req.deadline - time.monotonic(), 0))
+        if not req.done and not req.dispatched:
+            if req.complete(None, STATUS_DEADLINE,
+                            "deadline expired before dispatch"):
+                self.batcher.count(STATUS_DEADLINE)
+        elif not req.done and not req.wait(5.0):
+            if req.complete(None, STATUS_ERROR, "serving loop stalled"):
+                self.batcher.count(STATUS_ERROR)
+        self._m_latency.observe(time.monotonic() - req.enqueued)
+        if req.status == STATUS_OK:
+            # The coordinator completes OK requests with
+            # {"output", "weight_step"} so clients can prove which
+            # weights answered them (the hot-swap acceptance check).
+            body = req.result if isinstance(req.result, dict) else {
+                "output": req.result}
+            return 200, body
+        if req.status == STATUS_DEADLINE:
+            return 504, {"error": req.error or "deadline expired"}
+        if req.status == STATUS_SHUTDOWN:
+            return 503, {"error": req.error or "serving stopped"}
+        return 500, {"error": req.error or "replica error"}
+
+    # -- introspection ---------------------------------------------------
+    def basic_status(self) -> dict:
+        """The frontend's OWN state (the /serving view embeds this
+        next to the replica-set state without duplicating it)."""
+        return {
+            "queue_depth": self.queue.depth(),
+            "inflight": self._inflight_count(),
+            "stopping": self._stopping.is_set(),
+            "port": self.port,
+        }
+
+    def status(self) -> dict:
+        st = self.basic_status()
+        if self._status_fn is not None:
+            try:
+                st.update(self._status_fn())
+            except Exception:  # pragma: no cover - status best-effort
+                pass
+        return st

@@ -1,0 +1,227 @@
+"""Weight sources: where serving replicas get (and refresh) weights.
+
+The hot-swap protocol (docs/serving.md) rides the durability plane: a
+live or restarted training job two-phase-commits checkpoint manifests
+(common/checkpoint.py). The serving coordinator polls a
+`WeightSource` every
+``HOROVOD_SERVING_WEIGHT_REFRESH_SECONDS``; when a newer step appears
+it broadcasts PREPARE (every replica loads shards in the background,
+traffic uninterrupted), and once every replica reports the staged step
+it broadcasts COMMIT — the flip happens between batches, so no request
+is ever dropped or answered by a half-swapped replica.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..common import checkpoint as ckpt
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+
+class WeightSource:
+    """Interface: `poll()` names the newest available weight version
+    (an int step, or None); `load(step)` materializes that version's
+    weights on the calling rank. `load` runs on a background thread and
+    may take arbitrarily long; `poll` runs on the coordinator's serving
+    loop and must be cheap (a listdir / KV get, not a read)."""
+
+    def poll(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def load(self, step: int):
+        raise NotImplementedError
+
+
+class StaticWeightSource(WeightSource):
+    """No refresh: serve the weights the caller handed in forever."""
+
+    def poll(self) -> Optional[int]:
+        return None
+
+    def load(self, step: int):  # pragma: no cover - never polled
+        raise RuntimeError("static weights cannot be reloaded")
+
+
+class CheckpointWeightSource(WeightSource):
+    """Watch a checkpoint directory (the durability plane's layout) and
+    load complete manifests. `to_weights(step, objects, trees)` converts
+    the reassembled checkpoint state into whatever the model_fn expects;
+    the default hands back the `(objects, trees)` pair unchanged.
+
+    The poll goes to DISK every time (a listdir + one manifest read at
+    the refresh cadence — cheap). The `ckpt/latest` KV row the
+    durability plane also publishes is deliberately NOT used as a
+    skip-the-listdir fast path: that publish is best-effort (a commit
+    whose KV put failed is still a committed checkpoint), so an
+    unchanged row must never suppress discovery of a newer on-disk
+    manifest — and a KV step with no complete manifest behind it is
+    unloadable anyway. Disk is the truth; only disk is consulted."""
+
+    def __init__(self, directory: str,
+                 to_weights: Optional[Callable] = None):
+        self.directory = directory
+        self.to_weights = to_weights
+
+    def poll(self) -> Optional[int]:
+        found = ckpt.find_latest_manifest(self.directory)
+        return None if found is None else found[0]
+
+    def load(self, step: int):
+        man = ckpt.load_manifest(ckpt.manifest_path(self.directory, step))
+        if man is None:
+            raise FileNotFoundError(
+                f"checkpoint manifest for step {step} disappeared "
+                f"(GC'd under the watcher?)")
+        objects, trees = ckpt.load_checkpoint_arrays(self.directory, man)
+        if self.to_weights is not None:
+            return self.to_weights(step, objects, trees)
+        return objects, trees
+
+
+def publish_weights(directory: str, step: int, trees: dict,
+                    objects: Optional[dict] = None,
+                    rendezvous=None) -> str:
+    """Publish a weight version into a checkpoint directory WITHOUT a
+    training job: one complete single-shard checkpoint in the
+    durability plane's exact layout (shard pickle + CRC + manifest,
+    atomic renames), optionally announcing it on the KV like a real
+    commit. This is the standalone-serving publish path — and what the
+    serving tests/smokes use to stage a hot-swap. `trees` maps attr
+    name → list of leaves, mirroring `load_checkpoint_arrays`."""
+    import json
+    import os
+    import pickle
+    import zlib
+
+    from ..utils import atomic_file
+
+    attrs = sorted(trees)
+    leaves = [leaf for a in attrs for leaf in trees[a]]
+    doc = {
+        "format": ckpt.FORMAT_VERSION,
+        "step": step,
+        "rank": 0,
+        "world_size": 1,
+        "leaf_range": (0, len(leaves)),
+        "leaves": leaves,
+        "objects": objects or {},
+        "attrs": attrs,
+        "attr_counts": {a: len(trees[a]) for a in attrs},
+    }
+    payload = pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL)
+    rel = ckpt.shard_file(step, 0)
+    atomic_file.atomic_write_bytes(
+        os.path.join(directory, rel), payload, fsync=False)
+    manifest = {
+        "format": ckpt.FORMAT_VERSION,
+        "step": step,
+        "time": time.time(),
+        "world_size": 1,
+        "num_leaves": len(leaves),
+        "attrs": attrs,
+        "attr_counts": {a: len(trees[a]) for a in attrs},
+        "objects_shard": 0,
+        "shards": [{"rank": 0, "file": rel, "leaves": [0, len(leaves)],
+                    "bytes": len(payload),
+                    "crc32": zlib.crc32(payload)}],
+    }
+    path = ckpt.manifest_path(directory, step)
+    atomic_file.atomic_write_text(
+        path, json.dumps(manifest, indent=1, sort_keys=True), fsync=False)
+    if rendezvous is not None:
+        try:
+            rendezvous.put(ckpt.LATEST_SCOPE, ckpt.LATEST_KEY,
+                           json.dumps({"step": step,
+                                       "world_size": 1}).encode())
+        except Exception:  # the KV row is advisory, disk is the truth
+            pass
+    return path
+
+
+class BackgroundLoader:
+    """Per-rank staged load: PREPARE starts a daemon thread loading one
+    step; `staged()` names what is ready to flip. A newer PREPARE
+    supersedes an in-flight load (its result is discarded on arrival if
+    a newer target was set) — the coordinator only commits a step every
+    rank reports staged."""
+
+    def __init__(self, source: WeightSource):
+        self.source = source
+        self._lock = threading.Lock()
+        self._target: Optional[int] = None
+        self._staged_step: Optional[int] = None
+        self._staged_weights = None
+        self._error: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def prepare(self, step: int):
+        with self._lock:
+            if self._target == step or self._staged_step == step:
+                return  # already loading / loaded
+            self._target = step
+            if self._thread is not None and self._thread.is_alive():
+                return  # the running loader re-checks the target when done
+            self._thread = threading.Thread(
+                target=self._load_loop, name="hvd-serving-loader",
+                daemon=True)
+            self._thread.start()
+
+    def _load_loop(self):
+        while True:
+            with self._lock:
+                step = self._target
+                if step is None or step == self._staged_step:
+                    return
+            try:
+                weights = self.source.load(step)
+                err = None
+            except Exception as e:
+                weights, err = None, str(e)
+                logger.warning("serving weight load for step %d failed: %s",
+                               step, e)
+            with self._lock:
+                if err is not None:
+                    self._error = err
+                    if self._target == step:
+                        self._target = None  # a re-poll may retry later
+                        return
+                    continue  # a newer target arrived; load that instead
+                self._error = None
+                self._staged_step = step
+                self._staged_weights = weights
+                if self._target == step:
+                    return
+                # else: a newer PREPARE landed mid-load; go again.
+
+    def staged(self) -> Optional[int]:
+        with self._lock:
+            return self._staged_step
+
+    def error(self) -> Optional[str]:
+        with self._lock:
+            return self._error
+
+    def take(self, step: int):
+        """Flip: hand back the staged weights for `step` (COMMIT). The
+        coordinator guarantees every rank reported this step staged, so
+        a miss here is a protocol bug, not a race."""
+        with self._lock:
+            if self._staged_step != step:
+                raise RuntimeError(
+                    f"commit for step {step} but staged is "
+                    f"{self._staged_step}")
+            w = self._staged_weights
+            self._staged_weights = None
+            return w
+
+    def retry_poll(self, step: int):
+        """Re-arm a failed load (poll noticed the step is still newest
+        but no load is in flight)."""
+        with self._lock:
+            failed = self._error is not None and self._target is None
+        if failed:
+            self.prepare(step)

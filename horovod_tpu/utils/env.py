@@ -70,13 +70,18 @@ CONNECT_BACKOFF_CAP = "HOROVOD_CONNECT_BACKOFF_CAP_SECONDS"
 
 # -- transport selection knobs (docs/running.md "Transports") ----------
 # Which data-plane transport moves collective payloads between ranks:
-#   tcp  (default) — every byte rides the TCP mesh sockets, co-located
-#          ranks included (loopback through the kernel).
+#   auto (default) — shm where peers are co-located, tcp otherwise:
+#          co-located ranks engage the shared-memory overlay
+#          automatically, remote peers stay on TCP. (Flipped from tcp
+#          after the shm plane soaked in CI; pin tcp to reproduce the
+#          old behavior or to assert tcp-only byte accounting.)
+#   tcp  — every byte rides the TCP mesh sockets, co-located ranks
+#          included (loopback through the kernel).
 #   shm  — co-located ranks (same host, agreed via the rendezvous KV
 #          locality rows) exchange data-channel frames over mmap'd
-#          shared-memory ring buffers; remote peers stay on TCP.
-#   auto — like shm where peers are co-located, tcp otherwise (the
-#          recommended setting; it is what `shm` degrades to anyway).
+#          shared-memory ring buffers; remote peers stay on TCP —
+#          operationally identical to auto (shm degrades to tcp for
+#          remote pairs anyway); spells out the intent.
 # Control-plane and heartbeat frames ALWAYS ride the TCP mesh — the
 # socket FIN/RST is what makes dead-peer detection bounded, and a
 # wedged peer's shm ring going quiet is attributed by the same
@@ -214,6 +219,46 @@ DEFAULT_CHECKPOINT_INTERVAL_STEPS = 10
 DEFAULT_CHECKPOINT_KEEP = 3
 DEFAULT_CHECKPOINT_COMMIT_TIMEOUT = 120.0
 
+# -- serving plane knobs (docs/serving.md) -----------------------------
+# Port of the rank-0 HTTP front door (POST /v1/infer). Empty/unset =
+# the serving plane never opens a socket; 0 = ephemeral port (tests
+# read it back from the frontend object).
+SERVING_PORT = "HOROVOD_SERVING_PORT"
+# Bind address of the front door. Loopback by default for the same
+# reason as HOROVOD_METRICS_ADDR: the endpoint is unauthenticated, so
+# network exposure is the explicit opt-in.
+SERVING_ADDR = "HOROVOD_SERVING_ADDR"
+# Continuous-batching caps: a dispatch closes when it holds MAX_BATCH
+# requests, when the summed per-request token budget reaches
+# MAX_BATCH_TOKENS, or when the oldest admitted request has waited
+# MAX_DELAY_MS — whichever comes first. Like HOROVOD_CYCLE_TIME the
+# delay is a max-coalescing bound, never a latency floor: the batcher
+# wakes on enqueue and a full batch dispatches immediately.
+SERVING_MAX_BATCH = "HOROVOD_SERVING_MAX_BATCH"
+SERVING_MAX_BATCH_TOKENS = "HOROVOD_SERVING_MAX_BATCH_TOKENS"
+SERVING_MAX_DELAY_MS = "HOROVOD_SERVING_MAX_DELAY_MS"
+# Bounded admission queue: requests arriving while QUEUE_DEPTH are
+# already admitted are rejected with HTTP 429 (backpressure — the
+# client retries; an unbounded queue just converts overload into
+# timeouts for everyone).
+SERVING_QUEUE_DEPTH = "HOROVOD_SERVING_QUEUE_DEPTH"
+# Per-request deadline: admitted requests still undispatched past it
+# are dropped BEFORE dispatch (counted, never forwarded) and the
+# client gets 504; a client may lower (never raise) it per request.
+SERVING_REQUEST_TIMEOUT = "HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS"
+# How often the serving coordinator polls the checkpoint manifest
+# store (HOROVOD_CHECKPOINT_DIR; disk is the truth — the KV
+# `ckpt/latest` row is best-effort and never gates discovery) for
+# newly-committed weights to hot-swap. 0 disables the watch.
+SERVING_WEIGHT_REFRESH = "HOROVOD_SERVING_WEIGHT_REFRESH_SECONDS"
+
+DEFAULT_SERVING_MAX_BATCH = 32
+DEFAULT_SERVING_MAX_BATCH_TOKENS = 16384
+DEFAULT_SERVING_MAX_DELAY_MS = 5.0
+DEFAULT_SERVING_QUEUE_DEPTH = 256
+DEFAULT_SERVING_REQUEST_TIMEOUT = 30.0
+DEFAULT_SERVING_WEIGHT_REFRESH = 10.0
+
 # -- telemetry knobs (docs/metrics.md) ---------------------------------
 # Serve Prometheus text at /metrics and live job state at /status from a
 # daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
@@ -300,11 +345,13 @@ def tcp_timeout_seconds() -> float:
 
 def transport_mode() -> str:
     """HOROVOD_TRANSPORT, normalized to tcp|shm|auto (unknown values
-    fall back to tcp — never crash the data plane over a typo; the
-    value is logged at establishment). Read per call so paired
-    benchmarks can flip the ROUTE between barrier-separated rounds."""
-    v = get_str(TRANSPORT, "tcp").lower()
-    return v if v in ("tcp", "shm", "auto") else "tcp"
+    fall back to the default — never crash the data plane over a typo;
+    the value is logged at establishment). Default `auto`: co-located
+    ranks ride the shm overlay, remote peers ride tcp. Read per call so
+    paired benchmarks can flip the ROUTE between barrier-separated
+    rounds."""
+    v = get_str(TRANSPORT, "auto").lower()
+    return v if v in ("tcp", "shm", "auto") else "auto"
 
 
 def shm_ring_bytes() -> int:
@@ -462,6 +509,51 @@ def hierarchical_mode() -> str:
     HIERARCHICAL_MODE above). Read per call like the ring knobs."""
     v = get_str(HIERARCHICAL_MODE, "auto").lower()
     return v if v in ("slice", "leader", "auto") else "auto"
+
+
+def serving_port() -> int:
+    """Front-door port; -1 = serving HTTP disabled (the round loop can
+    still be driven programmatically), 0 = ephemeral."""
+    return get_int(SERVING_PORT, -1)
+
+
+def serving_addr() -> str:
+    return get_str(SERVING_ADDR, "127.0.0.1")
+
+
+def serving_max_batch() -> int:
+    """Requests per dispatch; always >= 1."""
+    return max(get_int(SERVING_MAX_BATCH, DEFAULT_SERVING_MAX_BATCH), 1)
+
+
+def serving_max_batch_tokens() -> int:
+    """Summed token budget per dispatch; always >= 1."""
+    return max(get_int(SERVING_MAX_BATCH_TOKENS,
+                       DEFAULT_SERVING_MAX_BATCH_TOKENS), 1)
+
+
+def serving_max_delay_ms() -> float:
+    """Max coalescing delay (never a latency floor); floor 0 = dispatch
+    every admitted request immediately."""
+    return max(get_float(SERVING_MAX_DELAY_MS,
+                         DEFAULT_SERVING_MAX_DELAY_MS), 0.0)
+
+
+def serving_queue_depth() -> int:
+    """Admission-queue bound (429 past it); always >= 1."""
+    return max(get_int(SERVING_QUEUE_DEPTH, DEFAULT_SERVING_QUEUE_DEPTH), 1)
+
+
+def serving_request_timeout() -> float:
+    """Default per-request deadline in seconds; always > 0."""
+    return max(get_float(SERVING_REQUEST_TIMEOUT,
+                         DEFAULT_SERVING_REQUEST_TIMEOUT), 0.001)
+
+
+def serving_weight_refresh_seconds() -> float:
+    """Manifest-watch poll cadence; 0 disables weight hot-swap."""
+    return max(get_float(SERVING_WEIGHT_REFRESH,
+                         DEFAULT_SERVING_WEIGHT_REFRESH), 0.0)
 
 
 def metrics_sync_seconds() -> float:

@@ -25,11 +25,18 @@ included), then a fresh job over the same checkpoint dir must resume
 at the last committed checkpoint with bitwise state parity. Delegates
 to ``checkpoint_smoke``'s two-phase harness.
 
+``--serving`` mode — the serving plane's wedge scenario
+(docs/serving.md): a 4-rank continuous-batching serving mesh under
+concurrent HTTP load has one replica wedged mid-traffic; the liveness
+verdict evicts it, survivors re-mesh and every accepted request still
+completes. Delegates to ``serving_smoke``'s harness (its phase 3).
+
     python scripts/chaos_smoke.py                 # 4 workers, kill rank 2 at step 3
     python scripts/chaos_smoke.py --np 8 --kill-rank 5 --kill-step 10
     python scripts/chaos_smoke.py --wedge         # wedge rank 2 instead
     python scripts/chaos_smoke.py --wedge --hb-interval 0.5 --hb-miss 4
     python scripts/chaos_smoke.py --killall --kill-step 7
+    python scripts/chaos_smoke.py --serving       # wedge a serving replica
 """
 from __future__ import annotations
 
@@ -107,6 +114,11 @@ def main() -> int:
                          "loss) and assert a restarted job resumes "
                          "from the last committed durable checkpoint "
                          "with bitwise parity")
+    ap.add_argument("--serving", action="store_true",
+                    help="wedge one replica of a 4-rank serving mesh "
+                         "under concurrent HTTP load; the verdict "
+                         "evicts it and every accepted request still "
+                         "completes (docs/serving.md)")
     ap.add_argument("--interval", type=int, default=2,
                     help="HOROVOD_CHECKPOINT_INTERVAL_STEPS "
                          "(killall mode)")
@@ -121,6 +133,8 @@ def main() -> int:
 
     if args.killall:
         return run_killall(args)
+    if args.serving:
+        return run_serving(args)
 
     from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
     from horovod_tpu.runner.launch import slot_env
@@ -196,6 +210,20 @@ def run_killall(args) -> int:
               "kill", flush=True)
         return 2
     return checkpoint_smoke.run_killall(args)
+
+
+def run_serving(args) -> int:
+    """Serving-plane chaos: delegate to serving_smoke's harness with
+    the same wedge knobs this script uses (docs/serving.md)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serving_smoke
+
+    sys.argv = ["serving_smoke",
+                "--np", str(args.np_),
+                "--wedge-rank", str(args.kill_rank),
+                "--hb-interval", str(args.hb_interval),
+                "--hb-miss", str(args.hb_miss)]
+    return serving_smoke.main()
 
 
 def run_kill(args, procs) -> int:

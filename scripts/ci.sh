@@ -32,6 +32,9 @@ python scripts/checkpoint_smoke.py
 echo "=== checkpoint overhead smoke (background write <5% of step time) ==="
 python scripts/checkpoint_smoke.py --overhead
 
+echo "=== serving smoke (4-rank continuous batching: p50/p99 under concurrent load, weight hot-swap mid-traffic, wedged-replica eviction) ==="
+python scripts/serving_smoke.py
+
 echo "=== multichip sharding dryrun (8 virtual devices) ==="
 python __graft_entry__.py
 

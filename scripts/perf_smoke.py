@@ -120,11 +120,13 @@ def worker():
 
 
 def worker_shm():
-    """Shared-memory transport smoke (HOROVOD_TRANSPORT=auto at
-    launch): star over shm p2p, ring over the per-pair shm rings, and
-    the intra-host arena — engine byte accounting stays EXACT on every
-    path, and the per-transport counters let main() assert exact
-    conservation: every shm byte one rank sent, the other received."""
+    """Shared-memory transport smoke, launched with NO HOROVOD_TRANSPORT
+    set — the `auto` DEFAULT must engage shm between these co-located
+    ranks by itself (the ROADMAP-flagged default-flip assertion): star
+    over shm p2p, ring over the per-pair shm rings, and the intra-host
+    arena — engine byte accounting stays EXACT on every path, and the
+    per-transport counters let main() assert exact conservation: every
+    shm byte one rank sent, the other received."""
     import numpy as np
 
     import horovod_tpu as hvd
@@ -227,16 +229,22 @@ def main():
         "JAX_PLATFORMS": "cpu",
         "HOROVOD_CYCLE_TIME": "1",
         "HOROVOD_TCP_TIMEOUT_SECONDS": "60",
+        # Explicit pin: the default transport is `auto` now, and this
+        # stage's sendmsg/segment counters assert the raw socket plane.
+        "HOROVOD_TRANSPORT": "tcp",
     })
     assert len(results) == 2, results
     assert all(r["bytes"] == results[0]["bytes"] for r in results), results
     print("perf smoke OK (tcp):", results)
 
+    # Deliberately NO HOROVOD_TRANSPORT here: this stage doubles as the
+    # default-route assertion — on a co-located mesh the `auto` default
+    # must select shm on its own (worker_shm fails if no data byte ever
+    # rode shared memory).
     shm_results = run(worker_shm, np=2, extra_env={
         "JAX_PLATFORMS": "cpu",
         "HOROVOD_CYCLE_TIME": "1",
         "HOROVOD_TCP_TIMEOUT_SECONDS": "60",
-        "HOROVOD_TRANSPORT": "auto",
     })
     assert len(shm_results) == 2, shm_results
     assert all(r["bytes"] == shm_results[0]["bytes"]

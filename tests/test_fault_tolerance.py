@@ -190,6 +190,11 @@ def _tcp_pair(scope, monkeypatch):
     from horovod_tpu.runner.rendezvous_server import RendezvousServer
 
     monkeypatch.setenv("HVDRUN_FORCE_LOCAL", "1")
+    # Pin the raw socket plane: the default transport is `auto` (shm
+    # engages between co-located ranks), and this helper feeds the
+    # tcp-only suites — fault injections on socket paths, exact
+    # tcp byte/frame counter assertions.
+    monkeypatch.setenv("HOROVOD_TRANSPORT", "tcp")
     server = RendezvousServer()
     port = server.start()
     rdv = RendezvousClient("127.0.0.1", port)

@@ -1,0 +1,605 @@
+"""Serving plane tests (docs/serving.md): continuous batcher edge
+cases, bounded admission + HTTP backpressure, weight sources + the
+staged hot-swap loader, the extensible metrics-endpoint views, env
+knobs, and an end-to-end 2-rank serve() with a mid-traffic hot swap.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.telemetry import MetricsRegistry
+from horovod_tpu.serving.batcher import (
+    STATUS_DEADLINE, STATUS_OK, AdmissionQueue, ContinuousBatcher,
+    InferenceRequest,
+)
+
+
+def _mk(reg=None, maxsize=16, max_batch=4, max_tokens=1000,
+        max_delay_s=0.2):
+    reg = reg or MetricsRegistry()
+    q = AdmissionQueue(maxsize, registry=reg)
+    b = ContinuousBatcher(q, max_batch=max_batch, max_tokens=max_tokens,
+                          max_delay_s=max_delay_s, registry=reg)
+    return reg, q, b
+
+
+# ---------------------------------------------------------------------------
+# Batcher edge cases (the satellite checklist)
+
+def test_empty_queue_wakeup_on_enqueue():
+    """next_batch parks on an empty queue and an offer wakes it NOW —
+    no poll tick, no full max-delay stall before the first take."""
+    _, q, b = _mk(max_delay_s=0.05)
+    t0 = time.monotonic()
+
+    def later():
+        time.sleep(0.15)
+        q.offer(InferenceRequest("x", timeout_s=5))
+
+    threading.Thread(target=later).start()
+    batch = b.next_batch(wait_timeout=10.0)
+    took = time.monotonic() - t0
+    assert batch is not None and len(batch) == 1
+    # 0.15s arrival + 0.05s coalesce window + slack; a 1s+ result would
+    # mean the wait polled or slept through the enqueue.
+    assert took < 1.0, took
+
+
+def test_empty_queue_timeout_returns_none():
+    _, q, b = _mk()
+    t0 = time.monotonic()
+    assert b.next_batch(wait_timeout=0.05) is None
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_deadline_expired_dropped_before_dispatch():
+    """An admitted request whose deadline lapses in the queue is
+    completed with status=deadline and COUNTED, and next_batch never
+    hands it out."""
+    reg, q, b = _mk()
+    dead = InferenceRequest("late", timeout_s=0.01)
+    live = InferenceRequest("fine", timeout_s=10)
+    q.offer(dead)
+    q.offer(live)
+    time.sleep(0.05)
+    batch = b.next_batch(wait_timeout=1.0)
+    assert [r.payload for r in batch] == ["fine"]
+    assert dead.done and dead.status == STATUS_DEADLINE
+    snap = reg.snapshot()
+    assert snap[
+        'horovod_serving_requests_total{status="deadline"}'] == 1, snap
+
+
+def test_deadline_drop_only_path_returns_none():
+    """A queue holding ONLY expired requests yields no batch (and every
+    dropped request is answered), not an empty list."""
+    _, q, b = _mk()
+    reqs = [InferenceRequest(i, timeout_s=0.01) for i in range(3)]
+    for r in reqs:
+        q.offer(r)
+    time.sleep(0.05)
+    assert b.next_batch(wait_timeout=0.05) is None
+    assert all(r.status == STATUS_DEADLINE for r in reqs)
+
+
+def test_max_size_beats_max_delay():
+    """A full batch dispatches immediately — the max-delay window is a
+    bound, not a floor (the race the satellite names)."""
+    _, q, b = _mk(max_batch=3, max_delay_s=5.0)
+    for i in range(5):
+        q.offer(InferenceRequest(i, timeout_s=30))
+    t0 = time.monotonic()
+    batch = b.next_batch(wait_timeout=1.0)
+    assert len(batch) == 3
+    assert time.monotonic() - t0 < 1.0  # nowhere near the 5s window
+    # The remainder is still queued for the next batch, FIFO.
+    assert [r.payload for r in b.next_batch(1.0)] == [3, 4]
+
+
+def test_max_delay_closes_partial_batch():
+    _, q, b = _mk(max_batch=100, max_delay_s=0.05)
+    q.offer(InferenceRequest("only", timeout_s=30))
+    t0 = time.monotonic()
+    batch = b.next_batch(wait_timeout=1.0)
+    took = time.monotonic() - t0
+    assert len(batch) == 1
+    assert took < 1.0, took
+
+
+def test_single_request_latency_bounded_by_max_delay():
+    """The satellite's latency bound: a lone request waits AT MOST the
+    coalescing delay, and max_delay=0 dispatches with no wait at all."""
+    _, q, b = _mk(max_delay_s=0.0)
+    q.offer(InferenceRequest("now", timeout_s=30))
+    t0 = time.monotonic()
+    assert len(b.next_batch(1.0)) == 1
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_token_budget_caps_batch():
+    _, q, b = _mk(max_batch=100, max_tokens=10, max_delay_s=0.5)
+    for tok in (4, 4, 4):
+        q.offer(InferenceRequest("p", tokens=tok, timeout_s=30))
+    batch = b.next_batch(1.0)
+    # 4+4 admitted; the third would exceed 10 and waits its turn.
+    assert len(batch) == 2
+    assert len(b.next_batch(1.0)) == 1
+
+
+def test_oversized_single_request_still_dispatches():
+    _, q, b = _mk(max_tokens=10)
+    q.offer(InferenceRequest("big", tokens=999, timeout_s=30))
+    assert len(b.next_batch(1.0)) == 1
+
+
+def test_admission_queue_bound_and_requeue_bypass():
+    _, q, _ = _mk(maxsize=2)
+    r1, r2, r3 = (InferenceRequest(i, timeout_s=30) for i in range(3))
+    assert q.offer(r1) and q.offer(r2)
+    assert not q.offer(r3)  # full -> the frontend's 429
+    # Rerouted (already-admitted) work re-enters at the HEAD past the
+    # bound — an eviction retry must never be 429'd.
+    q._pop_locked()
+    taken = [q._pop_locked()]
+    q.requeue_front([r1] + taken)
+    assert q.depth() == 2
+    assert q._peek_locked() is r1
+
+
+def test_first_completion_wins():
+    r = InferenceRequest("x", timeout_s=30)
+    r.complete({"output": 1}, STATUS_OK)
+    r.complete(None, STATUS_DEADLINE, "late loser")
+    assert r.status == STATUS_OK and r.result == {"output": 1}
+
+
+# ---------------------------------------------------------------------------
+# Frontend: HTTP admission / backpressure / deadlines
+
+def _http(port, method, path, body=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(method, path,
+                 json.dumps(body) if body is not None else None)
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read() or b"null"))
+    conn.close()
+    return out
+
+
+def _frontend(monkeypatch, **env):
+    from horovod_tpu.serving.frontend import InferenceFrontend
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    return InferenceFrontend(port=0, registry=MetricsRegistry()).start()
+
+
+def test_frontend_backpressure_429(monkeypatch):
+    fe = _frontend(monkeypatch, HOROVOD_SERVING_QUEUE_DEPTH=1,
+                   HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS=30)
+    try:
+        assert fe.submit("a") is not None
+        # Queue full: HTTP answers 429 + Retry-After without blocking.
+        code, body = _http(fe.port, "POST", "/v1/infer", {"inputs": "b"})
+        assert code == 429, body
+        snap = fe.registry.snapshot()
+        assert snap[
+            'horovod_serving_requests_total{status="rejected"}'] == 1
+    finally:
+        fe.stop()
+
+
+def test_frontend_deadline_504(monkeypatch):
+    fe = _frontend(monkeypatch,
+                   HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS=0.1)
+    try:
+        # Nobody dispatches: the request comes back 504 AT its deadline
+        # (undispatched -> no grace window), counted exactly once even
+        # though the batcher would also have dropped it.
+        t0 = time.monotonic()
+        code, body = _http(fe.port, "POST", "/v1/infer", {"inputs": 1})
+        assert code == 504, body
+        assert time.monotonic() - t0 < 5
+        # The late batcher pass finds the corpse and must NOT recount.
+        assert fe.batcher.next_batch(0.05) is None
+        snap = fe.registry.snapshot()
+        assert snap[
+            'horovod_serving_requests_total{status="deadline"}'] == 1
+    finally:
+        fe.stop()
+
+
+def test_frontend_client_cannot_raise_deadline(monkeypatch):
+    fe = _frontend(monkeypatch,
+                   HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS=0.5)
+    try:
+        req = fe.submit("x", timeout_s=9999)
+        assert req.deadline - req.enqueued <= 0.5 + 1e-6
+        req2 = fe.submit("y", timeout_s=0.1)
+        assert req2.deadline - req2.enqueued <= 0.1 + 1e-6
+    finally:
+        fe.stop()
+
+
+def test_frontend_inflight_tracks_programmatic_submits(monkeypatch):
+    """The inflight gauge derives from the request futures, so the
+    programmatic submit() path (no infer() handler to decrement)
+    cannot inflate it forever."""
+    fe = _frontend(monkeypatch)
+    try:
+        reqs = [fe.submit(i) for i in range(3)]
+        assert fe.registry.gauge(
+            "horovod_serving_inflight_requests").value == 3
+        for r in reqs[:2]:
+            r.complete({"output": 0}, STATUS_OK)
+        assert fe.registry.gauge(
+            "horovod_serving_inflight_requests").value == 1
+        reqs[2].complete(None, STATUS_DEADLINE, "x")
+        assert fe.basic_status()["inflight"] == 0
+    finally:
+        fe.stop()
+
+
+def test_frontend_healthz_and_stop(monkeypatch):
+    fe = _frontend(monkeypatch)
+    try:
+        code, body = _http(fe.port, "GET", "/healthz")
+        assert code == 200 and body["queue_depth"] == 0
+        code, body = _http(fe.port, "POST", "/admin/stop")
+        assert code == 200 and body["stopping"]
+        code, body = _http(fe.port, "POST", "/v1/infer", {"inputs": 1})
+        assert code == 503
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# Weight sources + staged loader
+
+def test_publish_and_checkpoint_weight_source(tmp_path):
+    from horovod_tpu.serving.weights import (CheckpointWeightSource,
+                                             publish_weights)
+
+    src = CheckpointWeightSource(
+        str(tmp_path),
+        to_weights=lambda step, objects, trees: {
+            "w": float(np.asarray(trees["w"][0])), "step": step})
+    assert src.poll() is None
+    publish_weights(str(tmp_path), 7, {"w": [np.float64(3.5)]},
+                    objects={"note": "v7"})
+    assert src.poll() == 7
+    w = src.load(7)
+    assert w == {"w": 3.5, "step": 7}
+    # Default converter hands back (objects, trees) unchanged.
+    raw = CheckpointWeightSource(str(tmp_path))
+    objects, trees = raw.load(7)
+    assert objects == {"note": "v7"}
+    assert float(trees["w"][0]) == 3.5
+    # Newer publish wins the poll.
+    publish_weights(str(tmp_path), 9, {"w": [np.float64(4.0)]})
+    assert src.poll() == 9
+
+
+def test_background_loader_stages_and_supersedes(tmp_path):
+    from horovod_tpu.serving.weights import (BackgroundLoader,
+                                             CheckpointWeightSource,
+                                             publish_weights)
+
+    publish_weights(str(tmp_path), 1, {"w": [np.float64(1.0)]})
+    publish_weights(str(tmp_path), 2, {"w": [np.float64(2.0)]})
+    src = CheckpointWeightSource(
+        str(tmp_path),
+        to_weights=lambda s, o, t: float(np.asarray(t["w"][0])))
+    loader = BackgroundLoader(src)
+    loader.prepare(1)
+    deadline = time.monotonic() + 10
+    while loader.staged() != 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert loader.staged() == 1
+    # A newer prepare supersedes; commit takes exactly the staged step.
+    loader.prepare(2)
+    while loader.staged() != 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert loader.take(2) == 2.0
+    with pytest.raises(RuntimeError):
+        loader.take(1)
+
+
+def test_background_loader_error_reported(tmp_path):
+    from horovod_tpu.serving.weights import (BackgroundLoader,
+                                             CheckpointWeightSource)
+
+    loader = BackgroundLoader(CheckpointWeightSource(str(tmp_path)))
+    loader.prepare(99)  # no such manifest
+    deadline = time.monotonic() + 10
+    while loader.error() is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "99" in loader.error()
+    assert loader.staged() is None
+
+
+# ---------------------------------------------------------------------------
+# Batch split math + verdict parsing
+
+def test_slice_bounds_tile_exactly():
+    from horovod_tpu.serving.replicas import slice_bounds
+
+    for n in (0, 1, 2, 5, 7, 32):
+        for w in (1, 2, 3, 4, 8):
+            cuts = [slice_bounds(n, w, i) for i in range(w)]
+            assert cuts[0][0] == 0 and cuts[-1][1] == n
+            for (a, b), (c, d) in zip(cuts, cuts[1:]):
+                assert b == c and a <= b
+
+
+def test_failed_rank_from_error():
+    from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                               TransportError)
+    from horovod_tpu.serving.replicas import failed_rank_from_error
+
+    assert failed_rank_from_error(
+        TransportError("boom", peer=3)) == 3
+    assert failed_rank_from_error(HorovodInternalError(
+        "rank 2 (host x) declared dead by rank 0: no heartbeat")) == 2
+    assert failed_rank_from_error(HorovodInternalError("boom")) is None
+
+
+def test_swap_state_machine_piggybacks_and_replays():
+    """The coordinator's hot-swap state machine: commit only travels
+    after EVERY reply staged the target, and an eviction mid-swap
+    (half the survivors may have flipped already) re-proves staged
+    state on the new communicator before another commit."""
+    from horovod_tpu.serving.replicas import ServingCoordinator
+
+    coord = ServingCoordinator.__new__(ServingCoordinator)
+    coord._swap_target = 10
+    coord._all_staged = False
+
+    def note(replies):
+        ServingCoordinator._note_staged(coord, replies)
+
+    rep = lambda staged, committed: {"staged": staged,  # noqa: E731
+                                     "committed": committed}
+    # Partial staging: no commit yet.
+    note([rep(10, -1), rep(None, -1)])
+    assert coord._all_staged is False and coord._swap_target == 10
+    # All staged: the next round may attach commit.
+    note([rep(10, -1), rep(10, -1)])
+    assert coord._all_staged is True
+    # Eviction mid-commit: recovery resets _all_staged; a half-flipped
+    # reply set (one committed, one only staged) re-proves and the
+    # idempotent commit replays.
+    coord._all_staged = False
+    note([rep(10, 10), rep(10, -1)])
+    assert coord._all_staged is True and coord._swap_target == 10
+    # Everyone committed: the swap is done.
+    note([rep(10, 10), rep(10, 10)])
+    assert coord._swap_target is None and coord._all_staged is False
+
+
+# ---------------------------------------------------------------------------
+# Extensible metrics-endpoint views (the add_view satellite)
+
+def test_metrics_server_add_view_and_404_listing():
+    from horovod_tpu.common.metrics_export import MetricsHTTPServer
+
+    reg = MetricsRegistry()
+    srv = MetricsHTTPServer(0, registry=reg,
+                            status_fn=lambda: {"ok": 1}).start()
+    try:
+        srv.add_view("serving", lambda: {"role": "coordinator"})
+        code, body = _http(srv.port, "GET", "/serving")
+        assert code == 200 and body == {"role": "coordinator"}
+        # ctor sugar still lands on /status
+        code, body = _http(srv.port, "GET", "/status")
+        assert code == 200 and body == {"ok": 1}
+        # string providers pass through verbatim (the /trace shape)
+        srv.add_view("trace", lambda: '{"traceEvents": []}')
+        code, body = _http(srv.port, "GET", "/trace")
+        assert code == 200 and body == {"traceEvents": []}
+        # unknown views 404 and NAME the registered ones
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("GET", "/nope")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 404 and "/serving" in text, text
+        conn.close()
+        # conditional removal: a replaced provider's stale remove is a
+        # no-op, unconditional remove detaches
+        old = srv.get_view("serving")
+        srv.add_view("serving", lambda: {"role": "new"})
+        srv.remove_view("serving", old)
+        assert srv.get_view("serving") is not None
+        srv.remove_view("serving")
+        assert srv.get_view("serving") is None
+        with pytest.raises(ValueError):
+            srv.add_view("metrics", lambda: {})
+        with pytest.raises(ValueError):
+            srv.add_view("bad/name", lambda: {})
+    finally:
+        srv.stop()
+
+
+def test_engine_gauge_detach_is_conditional():
+    """The stale-gauge fix: a dying Engine's shutdown must not wipe a
+    replacement's gauge registration (teardown overlapping re-init on
+    a shared registry)."""
+    from horovod_tpu.engine.engine import Engine
+
+    reg = MetricsRegistry()
+    eng = Engine(rank=0, size=1, registry=reg)
+    eng.start()
+    try:
+        replacement = lambda: 42.0  # noqa: E731
+        for name in ("horovod_tensor_queue_depth",
+                     "horovod_last_cycle_age_seconds",
+                     "horovod_inflight_responses"):
+            reg.gauge(name).set_function(replacement)
+    finally:
+        eng.shutdown()
+    for name in ("horovod_tensor_queue_depth",
+                 "horovod_last_cycle_age_seconds",
+                 "horovod_inflight_responses"):
+        assert reg.gauge(name).value == 42.0, name
+
+
+# ---------------------------------------------------------------------------
+# Env knobs (the parse-test satellite)
+
+def test_serving_env_knob_parsing(monkeypatch):
+    from horovod_tpu.utils import env as env_cfg
+
+    # Defaults.
+    for k in ("HOROVOD_SERVING_PORT", "HOROVOD_SERVING_MAX_BATCH",
+              "HOROVOD_SERVING_MAX_BATCH_TOKENS",
+              "HOROVOD_SERVING_MAX_DELAY_MS",
+              "HOROVOD_SERVING_QUEUE_DEPTH",
+              "HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS",
+              "HOROVOD_SERVING_WEIGHT_REFRESH_SECONDS"):
+        monkeypatch.delenv(k, raising=False)
+    assert env_cfg.serving_port() == -1
+    assert env_cfg.serving_max_batch() == 32
+    assert env_cfg.serving_max_batch_tokens() == 16384
+    assert env_cfg.serving_max_delay_ms() == 5.0
+    assert env_cfg.serving_queue_depth() == 256
+    assert env_cfg.serving_request_timeout() == 30.0
+    assert env_cfg.serving_weight_refresh_seconds() == 10.0
+    assert env_cfg.serving_addr() == "127.0.0.1"
+    # Explicit values + floors.
+    monkeypatch.setenv("HOROVOD_SERVING_PORT", "8500")
+    monkeypatch.setenv("HOROVOD_SERVING_MAX_BATCH", "0")
+    monkeypatch.setenv("HOROVOD_SERVING_MAX_BATCH_TOKENS", "-5")
+    monkeypatch.setenv("HOROVOD_SERVING_MAX_DELAY_MS", "-1")
+    monkeypatch.setenv("HOROVOD_SERVING_QUEUE_DEPTH", "0")
+    monkeypatch.setenv("HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS", "0")
+    monkeypatch.setenv("HOROVOD_SERVING_WEIGHT_REFRESH_SECONDS", "0")
+    assert env_cfg.serving_port() == 8500
+    assert env_cfg.serving_max_batch() == 1
+    assert env_cfg.serving_max_batch_tokens() == 1
+    assert env_cfg.serving_max_delay_ms() == 0.0
+    assert env_cfg.serving_queue_depth() == 1
+    assert env_cfg.serving_request_timeout() == 0.001
+    assert env_cfg.serving_weight_refresh_seconds() == 0.0
+    # The HVD_TPU_ alias prefix works here like everywhere else.
+    monkeypatch.delenv("HOROVOD_SERVING_PORT")
+    monkeypatch.setenv("HVD_TPU_SERVING_PORT", "8600")
+    assert env_cfg.serving_port() == 8600
+
+
+def test_transport_default_is_auto(monkeypatch):
+    from horovod_tpu.utils import env as env_cfg
+
+    monkeypatch.delenv("HOROVOD_TRANSPORT", raising=False)
+    monkeypatch.delenv("HVD_TPU_TRANSPORT", raising=False)
+    assert env_cfg.transport_mode() == "auto"
+    monkeypatch.setenv("HOROVOD_TRANSPORT", "tcp")
+    assert env_cfg.transport_mode() == "tcp"
+    monkeypatch.setenv("HOROVOD_TRANSPORT", "bogus")
+    assert env_cfg.transport_mode() == "auto"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: 2-rank mesh, HTTP traffic, mid-traffic hot swap
+
+def test_serve_two_ranks_with_hot_swap(tmp_path):
+    """Real 2-process mesh: concurrent HTTP clients through the front
+    door, then a publish_weights mid-traffic; every request answers
+    200, the last answers provably carry the new weights, and zero
+    requests are dropped across the swap."""
+    from horovod_tpu.runner import run
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    results = run(_swap_worker, np=2, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_SERVING_MAX_DELAY_MS": "5",
+        "HOROVOD_SERVING_WEIGHT_REFRESH_SECONDS": "0.1",
+        "TEST_CKPT_DIR": str(tmp_path),
+        # The worker unpickles a function living in this test module.
+        "PYTHONPATH": os.pathsep.join([repo, here]),
+    })
+    assert len(results) == 2
+    for rep in results:
+        assert rep["weight_step"] == 50, rep
+        assert rep["evictions"] == 0, rep
+
+
+def _swap_worker():
+    import http.client
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.metrics_export import MetricsHTTPServer
+    from horovod_tpu.serving.weights import (CheckpointWeightSource,
+                                             publish_weights)
+
+    hvd.init()
+    ckpt_dir = os.environ["TEST_CKPT_DIR"]
+    source = CheckpointWeightSource(
+        ckpt_dir,
+        to_weights=lambda s, o, t: {"w": float(np.asarray(t["w"][0]))})
+
+    def model_fn(weights, payloads):
+        return [weights["w"] * p for p in payloads]
+
+    outcome = {}
+    port = None
+    if hvd.rank() == 0:
+        # Pick a free port up front so the client thread knows it.
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        def client():
+            from horovod_tpu.serving import replicas
+
+            deadline = time.monotonic() + 30
+            while (replicas.current() is None
+                   or replicas.current().rounds == 0):
+                time.sleep(0.02)
+                assert time.monotonic() < deadline, "serving never started"
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            vals = []
+            for i in range(16):
+                if i == 5:
+                    publish_weights(ckpt_dir, 50,
+                                    {"w": [np.float64(5.0)]})
+                conn.request("POST", "/v1/infer",
+                             json.dumps({"inputs": 2.0}))
+                r = conn.getresponse()
+                body = json.loads(r.read())
+                assert r.status == 200, (r.status, body)
+                vals.append((body["output"], body["weight_step"]))
+                time.sleep(0.05)
+            outcome["vals"] = vals
+
+        threading.Thread(target=client, daemon=True).start()
+    report = hvd.serving.serve(model_fn, weights={"w": 1.0},
+                               weight_source=source, port=port,
+                               max_requests=16, tick_seconds=0.05)
+    if hvd.rank() == 0:
+        vals = outcome["vals"]
+        assert vals[0] == (2.0, -1), vals
+        assert vals[-1] == (10.0, 50), vals
+        assert all(v in ((2.0, -1), (10.0, 50)) for v in vals), vals
+        # The /serving view unregisters when serve() returns — a stale
+        # view would pin the dead plane and answer with frozen state.
+        for exp in basics.engine()._exporters:
+            if isinstance(exp, MetricsHTTPServer):
+                assert exp.get_view("serving") is None
+    hvd.shutdown()
+    return report

@@ -80,7 +80,10 @@ def _make_pair(kind, scope, monkeypatch) -> _Pair:
     if kind == "shm":
         monkeypatch.setenv("HOROVOD_TRANSPORT", "auto")
     else:
-        monkeypatch.delenv("HOROVOD_TRANSPORT", raising=False)
+        # Explicit pin: the default is `auto` now, and this is the leg
+        # whose whole point is exercising the raw socket plane (its
+        # byte/frame assertions are tcp-only).
+        monkeypatch.setenv("HOROVOD_TRANSPORT", "tcp")
     server = RendezvousServer()
     port = server.start()
     rdv = RendezvousClient("127.0.0.1", port)
