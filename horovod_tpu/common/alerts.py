@@ -1,0 +1,614 @@
+"""Declarative SLO/anomaly alert engine over the on-box time-series
+(docs/health.md).
+
+Nothing in the stack *watches* the signals the earlier planes surface:
+a serving p99 breach, a persistent straggler, a stalled checkpoint all
+sit in `/metrics` waiting for a human to scrape them. This module
+closes the loop: a rule engine evaluated on every sampler tick
+(common/timeseries.py), Google-SRE-shaped rule types, and latched
+firing→resolved state with duration hysteresis so a single noisy
+sample never pages.
+
+Rule types:
+
+* ``threshold`` — a gauge (last value, family max) or counter rate
+  above/below a bound for ≥ ``for_seconds``;
+* ``burn_rate`` — a windowed histogram quantile vs an SLO target in a
+  fast AND a slow window (the multi-window burn-rate pattern: the fast
+  window reacts, the slow window filters blips);
+* ``regression`` — a windowed statistic vs the median of trailing
+  adjacent windows, relative tolerance ("this got worse", no absolute
+  bound needed);
+* ``straggler`` — the same rank named by an attribution gauge in ≥ K
+  of the last N samples (every verdict in this stack names a rank;
+  alerts do too);
+* ``overdue`` — a progress counter that stopped advancing for longer
+  than ``factor`` × its own observed median cadence (self-calibrating
+  "checkpoint overdue").
+
+State machine per rule: a breach must hold ``for_seconds`` before the
+alert latches FIRING (counted in ``horovod_alerts_total{rule=,
+state="fire"}``, an ``alert.fire`` instant in the flight recorder, a
+log line); it must then stay clear ``clear_seconds`` before RESOLVED.
+Stale data — the sampler ring's newest sample older than the staleness
+bound — never fires anything: no data is not evidence of breach.
+
+Fleet view: each rank's firing set rides the telemetry piggyback
+(controller → ``FleetAlerts`` on rank 0), so the coordinator's
+``/alerts`` names the offending rank job-wide — the same path every
+PR 5 liveness verdict takes.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from . import timeseries as ts
+
+logger = get_logger()
+
+# evaluate() verdict: (breach, value, detail) — None = not enough data
+# (a rule with nothing to say must stay silent, never fire).
+Verdict = Optional[Tuple[bool, float, dict]]
+
+
+class Rule:
+    """Base rule: name, doc, hysteresis bounds, override plumbing."""
+
+    kind = "rule"
+
+    def __init__(self, name: str, description: str = "",
+                 for_seconds: float = 0.0,
+                 clear_seconds: Optional[float] = None,
+                 enabled: bool = True):
+        self.name = name
+        self.description = description
+        self.for_seconds = for_seconds
+        self.clear_seconds = (for_seconds if clear_seconds is None
+                              else clear_seconds)
+        self.enabled = enabled
+        # Parameters the user explicitly pinned via HOROVOD_ALERT_RULES;
+        # live re-wiring (serving/_wire_alert_rules) must not clobber
+        # them — an explicit override always wins over a derived value.
+        self._overridden: set = set()
+
+    def evaluate(self, store: ts.TimeSeriesStore,
+                 now: Optional[float] = None) -> Verdict:
+        raise NotImplementedError
+
+    def set_param(self, key: str, value: str):
+        """HOROVOD_ALERT_RULES override: coerce to the attribute's
+        current type so `serving_p99_slo:target_s=0.05` just works.
+        Unknown keys are loud — a typo'd override that silently does
+        nothing is worse than an error."""
+        if not hasattr(self, key) or key in ("name", "kind"):
+            raise ValueError(f"rule {self.name!r} has no parameter {key!r}")
+        cur = getattr(self, key)
+        if isinstance(cur, bool):
+            value = value.lower() not in ("0", "false", "no", "off")
+        elif isinstance(cur, int) and not isinstance(cur, bool):
+            value = int(value)
+        elif isinstance(cur, float) or cur is None:
+            value = float(value)
+        setattr(self, key, value)
+        self._overridden.add(key)
+
+    def config(self) -> dict:
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+
+class ThresholdRule(Rule):
+    """Gauge / rate / family-max vs a bound for >= for_seconds."""
+
+    kind = "threshold"
+
+    def __init__(self, name: str, metric: str, threshold: float,
+                 op: str = "above", mode: str = "last",
+                 window_s: float = 60.0, **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.threshold = threshold
+        self.op = op          # above | below
+        self.mode = mode      # last | rate | family_max
+        self.window_s = window_s
+
+    def evaluate(self, store, now=None) -> Verdict:
+        detail: dict = {}
+        if self.mode == "rate":
+            value = store.rate(self.metric, self.window_s)
+        elif self.mode == "family_max":
+            latest = store.latest()
+            if latest is None:
+                return None
+            items = [(k, v) for k, v in
+                     ts.family_items(latest, self.metric).items()
+                     if isinstance(v, (int, float)) and v == v]
+            if not items:
+                return None
+            key, value = max(items, key=lambda kv: kv[1])
+            detail["series"] = key
+        else:
+            latest = store.latest()
+            value = latest.get(self.metric) if latest else None
+            if not isinstance(value, (int, float)) or value != value:
+                return None
+        if value is None:
+            return None
+        breach = (value > self.threshold if self.op == "above"
+                  else value < self.threshold)
+        detail["threshold"] = self.threshold
+        return breach, float(value), detail
+
+
+class BurnRateRule(Rule):
+    """Windowed histogram quantile vs an SLO target, fast + slow
+    window both breaching (multi-window burn rate). target_s <= 0
+    disarms (the serving SLO default until the knob is set)."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name: str, metric: str, target_s: float,
+                 quantile: float = 0.99, fast_window_s: float = 60.0,
+                 slow_window_s: float = 300.0, min_count: int = 10, **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.target_s = target_s
+        self.quantile = quantile
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.min_count = min_count
+
+    def evaluate(self, store, now=None) -> Verdict:
+        if self.target_s <= 0:
+            return None
+        fast_w = store.hist_window(self.metric, self.fast_window_s, now)
+        if fast_w is None or fast_w["count"] < self.min_count:
+            return None
+        fast = ts.quantile_from_counts(
+            fast_w["bounds"], fast_w["counts"], self.quantile)
+        slow = store.quantile(self.metric, self.quantile,
+                              self.slow_window_s, now)
+        if fast is None or slow is None:
+            return None
+        breach = fast > self.target_s and slow > self.target_s
+        return breach, fast, {
+            "target_s": self.target_s,
+            "fast_q": round(fast, 6), "slow_q": round(slow, 6),
+            "quantile": self.quantile,
+        }
+
+
+class RegressionRule(Rule):
+    """Windowed quantile vs the median of trailing adjacent windows:
+    fires when "now" is worse than "recently" by more than the relative
+    tolerance. Needs >= min_baselines trailing windows with data, so a
+    cold start never fires."""
+
+    kind = "regression"
+
+    def __init__(self, name: str, metric: str, window_s: float = 60.0,
+                 baselines: int = 5, min_baselines: int = 2,
+                 tolerance: float = 0.75, quantile: float = 0.5,
+                 min_count: int = 20, **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.window_s = window_s
+        self.baselines = baselines
+        self.min_baselines = min_baselines
+        self.tolerance = tolerance
+        self.quantile = quantile
+        self.min_count = min_count
+
+    def evaluate(self, store, now=None) -> Verdict:
+        samples = store.samples()
+        if not samples:
+            return None
+        now = samples[-1][1] if now is None else now
+        cur_w = ts.histogram_window(samples, self.metric,
+                                    self.window_s, now)
+        if cur_w is None or cur_w["count"] < self.min_count:
+            return None
+        cur = ts.quantile_from_counts(
+            cur_w["bounds"], cur_w["counts"], self.quantile)
+        if cur is None:
+            return None
+        base_vals = []
+        for i in range(1, self.baselines + 1):
+            v = ts.window_quantile(samples, self.metric, self.quantile,
+                                   self.window_s,
+                                   now=now - i * self.window_s)
+            if v is not None:
+                base_vals.append(v)
+        if len(base_vals) < self.min_baselines:
+            return None
+        base_vals.sort()
+        baseline = base_vals[len(base_vals) // 2]
+        if baseline <= 0:
+            return None
+        ratio = cur / baseline
+        return ratio > 1.0 + self.tolerance, cur, {
+            "baseline": round(baseline, 6),
+            "ratio": round(ratio, 3),
+            "tolerance": self.tolerance,
+        }
+
+
+class StragglerRule(Rule):
+    """The attribution gauge named the same rank in >= k of the last n
+    samples. The detail carries the rank — the alert IS the verdict.
+
+    Two guards keep a healthy mesh quiet: `activity_metric` must have
+    advanced across the window (an idle mesh freezes the gauge on the
+    last straggler, which is history, not evidence), and the default
+    k/n demands 90% dominance sustained for `for_seconds` — on a
+    balanced mesh the last-to-arrive rank is a coin flip, and a coin
+    must not page."""
+
+    kind = "straggler"
+
+    def __init__(self, name: str,
+                 metric: str = "horovod_straggler_rank",
+                 k: int = 9, n: int = 10,
+                 activity_metric: str = "horovod_responses_total", **kw):
+        kw.setdefault("for_seconds", 30.0)
+        kw.setdefault("clear_seconds", 0.0)
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.k = k
+        self.n = n
+        self.activity_metric = activity_metric
+
+    def evaluate(self, store, now=None) -> Verdict:
+        samples = store.samples()
+        if len(samples) < self.n:
+            return None
+        window = samples[-self.n:]
+        if self.activity_metric:
+            first = window[0][2].get(self.activity_metric)
+            last = window[-1][2].get(self.activity_metric)
+            if (not isinstance(first, (int, float))
+                    or not isinstance(last, (int, float))
+                    or last == first):
+                return None  # no negotiations: the gauge is stale history
+        vals = [s[2].get(self.metric) for s in window]
+        vals = [int(v) for v in vals
+                if isinstance(v, (int, float)) and v == v and v >= 0]
+        if not vals:
+            return False, -1.0, {}
+        counts: Dict[int, int] = {}
+        for v in vals:
+            counts[v] = counts.get(v, 0) + 1
+        rank, hits = max(counts.items(), key=lambda kv: kv[1])
+        return hits >= self.k, float(rank), {
+            "rank": rank, "hits": hits, "of": self.n,
+        }
+
+
+class OverdueRule(Rule):
+    """A progress counter stopped advancing for > factor x its own
+    observed median cadence. Self-calibrating: needs >= 2 observed
+    advances (one interval) before it can fire, so a job that never
+    checkpoints never pages about checkpoints."""
+
+    kind = "overdue"
+
+    def __init__(self, name: str, metric: str, factor: float = 2.0,
+                 min_advances: int = 2, **kw):
+        super().__init__(name, **kw)
+        self.metric = metric
+        self.factor = factor
+        self.min_advances = min_advances
+
+    def evaluate(self, store, now=None) -> Verdict:
+        samples = store.samples()
+        if not samples:
+            return None
+        now = samples[-1][1] if now is None else now
+        advances: List[float] = []  # mono times the counter moved
+        prev = None
+        for _, mono, snap in samples:
+            v = snap.get(self.metric)
+            if not isinstance(v, (int, float)):
+                continue
+            if prev is not None and v > prev:
+                advances.append(mono)
+            prev = v
+        if prev is None or len(advances) < self.min_advances:
+            return None
+        gaps = sorted(b - a for a, b in zip(advances, advances[1:]))
+        median_gap = gaps[len(gaps) // 2]
+        age = now - advances[-1]
+        limit = self.factor * median_gap
+        return age > limit, age, {
+            "overdue_seconds": round(age, 3),
+            "median_interval_seconds": round(median_gap, 3),
+            "factor": self.factor,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Default rule set
+
+def default_rules() -> List[Rule]:
+    """The built-in rules, wired to real signals. Rules over planes
+    that are off in this process simply never see data and stay
+    silent; the serving SLO rule additionally disarms itself while
+    HOROVOD_SERVING_SLO_P99_MS is 0."""
+    hb_interval = env_cfg.heartbeat_interval_seconds()
+    hb_limit = env_cfg.heartbeat_miss_limit()
+    rules: List[Rule] = [
+        BurnRateRule(
+            "serving_p99_slo", "horovod_serving_request_seconds",
+            target_s=env_cfg.serving_slo_p99_ms() / 1e3,
+            description="Serving p99 latency above the "
+                        "HOROVOD_SERVING_SLO_P99_MS target in both the "
+                        "fast and slow windows"),
+        RegressionRule(
+            "cycle_time_regression", "horovod_cycle_seconds",
+            description="Engine cycle time p50 regressed vs the "
+                        "trailing-window baseline (the step got slower)"),
+        StragglerRule(
+            "persistent_straggler",
+            description="horovod_straggler_rank named the same rank in "
+                        ">= k of the last n samples — one rank is "
+                        "holding every collective back"),
+        ThresholdRule(
+            "heartbeat_stale", "horovod_heartbeat_age_seconds",
+            threshold=0.8 * hb_interval * max(hb_limit, 1),
+            mode="family_max",
+            enabled=env_cfg.heartbeat_enabled(),
+            description="A peer's heartbeat age is approaching the "
+                        "dead-declaration bound (silence, not yet a "
+                        "verdict)"),
+        ThresholdRule(
+            "admission_queue_saturated", "horovod_serving_queue_depth",
+            threshold=0.9 * env_cfg.serving_queue_depth(),
+            for_seconds=20.0,
+            description="Serving admission queue >= 90% of "
+                        "HOROVOD_SERVING_QUEUE_DEPTH — 429 backpressure "
+                        "is imminent or already happening"),
+        OverdueRule(
+            "checkpoint_overdue", "horovod_checkpoint_commits_total",
+            description="No checkpoint commit within 2x the observed "
+                        "commit cadence — durability is stalled"),
+    ]
+    return rules
+
+
+def apply_rules_spec(spec: str, rules: List[Rule]) -> List[Rule]:
+    """Apply the HOROVOD_ALERT_RULES token list (utils/env.py) to a
+    rule set: `none`/`off` disables everything, `-name` disables one,
+    `name` (re-)enables one, `name:param=value:...` overrides
+    parameters. Unknown rule names and bad parameters raise — a typo'd
+    alert config must fail loudly at startup, not page never."""
+    by_name = {r.name: r for r in rules}
+    for token in (t.strip() for t in spec.split(",")):
+        if not token:
+            continue
+        if token.lower() in ("none", "off"):
+            for r in rules:
+                r.enabled = False
+            continue
+        disable = token.startswith("-")
+        fields = token.lstrip("-").split(":")
+        name = fields[0]
+        rule = by_name.get(name)
+        if rule is None:
+            raise ValueError(
+                f"unknown alert rule {name!r} in {env_cfg.ALERT_RULES} "
+                f"(have: {', '.join(sorted(by_name))})")
+        rule.enabled = not disable
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(f"bad alert override {f!r} in {token!r}")
+            k, v = f.split("=", 1)
+            rule.set_param(k.strip(), v.strip())
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# The engine
+
+class _AlertState:
+    __slots__ = ("firing", "since_wall", "breach_start", "clear_start",
+                 "value", "detail", "fires", "resolves")
+
+    def __init__(self):
+        self.firing = False
+        self.since_wall: Optional[float] = None
+        self.breach_start: Optional[float] = None
+        self.clear_start: Optional[float] = None
+        self.value: Optional[float] = None
+        self.detail: dict = {}
+        self.fires = 0
+        self.resolves = 0
+
+
+class AlertEngine:
+    """Evaluates the rule set on each sampler tick and latches per-rule
+    firing state. `stale_after` bounds trust in the ring: when the
+    newest sample is older than it, evaluation is skipped entirely
+    (state frozen, noted in status) — stale data never fires OR
+    resolves anything."""
+
+    def __init__(self, store: ts.TimeSeriesStore, registry,
+                 rules: Optional[List[Rule]] = None, tracer=None,
+                 stale_after: Optional[float] = None,
+                 rules_spec: Optional[str] = None):
+        self.store = store
+        self.registry = registry
+        self.tracer = tracer
+        if rules is None:
+            rules = default_rules()
+        if rules_spec is None:
+            rules_spec = env_cfg.alert_rules_spec()
+        if rules_spec:
+            apply_rules_spec(rules_spec, rules)
+        self.rules = rules
+        if stale_after is None:
+            stale_after = 3 * max(env_cfg.metrics_sample_seconds(), 1.0)
+        self.stale_after = stale_after
+        self._state: Dict[str, _AlertState] = {
+            r.name: _AlertState() for r in rules}
+        self._lock = threading.Lock()
+        self.stale = False
+        self._m_firing = registry.gauge(
+            "horovod_alerts_firing", "Alert rules currently latched firing")
+        self._m_total: Dict[Tuple[str, str], object] = {}
+
+    def _count(self, rule: str, state: str):
+        key = (rule, state)
+        c = self._m_total.get(key)
+        if c is None:
+            c = self._m_total[key] = self.registry.counter(
+                "horovod_alerts_total",
+                "Alert transitions by rule and state",
+                labels={"rule": rule, "state": state})
+        c.inc()
+
+    def _instant(self, name: str, rule: Rule, st: _AlertState):
+        if self.tracer is not None and getattr(self.tracer, "enabled", False):
+            self.tracer.instant(name, cat="alert", args=dict(
+                {"rule": rule.name, "value": st.value}, **st.detail))
+
+    # -- the tick ------------------------------------------------------
+    def evaluate(self, store: Optional[ts.TimeSeriesStore] = None,
+                 now: Optional[float] = None):
+        """Run every enabled rule once. Registered as a sampler tick
+        callback; callable directly in tests with a synthetic store."""
+        store = store if store is not None else self.store
+        now = time.monotonic() if now is None else now
+        age = store.last_age()
+        self.stale = age < 0 or age > self.stale_after
+        if self.stale:
+            return
+        with self._lock:
+            for rule in self.rules:
+                if not rule.enabled:
+                    continue
+                try:
+                    verdict = rule.evaluate(store, now)
+                except Exception:
+                    logger.exception("alert rule %s failed", rule.name)
+                    continue
+                st = self._state[rule.name]
+                if verdict is None:
+                    # No data: clear any pending breach window (a gap
+                    # must not bridge two short breaches into one long
+                    # one), keep latched state as-is.
+                    st.breach_start = None
+                    continue
+                breach, st.value, st.detail = verdict
+                if breach:
+                    st.clear_start = None
+                    if st.breach_start is None:
+                        st.breach_start = now
+                    if (not st.firing
+                            and now - st.breach_start >= rule.for_seconds):
+                        st.firing = True
+                        st.since_wall = time.time()
+                        st.fires += 1
+                        self._count(rule.name, "fire")
+                        self._instant("alert.fire", rule, st)
+                        logger.warning(
+                            "ALERT FIRING %s: value=%s %s", rule.name,
+                            st.value, st.detail)
+                else:
+                    st.breach_start = None
+                    if st.firing:
+                        if st.clear_start is None:
+                            st.clear_start = now
+                        if now - st.clear_start >= rule.clear_seconds:
+                            st.firing = False
+                            st.resolves += 1
+                            st.clear_start = None
+                            self._count(rule.name, "resolve")
+                            self._instant("alert.resolve", rule, st)
+                            logger.info("alert resolved: %s", rule.name)
+            self._m_firing.set(
+                sum(1 for s in self._state.values() if s.firing))
+
+    # -- views ---------------------------------------------------------
+    def firing(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"rule": name, "value": st.value, "detail": dict(st.detail),
+                 "since": st.since_wall}
+                for name, st in sorted(self._state.items()) if st.firing
+            ]
+
+    def status(self) -> dict:
+        with self._lock:
+            rules = {}
+            for rule in self.rules:
+                st = self._state[rule.name]
+                rules[rule.name] = {
+                    "kind": rule.kind,
+                    "enabled": rule.enabled,
+                    "firing": st.firing,
+                    "since": st.since_wall,
+                    "value": st.value,
+                    "detail": dict(st.detail),
+                    "fires": st.fires,
+                    "resolves": st.resolves,
+                    "description": rule.description,
+                }
+            return {
+                "stale": self.stale,
+                "firing": sorted(n for n, s in self._state.items()
+                                 if s.firing),
+                "rules": rules,
+            }
+
+    def push_state(self) -> dict:
+        """Compact per-rank state for the telemetry piggyback (the
+        coordinator's FleetAlerts ingests it)."""
+        return {"firing": self.firing()}
+
+
+# ---------------------------------------------------------------------------
+# Coordinator-side fold
+
+class FleetAlerts:
+    """Rank 0's per-rank alert state, folded from the telemetry
+    piggyback blobs — the fleet-wide `/alerts` view that names which
+    RANK an alert is firing on, not just that one is."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._lock = threading.Lock()
+        self._ranks: Dict[int, Tuple[float, dict]] = {}
+
+    def ingest_blob(self, rank: int, blob: bytes):
+        try:
+            d = json.loads(blob.decode("utf-8"))
+            alerts = d.get("alerts")
+        except Exception:
+            return  # malformed blobs never take down the cycle loop
+        if isinstance(alerts, dict):
+            with self._lock:
+                self._ranks[int(rank)] = (time.time(), alerts)
+
+    def snapshot(self) -> dict:
+        now = time.time()
+        with self._lock:
+            per_rank = {
+                r: {"age_seconds": round(max(now - t, 0.0), 3),
+                    "firing": list(state.get("firing", []))}
+                for r, (t, state) in sorted(self._ranks.items())
+            }
+        by_rule: Dict[str, List[int]] = {}
+        for r, entry in per_rank.items():
+            for f in entry["firing"]:
+                by_rule.setdefault(f.get("rule", "?"), []).append(r)
+        return {
+            "size": self.size,
+            "ranks": per_rank,
+            "firing_by_rule": {k: sorted(v) for k, v in
+                               sorted(by_rule.items())},
+        }

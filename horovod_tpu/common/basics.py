@@ -172,6 +172,10 @@ def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "
         telemetry.gauge(
             "horovod_world_size", "World size after the last (re)init"
         ).set(_state.size)
+        # Build identity + uptime on the process registry: every scrape
+        # (and the perf regression reporter) can attribute numbers to a
+        # build (docs/health.md).
+        telemetry.register_build_info()
         logger.debug(
             "horovod_tpu initialized: mode=%s rank=%d size=%d local=%d/%d cross=%d/%d",
             _state.mode, _state.rank, _state.size, _state.local_rank,

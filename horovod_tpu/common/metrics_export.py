@@ -40,10 +40,26 @@ def _prom_name(name: str) -> str:
     return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
 
 
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (exposition spec §text
+    format details) — a multi-line help string would otherwise corrupt
+    every line after it for strict parsers."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: str) -> str:
+    """Label values escape backslash, double-quote and newline. A
+    version label like `0.4.37+cuda"test` must round-trip, not break
+    the series line."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels, extra: str = "") -> str:
     parts = []
     if labels:
-        parts.extend(f'{k}="{labels[k]}"' for k in sorted(labels))
+        parts.extend(f'{k}="{_escape_label_value(labels[k])}"'
+                     for k in sorted(labels))
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -74,7 +90,7 @@ def to_prometheus(registry: Optional[telemetry.MetricsRegistry] = None) -> str:
         if name not in seen_headers:
             seen_headers.add(name)
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# HELP {name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
         if isinstance(m, telemetry.Histogram):
             snap = m.snapshot()
@@ -91,6 +107,101 @@ def to_prometheus(registry: Optional[telemetry.MetricsRegistry] = None) -> str:
         else:
             lines.append(f"{name}{_prom_labels(m.labels)} {_fmt(m.snapshot())}")
     return "\n".join(lines) + "\n"
+
+
+def _unescape_help(s: str) -> str:
+    """Inverse of `_escape_help`, single left-to-right pass — chained
+    str.replace would corrupt a literal backslash followed by 'n'
+    (escaped `\\\\n` must decode to backslash+n, not backslash+LF)."""
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_label_block(s: str) -> dict:
+    """Inverse of `_prom_labels`: parse `{k="v",...}` honoring the
+    value escapes (backslash, quote, newline)."""
+    out = {}
+    i = 1  # past '{'
+    end = len(s) - 1  # before '}'
+    while i < end:
+        eq = s.index("=", i)
+        name = s[i:eq].strip().lstrip(",").strip()
+        if s[eq + 1] != '"':
+            raise ValueError(f"unquoted label value in {s!r}")
+        k = eq + 2
+        val = []
+        while True:
+            c = s[k]
+            if c == "\\":
+                nxt = s[k + 1]
+                val.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+                k += 2
+            elif c == '"':
+                k += 1
+                break
+            else:
+                val.append(c)
+                k += 1
+        out[name] = "".join(val)
+        i = k
+    return out
+
+
+def parse_prometheus(text: str):
+    """Parse text exposition 0.0.4 back into
+    ``(samples, types, helps)``: samples keyed the same way as
+    `MetricsRegistry.snapshot()` (``name{k="v",...}`` with sorted
+    labels), types/helps keyed by family name. The conformance
+    round-trip test — and anything in-repo that scrapes a live
+    `/metrics` — consumes this instead of regexing the text."""
+    samples: dict = {}
+    types: dict = {}
+    helps: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, rest = line.split(None, 3)
+            helps[name] = _unescape_help(rest)
+            continue
+        if line.startswith("#"):
+            continue
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rindex("}")
+            name = line[:brace]
+            labels = _parse_label_block(line[brace:close + 1])
+            value = line[close + 1:].strip()
+        else:
+            name, value = line.split(None, 1)
+            labels = {}
+        v = float(value)
+        key = name
+        if labels:
+            inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+            key = f"{name}{{{inner}}}"
+        samples[key] = v
+    return samples, types, helps
 
 
 def to_json(registry: Optional[telemetry.MetricsRegistry] = None,

@@ -317,6 +317,45 @@ def histogram(name: str, help: str = "", labels: LabelDict = None,
 
 
 # ---------------------------------------------------------------------------
+# Build identity + uptime (standard practice for any scraped process;
+# the perf regression reporter stamps the same dict into its JSON so
+# every BENCH round is attributable to a build).
+
+_PROCESS_START_MONO = time.monotonic()
+
+
+def build_info() -> Dict[str, str]:
+    """Static build identity: package version + jax version."""
+    from ..version import __version__
+
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # pragma: no cover - jax is baked into the image
+        jax_version = "none"
+    return {"version": __version__, "jax": jax_version}
+
+
+def register_build_info(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register the `horovod_build_info{version=,jax=}` info-gauge
+    (constant 1 — the labels ARE the payload, the Prometheus info-metric
+    idiom) and `horovod_uptime_seconds` on `registry`. Idempotent."""
+    registry = registry or default_registry()
+    info = build_info()
+    registry.gauge(
+        "horovod_build_info",
+        "Build identity; the constant-1 value carries its labels",
+        labels=info,
+    ).set(1)
+    registry.gauge(
+        "horovod_uptime_seconds",
+        "Seconds since this process imported the telemetry layer",
+    ).set_function(lambda: time.monotonic() - _PROCESS_START_MONO)
+    return info
+
+
+# ---------------------------------------------------------------------------
 # Cross-rank aggregation (coordinator side).
 
 def encode_push(registry: MetricsRegistry, rank: int,

@@ -1,0 +1,437 @@
+"""On-box metrics time-series: a bounded ring of periodic registry
+samples with derived series computed at read time (docs/health.md).
+
+Every observability layer before this one (PR 2 counters, PR 6 spans,
+the serving histograms) is point-in-time: a scrape answers "how much so
+far", never "how fast right now" or "is this getting worse". This
+module is the history: a daemon sampler thread snapshots the telemetry
+registry every ``HOROVOD_METRICS_SAMPLE_SECONDS`` into a fixed-capacity
+ring (``HOROVOD_METRICS_HISTORY_SAMPLES`` entries — bounded memory like
+the PR 6 flight-recorder ring, overwrites counted), and everything
+interesting is DERIVED at read time, never at sample time:
+
+* **counter rates** — delta/sec over a window, summing consecutive
+  positive deltas so a counter reset (engine re-init during an elastic
+  reset) contributes the post-reset value instead of a huge negative
+  spike (the Prometheus ``rate()`` reset rule);
+* **windowed histogram quantiles** — the registry's log2 buckets make
+  a within-window p50/p99 one subtraction per bucket: cumulative-walk
+  the bucket-count deltas between the window edges and interpolate
+  inside the crossing bucket;
+* **gauge windows** — min/max/last over the window.
+
+Sampling reuses ``MetricsRegistry.snapshot()`` (the machinery the
+exporters already use), so the data-plane hot path pays nothing — the
+only cost is one snapshot per cadence tick on a daemon thread. The
+ring feeds the ``/timeseries`` view, the alert engine
+(common/alerts.py, evaluated on each sample tick), and the failure
+post-mortem (engine/engine.py dumps the scalar series next to the
+flight recorder, so a failure report carries the last N minutes of
+every key series, not just spans).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+# One sample: (wall clock s, monotonic s, full registry snapshot).
+Sample = Tuple[float, float, dict]
+
+
+# ---------------------------------------------------------------------------
+# Derived-series math (pure functions over sample lists; unit-testable
+# on synthetic data without threads or clocks).
+
+def counter_rate(samples: List[Sample], key: str,
+                 window_s: float, now: Optional[float] = None
+                 ) -> Optional[float]:
+    """Per-second rate of a counter over the trailing window.
+
+    Consecutive-pair deltas are summed with the Prometheus reset rule:
+    a sample smaller than its predecessor means the counter restarted
+    (elastic re-init), so that pair contributes the post-reset value —
+    never a negative delta. None when fewer than two in-window samples
+    exist (no rate is better than a made-up one)."""
+    win = _in_window(samples, window_s, now)
+    pts = [(mono, snap.get(key)) for _, mono, snap in win
+           if isinstance(snap.get(key), (int, float))]
+    if len(pts) < 2:
+        return None
+    total = 0.0
+    for (_, prev), (_, cur) in zip(pts, pts[1:]):
+        total += cur - prev if cur >= prev else cur
+    dt = pts[-1][0] - pts[0][0]
+    if dt <= 0:
+        return None
+    return total / dt
+
+
+def histogram_window(samples: List[Sample], key: str,
+                     window_s: float, now: Optional[float] = None
+                     ) -> Optional[dict]:
+    """Bucket-count deltas of a histogram across the trailing window:
+    ``{count, sum, bounds, counts}`` shaped exactly like a registry
+    histogram snapshot, but covering only observations inside the
+    window. Both edges honor `now`: the upper edge is the newest
+    sample at-or-before `now` (so a trailing-baseline window ending in
+    the past never absorbs newer observations), the base the newest
+    sample at-or-before `now - window_s` (zeros when history is
+    younger than the window — then the "window" is process lifetime).
+    A reset (any bucket shrank) falls back to the upper sample's
+    absolute counts: everything it holds happened after the restart."""
+    if not samples:
+        return None
+    now = samples[-1][1] if now is None else now
+    cur = None
+    cur_idx = -1
+    for i in range(len(samples) - 1, -1, -1):
+        if samples[i][1] <= now:
+            cand = samples[i][2].get(key)
+            if isinstance(cand, dict) and "counts" in cand:
+                cur = cand
+                cur_idx = i
+            break
+    if cur is None:
+        return None
+    base = None
+    for i in range(cur_idx - 1, -1, -1):
+        if samples[i][1] <= now - window_s:
+            cand = samples[i][2].get(key)
+            if isinstance(cand, dict) and "counts" in cand:
+                base = cand
+            break
+    if base is not None and (
+            len(base["counts"]) != len(cur["counts"])
+            or any(c < b for c, b in zip(cur["counts"], base["counts"]))):
+        base = None  # reset (or re-registered shape): delta from zero
+    if base is None:
+        counts = list(cur["counts"])
+        count = cur.get("count", sum(counts))
+        hsum = cur.get("sum", 0.0)
+    else:
+        counts = [c - b for c, b in zip(cur["counts"], base["counts"])]
+        count = cur.get("count", 0) - base.get("count", 0)
+        hsum = cur.get("sum", 0.0) - base.get("sum", 0.0)
+    return {"count": count, "sum": hsum,
+            "bounds": list(cur["bounds"]), "counts": counts}
+
+
+def quantile_from_counts(bounds: List[float], counts: List[int],
+                         q: float) -> Optional[float]:
+    """Quantile from log2 bucket counts (the last entry is +Inf):
+    cumulative walk, linear interpolation inside the crossing bucket.
+    The +Inf bucket reports the highest finite bound (the
+    histogram_quantile convention — no upper edge to interpolate to).
+    None on an empty window."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            if i >= len(bounds):  # +Inf bucket
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (target - cum) / c
+        cum += c
+    return float(bounds[-1])
+
+
+def window_quantile(samples: List[Sample], key: str, q: float,
+                    window_s: float, now: Optional[float] = None
+                    ) -> Optional[float]:
+    """p-q of a histogram's observations inside the trailing window."""
+    w = histogram_window(samples, key, window_s, now)
+    if w is None:
+        return None
+    return quantile_from_counts(w["bounds"], w["counts"], q)
+
+
+def gauge_window(samples: List[Sample], key: str,
+                 window_s: float, now: Optional[float] = None
+                 ) -> Optional[dict]:
+    """min/max/last of a scalar series over the trailing window."""
+    vals = [snap.get(key) for _, _, snap in _in_window(samples, window_s, now)
+            if isinstance(snap.get(key), (int, float))]
+    vals = [v for v in vals if v == v]  # drop NaN (dead pull gauges)
+    if not vals:
+        return None
+    return {"min": min(vals), "max": max(vals), "last": vals[-1],
+            "count": len(vals)}
+
+
+def family_items(snapshot: dict, name: str) -> Dict[str, object]:
+    """All series of one metric family: the bare key plus every labeled
+    ``name{...}`` variant (how alert rules scan per-peer gauges)."""
+    prefix = name + "{"
+    return {k: v for k, v in snapshot.items()
+            if k == name or k.startswith(prefix)}
+
+
+def _in_window(samples: List[Sample], window_s: float,
+               now: Optional[float]) -> List[Sample]:
+    if not samples:
+        return []
+    now = samples[-1][1] if now is None else now
+    lo = now - window_s
+    return [s for s in samples if s[1] >= lo]
+
+
+def flatten_scalars(snapshot: dict) -> Dict[str, float]:
+    """Scalar view of one snapshot for compact dumps: counters/gauges
+    verbatim, histograms as ``_count``/``_sum`` (the telemetry
+    ``scalars()`` convention)."""
+    out: Dict[str, float] = {}
+    for k, v in snapshot.items():
+        if isinstance(v, dict):
+            out[f"{k}_count"] = v.get("count", 0)
+            out[f"{k}_sum"] = v.get("sum", 0.0)
+        elif isinstance(v, (int, float)) and v == v:
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The store
+
+class TimeSeriesStore:
+    """Fixed-capacity ring of registry samples plus the derived-series
+    accessors. Appends are O(1) (deque with maxlen); overwrites of
+    never-dumped history are counted — a truncated post-mortem series
+    must not read as the whole story (the SpanRecorder contract)."""
+
+    def __init__(self, capacity: int, registry=None):
+        self.capacity = max(int(capacity), 0)
+        self._buf: deque = deque(maxlen=self.capacity or 1)
+        self._total = 0
+        self._lock = threading.Lock()
+        self._m_samples = None
+        self._m_dropped = None
+        if registry is not None and self.capacity:
+            self._m_samples = registry.counter(
+                "horovod_timeseries_samples_total",
+                "Registry snapshots taken by the on-box sampler")
+            self._m_dropped = registry.counter(
+                "horovod_timeseries_samples_dropped_total",
+                "Sampler ring overwrites (history lost to the bounded "
+                "ring before any dump)")
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def add_sample(self, snapshot: dict, wall: Optional[float] = None,
+                   mono: Optional[float] = None):
+        if not self.capacity:
+            return
+        with self._lock:
+            dropped = len(self._buf) == self.capacity
+            self._buf.append((
+                time.time() if wall is None else wall,
+                time.monotonic() if mono is None else mono,
+                snapshot,
+            ))
+            self._total += 1
+        if self._m_samples is not None:
+            self._m_samples.inc()
+        if dropped and self._m_dropped is not None:
+            self._m_dropped.inc()
+
+    def samples(self, window_s: Optional[float] = None) -> List[Sample]:
+        with self._lock:
+            out = list(self._buf)
+        if window_s is not None:
+            out = _in_window(out, window_s, None)
+        return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(self._total - len(self._buf), 0)
+
+    def last_age(self) -> float:
+        """Seconds since the newest sample; -1 before the first (the
+        alert engine's staleness guard reads this)."""
+        with self._lock:
+            if not self._buf:
+                return -1.0
+            return max(time.monotonic() - self._buf[-1][1], 0.0)
+
+    # -- derived accessors ---------------------------------------------
+    def rate(self, key: str, window_s: float) -> Optional[float]:
+        return counter_rate(self.samples(), key, window_s)
+
+    def quantile(self, key: str, q: float, window_s: float,
+                 now: Optional[float] = None) -> Optional[float]:
+        return window_quantile(self.samples(), key, q, window_s, now)
+
+    def hist_window(self, key: str, window_s: float,
+                    now: Optional[float] = None) -> Optional[dict]:
+        return histogram_window(self.samples(), key, window_s, now)
+
+    def window(self, key: str, window_s: float) -> Optional[dict]:
+        return gauge_window(self.samples(), key, window_s)
+
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._buf[-1][2] if self._buf else None
+
+    def series(self, key: str, window_s: Optional[float] = None
+               ) -> List[Tuple[float, float]]:
+        """[(wall_s, value)] points of one scalar series."""
+        return [(wall, snap[key]) for wall, _, snap
+                in self.samples(window_s)
+                if isinstance(snap.get(key), (int, float))]
+
+    # -- rendering ------------------------------------------------------
+    def view(self, window_s: float = 300.0,
+             max_points: int = 120) -> dict:
+        """The /timeseries body: ring state, a derived table for every
+        series (counter rate, histogram windowed p50/p99, gauge
+        min/max/last), and raw scalar points capped at `max_points`
+        (newest kept)."""
+        samples = self.samples()
+        derived: Dict[str, dict] = {}
+        points: Dict[str, list] = {}
+        latest = samples[-1][2] if samples else {}
+        for key, val in sorted(latest.items()):
+            if isinstance(val, dict):
+                w = histogram_window(samples, key, window_s)
+                if w is None:
+                    continue
+                derived[key] = {
+                    "kind": "histogram",
+                    "window_count": w["count"],
+                    "p50": quantile_from_counts(
+                        w["bounds"], w["counts"], 0.5),
+                    "p99": quantile_from_counts(
+                        w["bounds"], w["counts"], 0.99),
+                }
+            elif isinstance(val, (int, float)):
+                rate = counter_rate(samples, key, window_s)
+                gw = gauge_window(samples, key, window_s)
+                d = {"kind": "scalar", "last": val}
+                if rate is not None:
+                    d["rate_per_s"] = rate
+                if gw is not None:
+                    d["min"], d["max"] = gw["min"], gw["max"]
+                derived[key] = d
+                pts = [(round(wall, 3), snap[key])
+                       for wall, _, snap in samples
+                       if isinstance(snap.get(key), (int, float))]
+                points[key] = pts[-max_points:]
+        return {
+            "capacity": self.capacity,
+            "depth": len(samples),
+            "dropped": self.dropped,
+            "window_seconds": window_s,
+            "derived": derived,
+            "points": points,
+        }
+
+    def dump_scalars(self, max_samples: int = 120) -> dict:
+        """Compact scalar history for the post-mortem: the newest
+        `max_samples` samples, histograms flattened to _count/_sum."""
+        samples = self.samples()[-max_samples:]
+        return {
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "samples": [
+                [round(wall, 3), flatten_scalars(snap)]
+                for wall, _, snap in samples
+            ],
+        }
+
+
+# ---------------------------------------------------------------------------
+# The sampler thread
+
+class MetricsSampler:
+    """Daemon thread snapshotting `registry` into a TimeSeriesStore
+    every `interval` seconds, with tick callbacks (the alert engine
+    registers one) invoked after each sample lands. One per engine,
+    like the registry itself — the in-process multi-rank harness keeps
+    per-"rank" history separable."""
+
+    def __init__(self, registry, capacity: Optional[int] = None,
+                 interval: Optional[float] = None):
+        if capacity is None:
+            capacity = env_cfg.metrics_history_samples()
+        if interval is None:
+            interval = env_cfg.metrics_sample_seconds()
+        self.registry = registry
+        self.interval = interval
+        self.store = TimeSeriesStore(
+            capacity if interval > 0 else 0, registry=registry)
+        self._callbacks: List[Callable[[TimeSeriesStore], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.store.enabled and self.interval > 0
+
+    def add_tick_callback(self, fn: Callable[[TimeSeriesStore], None]):
+        self._callbacks.append(fn)
+
+    def sample_once(self):
+        if not self.store.enabled:
+            return
+        try:
+            snap = self.registry.snapshot()
+        except Exception:  # a broken pull gauge must not kill the loop
+            logger.exception("metrics sample failed")
+            return
+        self.store.add_sample(snap)
+        for fn in list(self._callbacks):
+            try:
+                fn(self.store)
+            except Exception:
+                logger.exception("sampler tick callback failed")
+
+    def start(self) -> "MetricsSampler":
+        if not self.enabled or self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-metrics-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        # First sample immediately: short-lived jobs (and smokes) get a
+        # baseline before the first full interval elapses.
+        self.sample_once()
+        while not self._stop.wait(self.interval):
+            self.sample_once()
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def status(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "interval_seconds": self.interval,
+            "capacity": self.store.capacity,
+            "depth": self.store.depth(),
+            "dropped": self.store.dropped,
+            "last_sample_age_seconds": round(self.store.last_age(), 3),
+        }

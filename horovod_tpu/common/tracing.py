@@ -600,6 +600,14 @@ def stitch_post_mortem(trace_dir: str, verdict: str = "",
                     "reason": d.get("reason", ""),
                     "events": len(d.get("events", [])),
                     "dropped": d.get("dropped", 0),
+                    # Health plane (docs/health.md): the flight dump
+                    # carries the rank's scalar time-series and latched
+                    # alerts; the summary counts them so a reader knows
+                    # which flight file holds history worth opening.
+                    "timeseries_samples": len(
+                        (d.get("timeseries") or {}).get("samples", [])),
+                    "alerts_firing": (d.get("alerts") or {}).get(
+                        "firing", []),
                 } for d in docs
             },
         },

@@ -176,6 +176,15 @@ class Controller:
         self.trace_collector = (
             tracing.TraceCollector(size) if self.is_coordinator else None)
         self._trace_cursor = 0
+        # -- health plane (common/alerts.py, docs/health.md) -----------
+        # Per-rank alert state rides the same telemetry piggyback:
+        # `alert_push` (a callable returning the rank's firing set) is
+        # merged into the push blob; `alert_sink` (rank 0's FleetAlerts)
+        # ingests every gathered blob. Both wired by Engine.start() —
+        # None until then, and None forever when the health plane is
+        # off.
+        self.alert_push = None
+        self.alert_sink = None
         # Per-tensor request-arrival stamps (coordinator): feed the
         # NEGOTIATE span and the straggler attribution gauges — the
         # rank whose request lands last is the one everyone waited for.
@@ -329,13 +338,18 @@ class Controller:
                 # Tracing piggyback: new flight-recorder events since
                 # the last push ride the same blob, so trace collection
                 # costs no extra control round (docs/tracing.md).
-                extra = None
+                extra = {}
                 if self.tracer is not None and self.tracer.enabled:
                     evs, self._trace_cursor = \
                         self.tracer.recorder.batch_since(self._trace_cursor)
                     extra = {"spans": evs, "anchor": clock.anchor_meta()}
+                if self.alert_push is not None:
+                    try:
+                        extra["alerts"] = self.alert_push()
+                    except Exception:  # alerts must never stall a cycle
+                        pass
                 req_list.telemetry = _telemetry.encode_push(
-                    self.registry, self.rank, extra=extra)
+                    self.registry, self.rank, extra=extra or None)
             try:
                 with self._span("ctrl.gather"):
                     gathered = self.transport.gather_bytes(
@@ -365,6 +379,9 @@ class Controller:
                                               rank_hint=peer_rank)
                         if self.trace_collector is not None:
                             self.trace_collector.ingest_blob(
+                                peer_rank, rl.telemetry)
+                        if self.alert_sink is not None:
+                            self.alert_sink.ingest_blob(
                                 peer_rank, rl.telemetry)
                     shutdown = shutdown or rl.shutdown
                     for req in rl.requests:

@@ -289,6 +289,12 @@ class Engine:
         # Liveness plane (common/health.py); armed by the background
         # loop once the backend exists, when heartbeats are enabled.
         self._health = None
+        # Health plane (common/timeseries.py + common/alerts.py,
+        # docs/health.md): the on-box sampler ring and the alert engine
+        # evaluated on its ticks; armed by start() after init succeeds.
+        self.sampler = None
+        self.alerts = None
+        self._fleet_alerts = None
         # Event-driven cycles: enqueues (and shutdown) set the event so
         # HOROVOD_CYCLE_TIME is a max-coalescing delay, not a floor.
         self._wake = threading.Event()
@@ -400,6 +406,23 @@ class Engine:
         health = self._health
         if health is not None:
             st["health"] = health.status()
+        # Health plane (docs/health.md): sampler ring state + latched
+        # alert verdicts, the "is anything wrong RIGHT NOW" section.
+        # Locals: shutdown nulls these fields concurrently with status
+        # scrapes.
+        sampler, alert_eng = self.sampler, self.alerts
+        fleet_alerts = self._fleet_alerts
+        if sampler is not None:
+            st["timeseries"] = sampler.status()
+        if alert_eng is not None:
+            alerts_st = alert_eng.status()
+            st["alerts"] = {
+                "stale": alerts_st["stale"],
+                "firing": alerts_st["firing"],
+            }
+            if fleet_alerts is not None:
+                st["alerts"]["fleet"] = \
+                    fleet_alerts.snapshot()["firing_by_rule"]
         # Durability plane: last committed/pending checkpoint step,
         # last error (docs/checkpoint.md). The manager is owned by the
         # elastic run loop, not the engine — report whichever one is
@@ -438,6 +461,27 @@ class Engine:
                 st["fleet"] = ctrl.fleet.snapshot()
         return st
 
+    # -- health-plane views (docs/health.md) ----------------------------
+    def _timeseries_view(self) -> dict:
+        """The /timeseries body: ring state, derived rates/quantiles/
+        windows for every series, raw scalar points."""
+        sampler = self.sampler
+        if sampler is None:
+            return {"enabled": False}
+        return sampler.store.view()
+
+    def _alerts_view(self) -> dict:
+        """The /alerts body: this rank's rule states plus (coordinator)
+        the fleet fold naming which rank each alert fires on."""
+        alert_eng, fleet_alerts = self.alerts, self._fleet_alerts
+        body: dict = {
+            "local": alert_eng.status() if alert_eng is not None
+            else {"enabled": False},
+        }
+        if fleet_alerts is not None:
+            body["fleet"] = fleet_alerts.snapshot()
+        return body
+
     # ------------------------------------------------------------------
     def start(self):
         self._thread = threading.Thread(
@@ -460,6 +504,33 @@ class Engine:
             rank=self.rank,
             trace_fn=(self._trace_json if self.rank == 0 else None),
         )
+        # Health plane (docs/health.md): sampler ring + alert engine,
+        # default-on with bounded memory (the flight-recorder bar);
+        # HOROVOD_METRICS_HISTORY_SAMPLES=0 or _SAMPLE_SECONDS=0 turns
+        # it off entirely — no thread, no ring, no rules.
+        if env_cfg.health_plane_enabled():
+            from ..common import alerts as alerts_mod
+            from ..common import timeseries as ts_mod
+
+            self.sampler = ts_mod.MetricsSampler(self.registry)
+            self.alerts = alerts_mod.AlertEngine(
+                self.sampler.store, self.registry, tracer=self.tracer,
+                stale_after=3 * max(self.sampler.interval, 1.0))
+            self.sampler.add_tick_callback(self.alerts.evaluate)
+            ctrl = self.controller
+            if ctrl is not None:
+                # Per-rank alert state rides the telemetry piggyback;
+                # rank 0 folds it so /alerts names the offending rank
+                # fleet-wide (the liveness-verdict attribution bar).
+                ctrl.alert_push = self.alerts.push_state
+                if ctrl.is_coordinator:
+                    self._fleet_alerts = alerts_mod.FleetAlerts(self.size)
+                    ctrl.alert_sink = self._fleet_alerts
+            self.sampler.start()
+            for exp in self._exporters:
+                if isinstance(exp, metrics_export.MetricsHTTPServer):
+                    exp.add_view("timeseries", self._timeseries_view)
+                    exp.add_view("alerts", self._alerts_view)
 
     def _background_loop(self):
         try:
@@ -1195,9 +1266,20 @@ class Engine:
         self._pm_dumped = True
         os.makedirs(trace_dir, exist_ok=True)
         health = self._health.status() if self._health is not None else None
+        extra = {"reason": str(exc), "health": health}
+        # Health plane: the last N minutes of every scalar series plus
+        # any latched alerts ride the flight dump, so the post-mortem
+        # answers "what was trending wrong BEFORE it died", not just
+        # "what were the final spans".
+        sampler, alert_eng = self.sampler, self.alerts
+        if sampler is not None:
+            sampler.sample_once()  # capture the dying state too
+            extra["timeseries"] = sampler.store.dump_scalars()
+        if alert_eng is not None:
+            extra["alerts"] = alert_eng.status()
         path = self.tracer.dump_flight(
             tracing.flight_path(trace_dir, self.rank), self.rank,
-            extra={"reason": str(exc), "health": health})
+            extra=extra)
         logger.error("flight recorder dumped to %s", path)
 
     def _stitch_post_mortem(self):
@@ -1232,6 +1314,13 @@ class Engine:
         self._wake.set()  # end any coalescing wait immediately
         self._thread.join(timeout=60)
         self._thread = None
+        # Health plane down first: a final sample captures shutdown
+        # state, then no tick may fire against a dying registry.
+        if self.sampler is not None:
+            self.sampler.stop()
+            self.sampler = None
+            self.alerts = None
+            self._fleet_alerts = None
         # Trace file AFTER the loop died (the final negotiation rounds'
         # span batches have been collected) but BEFORE exporters stop.
         self._write_trace_file()

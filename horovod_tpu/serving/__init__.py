@@ -91,14 +91,23 @@ def serve(model_fn: Callable, weights=None,
                     port=port, registry=rs.registry,
                     status_fn=rs.status).start()
             _register_view(rs, frontend)
+            _wire_alert_rules(frontend)
             if max_requests is not None:
                 _arm_request_cap(frontend, rs, max_requests)
+            def on_remesh():
+                # An eviction re-inits the engine (new exporters, a
+                # fresh AlertEngine built from defaults+env): the
+                # /serving view must follow onto the new endpoint AND
+                # the serving rules must be re-wired with the live
+                # frontend config, or the new engine alerts against
+                # the env defaults instead of the actual queue bound.
+                _register_view(rs, frontend)
+                _wire_alert_rules(frontend)
+
             coord = ServingCoordinator(
                 rs, frontend, tick_seconds=tick_seconds,
                 rendezvous=rendezvous,
-                # An eviction re-inits the engine (new exporters); the
-                # /serving view must follow it onto the new endpoint.
-                on_remesh=lambda: _register_view(rs, frontend))
+                on_remesh=on_remesh)
             report = coord.run()
             report["port"] = frontend.port
             return report
@@ -123,11 +132,33 @@ def _register_view(rs: ReplicaSet, frontend: InferenceFrontend):
     def view():
         st = rs.status()
         st["frontend"] = frontend.basic_status()
+        st["slo_p99_ms"] = env_cfg.serving_slo_p99_ms() or None
         return st
 
     for exp in getattr(eng, "_exporters", []):
         if isinstance(exp, MetricsHTTPServer):
             exp.add_view("serving", view)
+
+
+def _wire_alert_rules(frontend: InferenceFrontend):
+    """Refresh the serving-specific alert rules (docs/health.md) with
+    this plane's LIVE configuration: the admission-saturation bound
+    follows the frontend's actual queue capacity (a programmatic
+    frontend may differ from the env default), and the p99 SLO target
+    re-reads HOROVOD_SERVING_SLO_P99_MS in case it was set after
+    hvd.init() armed the defaults. Parameters the user pinned via
+    HOROVOD_ALERT_RULES win over both derived values."""
+    eng = basics.engine()
+    alerts_eng = getattr(eng, "alerts", None) if eng is not None else None
+    if alerts_eng is None:
+        return
+    for rule in alerts_eng.rules:
+        if (rule.name == "admission_queue_saturated"
+                and "threshold" not in rule._overridden):
+            rule.threshold = 0.9 * frontend.queue.maxsize
+        elif (rule.name == "serving_p99_slo"
+                and "target_s" not in rule._overridden):
+            rule.target_s = env_cfg.serving_slo_p99_ms() / 1e3
 
 
 def _unregister_view():

@@ -259,6 +259,34 @@ DEFAULT_SERVING_QUEUE_DEPTH = 256
 DEFAULT_SERVING_REQUEST_TIMEOUT = 30.0
 DEFAULT_SERVING_WEIGHT_REFRESH = 10.0
 
+# -- health plane knobs (docs/health.md) -------------------------------
+# Cadence of the on-box metrics sampler: a daemon thread snapshots the
+# registry every this-many seconds into a bounded in-memory ring
+# (common/timeseries.py) — the history behind /timeseries, the alert
+# engine and the post-mortem series dump. Reuses the existing
+# snapshot() machinery, so the hot path pays nothing. <= 0 disables
+# the health plane.
+METRICS_SAMPLE_SECONDS = "HOROVOD_METRICS_SAMPLE_SECONDS"
+# Ring capacity in samples (default 360 = one hour at the 10 s default
+# cadence). Bounded memory like the flight-recorder ring; overwrites
+# are counted in horovod_timeseries_samples_dropped_total. 0 disables
+# the health plane.
+METRICS_HISTORY_SAMPLES = "HOROVOD_METRICS_HISTORY_SAMPLES"
+# Alert rule list: enable/disable/override the built-in default rules
+# (common/alerts.py; docs/health.md "Rule grammar"). Comma-separated
+# tokens: `-name` disables a default, `name` keeps it, and
+# `name:param=value:param=value` overrides its parameters; `none`/`off`
+# disables every rule. Empty (the default) = all defaults armed.
+ALERT_RULES = "HOROVOD_ALERT_RULES"
+# Serving latency SLO: fires the serving_p99_slo burn-rate alert when
+# the windowed p99 of horovod_serving_request_seconds exceeds this
+# target in BOTH the fast and slow windows (multi-window burn-rate, so
+# a single spike never pages). 0 (default) disarms the rule.
+SERVING_SLO_P99_MS = "HOROVOD_SERVING_SLO_P99_MS"
+
+DEFAULT_METRICS_SAMPLE_SECONDS = 10.0
+DEFAULT_METRICS_HISTORY_SAMPLES = 360
+
 # -- telemetry knobs (docs/metrics.md) ---------------------------------
 # Serve Prometheus text at /metrics and live job state at /status from a
 # daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
@@ -554,6 +582,33 @@ def serving_weight_refresh_seconds() -> float:
     """Manifest-watch poll cadence; 0 disables weight hot-swap."""
     return max(get_float(SERVING_WEIGHT_REFRESH,
                          DEFAULT_SERVING_WEIGHT_REFRESH), 0.0)
+
+
+def metrics_sample_seconds() -> float:
+    """On-box sampler cadence; <= 0 disables the health plane. Floored
+    at 50 ms so a typo cannot turn the sampler into a busy loop."""
+    v = get_float(METRICS_SAMPLE_SECONDS, DEFAULT_METRICS_SAMPLE_SECONDS)
+    return max(v, 0.05) if v > 0 else 0.0
+
+
+def metrics_history_samples() -> int:
+    """Sampler ring capacity in samples; 0 disables the health plane."""
+    return max(get_int(METRICS_HISTORY_SAMPLES,
+                       DEFAULT_METRICS_HISTORY_SAMPLES), 0)
+
+
+def health_plane_enabled() -> bool:
+    return metrics_sample_seconds() > 0 and metrics_history_samples() > 0
+
+
+def alert_rules_spec() -> str:
+    """Raw HOROVOD_ALERT_RULES token list (parsed by common/alerts.py)."""
+    return get_str(ALERT_RULES, "")
+
+
+def serving_slo_p99_ms() -> float:
+    """Serving p99 latency SLO target in ms; 0 disarms the rule."""
+    return max(get_float(SERVING_SLO_P99_MS, 0.0), 0.0)
 
 
 def metrics_sync_seconds() -> float:

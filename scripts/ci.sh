@@ -11,7 +11,7 @@ python -m pytest tests/ -x -q -m 'not slow'
 echo "=== slow tier (full adapter / chaos coverage) ==="
 python -m pytest tests/ -x -q -m slow
 
-echo "=== telemetry smoke (metrics endpoint + snapshot) ==="
+echo "=== telemetry smoke (metrics endpoint + snapshot + health plane: /timeseries, /alerts, straggler fire/resolve) ==="
 python scripts/telemetry_smoke.py
 
 echo "=== tracing smoke (merged /trace + post-mortem on injected sever) ==="
@@ -34,6 +34,18 @@ python scripts/checkpoint_smoke.py --overhead
 
 echo "=== serving smoke (4-rank continuous batching: p50/p99 under concurrent load, weight hot-swap mid-traffic, wedged-replica eviction) ==="
 python scripts/serving_smoke.py
+
+echo "=== perf report (warn vs committed BENCH_BASELINE.json; docs/health.md) ==="
+python scripts/perf_report.py --quick --out /tmp/hvd_perf1.json
+
+echo "=== perf gate self-test (clean back-to-back must pass; injected 2x slowdown must trip) ==="
+python scripts/perf_report.py --quick --out /tmp/hvd_perf2.json \
+    --baseline /tmp/hvd_perf1.json --gate
+if python scripts/perf_report.py --replay /tmp/hvd_perf2.json \
+    --baseline /tmp/hvd_perf1.json --inject-slowdown 2.0 --gate; then
+  echo "perf gate FAILED TO TRIP on an injected 2x slowdown"
+  exit 1
+fi
 
 echo "=== multichip sharding dryrun (8 virtual devices) ==="
 python __graft_entry__.py

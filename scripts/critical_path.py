@@ -22,6 +22,12 @@ The summary aggregates phase totals and names the worst stragglers
     python scripts/critical_path.py trace.json
     python scripts/critical_path.py postmortem.json --top 10
     curl -s localhost:9099/trace | python scripts/critical_path.py -
+    python scripts/critical_path.py --from-url http://localhost:9099
+
+``--from-url`` pulls the live ``/trace`` endpoint of a RUNNING job
+(the rank-0 metrics server, docs/health.md) — straggler attribution
+without waiting for a shutdown dump. A bare host:port or a full URL
+(with or without the /trace path) are all accepted.
 """
 from __future__ import annotations
 
@@ -41,6 +47,20 @@ def load_events(path: str):
         doc = json.load(sys.stdin)
     else:
         doc = chrome_trace.read_trace_file(path)
+    return chrome_trace.trace_events(doc), doc
+
+
+def fetch_url(url: str, timeout: float = 30.0):
+    """GET a live /trace endpoint. Accepts host:port, http://host:port,
+    or a full .../trace URL."""
+    import urllib.request
+
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/trace"):
+        url = url.rstrip("/") + "/trace"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        doc = json.load(resp)
     return chrome_trace.trace_events(doc), doc
 
 
@@ -114,11 +134,20 @@ def analyze(events, top: int = 5):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="merged trace JSON ('-' for stdin)")
+    ap.add_argument("trace", nargs="?",
+                    help="merged trace JSON ('-' for stdin)")
+    ap.add_argument("--from-url", dest="from_url",
+                    help="pull the live /trace endpoint of a running "
+                         "job (host:port or URL) instead of a file")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest collectives to detail")
     args = ap.parse_args()
-    events, doc = load_events(args.trace)
+    if bool(args.trace) == bool(args.from_url):
+        ap.error("give exactly one of a trace file or --from-url")
+    if args.from_url:
+        events, doc = fetch_url(args.from_url)
+    else:
+        events, doc = load_events(args.trace)
     out = analyze(events, top=args.top)
     pm = doc.get("horovod_postmortem") if isinstance(doc, dict) else None
     if pm:

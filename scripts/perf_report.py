@@ -1,0 +1,462 @@
+#!/usr/bin/env python
+"""Standardized perf report + CI regression gate (docs/health.md).
+
+One harness that runs the repo's microbench stages — small-op latency,
+ring / segmented-ring bandwidth, the tcp-vs-shm transport pair, the
+two-level hierarchical allreduce, and a serving round-trip — and emits
+a BENCH-style JSON: medians over order-alternated rounds (the house
+methodology from the PR 3/4/8 acceptance measurements: on a shared box,
+sequential arms measure load drift, so stage order alternates per
+round and the median of rounds is the stage value). The report stamps
+``horovod_build_info`` (version + jax) so every number is attributable
+to a build — the BENCH trajectory stopped being recorded after PR 5;
+this file is how it restarts.
+
+Comparison: every stage is lower-is-better; a stage regresses when
+``value / baseline > 1 + tolerance`` (strictly — the boundary passes).
+Tolerances are per-stage (the baseline file may carry a
+``tolerances`` map) with a generous default, because CI boxes are
+noisy and a flaky gate is worse than none.
+
+CI wiring (scripts/ci.sh): warn-by-default against the committed
+``BENCH_BASELINE.json``; gating is the explicit opt-in (``--gate``).
+The gate itself is proven live on every CI run: a clean back-to-back
+run must pass, and a ``--replay --inject-slowdown 2.0`` of the same
+measurements must trip it.
+
+    python scripts/perf_report.py                         # measure, warn
+    python scripts/perf_report.py --gate                  # measure, gate
+    python scripts/perf_report.py --update-baseline       # refresh baseline
+    python scripts/perf_report.py --replay r.json --baseline b.json \
+        --inject-slowdown 2.0 --gate                      # gate self-test
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "BENCH_BASELINE.json")
+DEFAULT_TOLERANCE = 0.5
+
+SCHEMA = 1
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return float("nan")
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _quantile(sorted_vals, q):
+    return sorted_vals[min(int(q * len(sorted_vals)),
+                           len(sorted_vals) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# Measurement workers (run under the process-mode launcher, like
+# perf_smoke). Each returns {stage: seconds} for ONE round; main()
+# aggregates rounds into medians.
+
+def _engine_worker():
+    """np=2 engine stages: latency / ring / segring / transport, in
+    per-round alternating order."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    hvd.init()
+    eng = basics.engine()
+    rounds = int(os.environ["PERF_ROUNDS"])
+    lat_iters = int(os.environ["PERF_LAT_ITERS"])
+    bw_iters = int(os.environ["PERF_BW_ITERS"])
+    tr_iters = int(os.environ["PERF_TR_ITERS"])
+    lat_x = np.ones(16384, np.float32)     # 64KB
+    bw_x = np.ones(262144, np.float32)     # 1MB
+    tr_x = np.ones(1048576, np.float32)    # 4MB
+
+    def set_algo(ring: bool, seg_bytes: int):
+        os.environ.pop("HOROVOD_CPU_OPERATIONS", None)
+        os.environ["HOROVOD_RING_THRESHOLD"] = "0" if ring else str(1 << 40)
+        os.environ["HOROVOD_RING_SEGMENT_BYTES"] = str(seg_bytes)
+
+    def stage_latency(tag):
+        set_algo(False, 0)
+        name = "pr.lat"
+        for _ in range(3):
+            eng.synchronize(eng.enqueue_allreduce(lat_x, name=name),
+                            timeout=120)
+        hvd.barrier()
+        lats = []
+        for _ in range(lat_iters):
+            t0 = time.perf_counter()
+            eng.synchronize(eng.enqueue_allreduce(lat_x, name=name),
+                            timeout=120)
+            lats.append(time.perf_counter() - t0)
+        hvd.barrier()
+        lats.sort()
+        return _quantile(lats, 0.5)
+
+    def _timed_allreduce(x, name, iters):
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hvd.allreduce(x, name=name, op=hvd.Sum)
+        dt = (time.perf_counter() - t0) / iters
+        hvd.barrier()
+        return dt
+
+    def stage_ring(tag):
+        set_algo(True, 0)
+        return _timed_allreduce(bw_x, "pr.ring", bw_iters)
+
+    def stage_segring(tag):
+        set_algo(True, 1 << 18)
+        return _timed_allreduce(bw_x, "pr.segring", bw_iters)
+
+    def stage_transport(tag):
+        """tcp-vs-shm paired inside the stage (order alternates with
+        the round parity, the PR 8 protocol)."""
+        set_algo(True, 1 << 18)
+
+        def arm(transport):
+            os.environ["HOROVOD_TRANSPORT"] = transport
+            return _timed_allreduce(tr_x, f"pr.tr.{transport}", tr_iters)
+
+        if tag % 2 == 0:
+            tcp = arm("tcp")
+            shm = arm("shm")
+        else:
+            shm = arm("shm")
+            tcp = arm("tcp")
+        os.environ["HOROVOD_TRANSPORT"] = "auto"
+        return {"tcp": tcp, "shm": shm}
+
+    stages = [
+        ("latency_small_p50_s", stage_latency),
+        ("ring_1mb_s", stage_ring),
+        ("segring_1mb_s", stage_segring),
+        ("transport_4mb_s", stage_transport),
+    ]
+    out = {name: [] for name, _ in stages}
+    # Warmup round (negotiation, cache fill, shm establishment) —
+    # discarded.
+    for name, fn in stages:
+        fn(0)
+    for r in range(rounds):
+        order = stages if r % 2 == 0 else list(reversed(stages))
+        for name, fn in order:
+            out[name].append(fn(r))
+    rank = hvd.rank()
+    hvd.shutdown()
+    return {"rank": rank, "stages": out}
+
+
+def _hier_worker():
+    """np=4 simulated 2-host x 2-slot hierarchical allreduce."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rounds = int(os.environ["PERF_ROUNDS"])
+    iters = int(os.environ["PERF_BW_ITERS"])
+    x = np.ones(262144, np.float32)  # 1MB
+    os.environ["HOROVOD_RING_THRESHOLD"] = "0"
+    vals = []
+    for _ in range(3):
+        hvd.allreduce(x, name="pr.hier", op=hvd.Sum)
+    for r in range(rounds):
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hvd.allreduce(x, name="pr.hier", op=hvd.Sum)
+        vals.append((time.perf_counter() - t0) / iters)
+        hvd.barrier()
+    rank = hvd.rank()
+    hvd.shutdown()
+    return {"rank": rank, "hier_1mb_s": vals}
+
+
+def _serving_worker():
+    """np=2 serving round-trip: echo model over the SPMD round
+    protocol, p50 of programmatic submit -> reply."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rounds = int(os.environ["PERF_ROUNDS"])
+    n_req = int(os.environ["PERF_SERVE_REQS"])
+
+    def model_fn(weights, payloads):
+        return [p for p in payloads]
+
+    rank = hvd.rank()
+    if rank != 0:
+        hvd.serving.serve(model_fn, weights={})
+        hvd.shutdown()
+        return {"rank": rank}
+
+    import threading
+
+    from horovod_tpu.serving import InferenceFrontend
+
+    frontend = InferenceFrontend(port=None)
+    vals = []
+
+    def drive():
+        for _ in range(rounds):
+            lats = []
+            for _ in range(n_req):
+                t0 = time.perf_counter()
+                req = frontend.submit(1.0)
+                assert req is not None
+                assert req.wait(timeout=60)
+                lats.append(time.perf_counter() - t0)
+            lats.sort()
+            vals.append(_quantile(lats, 0.5))
+        frontend.request_stop()
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    report = hvd.serving.serve(model_fn, weights={}, frontend=frontend,
+                               tick_seconds=0.05)
+    t.join(timeout=60)
+    hvd.shutdown()
+    return {"rank": 0, "serving_rtt_p50_s": vals, "rounds": report}
+
+
+# ---------------------------------------------------------------------------
+# Harness
+
+def measure(rounds: int, quick: bool) -> dict:
+    from horovod_tpu.common import telemetry
+    from horovod_tpu.runner import run
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_TCP_TIMEOUT_SECONDS": "120",
+        "PERF_ROUNDS": str(rounds),
+        "PERF_LAT_ITERS": "10" if quick else "30",
+        "PERF_BW_ITERS": "3" if quick else "8",
+        "PERF_TR_ITERS": "2" if quick else "4",
+        "PERF_SERVE_REQS": "10" if quick else "30",
+    }
+    stages: dict = {}
+
+    res = run(_engine_worker, np=2,
+              extra_env=dict(env, HOROVOD_TRANSPORT="auto"))
+    r0 = next(r for r in res if r["rank"] == 0)
+    raw = r0["stages"]
+    for name in ("latency_small_p50_s", "ring_1mb_s", "segring_1mb_s"):
+        vals = raw[name]
+        stages[name[:-2] + "_ms"] = {
+            "unit": "ms",
+            "rounds": [round(v * 1e3, 4) for v in vals],
+            "value": round(_median(vals) * 1e3, 4),
+        }
+    tr = raw["transport_4mb_s"]
+    for arm in ("tcp", "shm"):
+        vals = [d[arm] for d in tr]
+        stages[f"transport_{arm}_4mb_ms"] = {
+            "unit": "ms",
+            "rounds": [round(v * 1e3, 4) for v in vals],
+            "value": round(_median(vals) * 1e3, 4),
+        }
+
+    os.environ["HVDRUN_FORCE_LOCAL"] = "1"
+    res = run(_hier_worker, np=4, hosts="hostA:2,hostB:2",
+              extra_env=dict(env, HVDRUN_FORCE_LOCAL="1",
+                             HOROVOD_TRANSPORT="auto",
+                             HOROVOD_HIERARCHICAL_ALLREDUCE="auto"))
+    vals = next(r for r in res if r.get("rank") == 0)["hier_1mb_s"]
+    stages["hier_1mb_ms"] = {
+        "unit": "ms",
+        "rounds": [round(v * 1e3, 4) for v in vals],
+        "value": round(_median(vals) * 1e3, 4),
+    }
+
+    res = run(_serving_worker, np=2, extra_env=env)
+    vals = next(r for r in res if r.get("rank") == 0)["serving_rtt_p50_s"]
+    stages["serving_rtt_p50_ms"] = {
+        "unit": "ms",
+        "rounds": [round(v * 1e3, 4) for v in vals],
+        "value": round(_median(vals) * 1e3, 4),
+    }
+
+    return {
+        "schema": SCHEMA,
+        "kind": "horovod_perf_report",
+        "time": time.time(),
+        "build": telemetry.build_info(),
+        "rounds": rounds,
+        "quick": quick,
+        "stages": stages,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Baseline comparison (pure — unit-tested on synthetic reports)
+
+def compare(report: dict, baseline: dict,
+            default_tolerance: float = DEFAULT_TOLERANCE) -> list:
+    """Per-stage verdicts of `report` against `baseline`. Every stage
+    is lower-is-better; regression iff ratio > 1 + tolerance
+    (STRICTLY — the boundary passes). A stage the baseline names but
+    the report lacks is `missing` (fails the gate: a silently dropped
+    measurement must not read as a pass); NaN measurements are
+    `invalid`; an unusable baseline entry is `skipped` (a broken
+    baseline must not fail every future run); stages only the report
+    has are `new` (informational)."""
+    tolerances = baseline.get("tolerances", {})
+    verdicts = []
+    rep_stages = report.get("stages", {})
+    base_stages = baseline.get("stages", {})
+    for name in sorted(base_stages):
+        tol = float(tolerances.get(name, default_tolerance))
+        base_val = base_stages[name].get("value")
+        ent = {"stage": name, "baseline": base_val, "tolerance": tol}
+        if (not isinstance(base_val, (int, float)) or base_val <= 0
+                or (isinstance(base_val, float) and math.isnan(base_val))):
+            ent.update(status="skipped", value=None, ratio=None)
+            verdicts.append(ent)
+            continue
+        rep = rep_stages.get(name)
+        val = rep.get("value") if isinstance(rep, dict) else None
+        if rep is None:
+            ent.update(status="missing", value=None, ratio=None)
+            verdicts.append(ent)
+            continue
+        if (not isinstance(val, (int, float))
+                or (isinstance(val, float) and math.isnan(val))):
+            ent.update(status="invalid", value=val, ratio=None)
+            verdicts.append(ent)
+            continue
+        ratio = val / base_val
+        ent.update(
+            status="regression" if ratio > 1.0 + tol else "ok",
+            value=val, ratio=round(ratio, 4))
+        verdicts.append(ent)
+    for name in sorted(set(rep_stages) - set(base_stages)):
+        rep = rep_stages[name]
+        verdicts.append({
+            "stage": name, "status": "new",
+            "value": rep.get("value") if isinstance(rep, dict) else None,
+            "baseline": None, "ratio": None, "tolerance": None,
+        })
+    return verdicts
+
+
+GATE_FAIL_STATES = ("regression", "missing", "invalid")
+
+
+def gate_verdict(verdicts: list) -> bool:
+    """True = pass. missing/invalid fail alongside regressions: a
+    gate that can be passed by not measuring is not a gate."""
+    return not any(v["status"] in GATE_FAIL_STATES for v in verdicts)
+
+
+def render(verdicts: list) -> str:
+    lines = [f"{'stage':<26} {'value':>12} {'baseline':>12} "
+             f"{'ratio':>7} {'tol':>5}  status"]
+    for v in verdicts:
+        val = f"{v['value']:.3f}" if isinstance(
+            v["value"], (int, float)) else "-"
+        base = f"{v['baseline']:.3f}" if isinstance(
+            v["baseline"], (int, float)) else "-"
+        ratio = f"{v['ratio']:.3f}" if v["ratio"] is not None else "-"
+        tol = f"{v['tolerance']:.2f}" if v["tolerance"] is not None else "-"
+        lines.append(f"{v['stage']:<26} {val:>12} {base:>12} "
+                     f"{ratio:>7} {tol:>5}  {v['status']}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", help="write the measured report JSON here")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline report to compare against "
+                         "(default: BENCH_BASELINE.json)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 on regression/missing/invalid "
+                         "(default: warn only)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="default relative tolerance (baseline "
+                         "`tolerances` map overrides per stage)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="order-alternated measurement rounds")
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer iterations per stage (CI budget)")
+    ap.add_argument("--replay",
+                    help="skip measurement; load stage values from this "
+                         "existing report (gate self-tests)")
+    ap.add_argument("--inject-slowdown", type=float, default=0.0,
+                    help="multiply every measured stage value by this "
+                         "factor after measurement — proves the gate "
+                         "trips (self-test)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the measured report to the baseline path")
+    args = ap.parse_args()
+
+    if args.replay:
+        with open(args.replay) as f:
+            report = json.load(f)
+    else:
+        report = measure(args.rounds, args.quick)
+
+    if args.inject_slowdown > 0:
+        report = json.loads(json.dumps(report))  # deep copy
+        for st in report["stages"].values():
+            if isinstance(st.get("value"), (int, float)):
+                st["value"] = st["value"] * args.inject_slowdown
+        report["injected_slowdown"] = args.inject_slowdown
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+
+    if args.update_baseline:
+        with open(args.baseline, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"baseline updated: {args.baseline}")
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline}; report only")
+        print(json.dumps(report["stages"], indent=1, sort_keys=True))
+        return 0
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    verdicts = compare(report, baseline, args.tolerance)
+    print(render(verdicts))
+    print(json.dumps({
+        "metric": "perf_report",
+        "build": report.get("build"),
+        "gate": args.gate,
+        "pass": gate_verdict(verdicts),
+        "stages": {v["stage"]: v["status"] for v in verdicts},
+    }))
+    if not gate_verdict(verdicts):
+        bad = [v for v in verdicts if v["status"] in GATE_FAIL_STATES]
+        msg = ", ".join(f"{v['stage']}={v['status']}" for v in bad)
+        if args.gate:
+            print(f"PERF GATE FAILED: {msg}", file=sys.stderr)
+            return 1
+        print(f"perf regression WARNING (not gating): {msg}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,21 @@
 #!/usr/bin/env python
-"""Telemetry smoke: a real 2-worker run with the metrics endpoint live.
+"""Telemetry smoke: real 2-worker runs with the metrics endpoint live.
 
-Validates the acceptance surface of docs/metrics.md end to end:
-HOROVOD_METRICS_PORT serves Prometheus text at /metrics and per-rank
-state at /status while collectives run, and hvd.metrics() reports
+Phase 1 validates the acceptance surface of docs/metrics.md end to
+end: HOROVOD_METRICS_PORT serves Prometheus text at /metrics and
+per-rank state at /status while collectives run, hvd.metrics() reports
 non-zero allreduce bytes, cycle-time histogram counts and a response
-cache hit rate. Run by scripts/ci.sh; also a manual repro tool:
+cache hit rate — and the health plane (docs/health.md) is live:
+/timeseries holds samples with derived series, /alerts lists the rule
+set, and the build-info gauge is scrapable.
+
+Phase 2 is the health-plane acceptance scenario: rank 1 arms a
+deterministic `delay` fault (the chaos harness) on its own data-plane
+sends, making it the persistent straggler; rank 0 polls its /alerts
+endpoint until `persistent_straggler` latches FIRING with rank 1 named
+in the detail, the ranks then coordinate clearing the fault over an
+ordinary allreduce, and rank 0 polls until the alert RESOLVES. Run by
+scripts/ci.sh; also a manual repro tool:
 
     python scripts/telemetry_smoke.py
 """
@@ -58,8 +68,35 @@ def worker():
         prom = conn.getresponse().read().decode()
         assert "horovod_allreduce_bytes_total" in prom, prom[:500]
         assert "horovod_cycle_seconds_bucket" in prom, prom[:500]
+        # Build identity rides the default registry (docs/health.md).
+        assert "horovod_build_info" in prom, prom[:500]
+        assert "horovod_uptime_seconds" in prom, prom[:500]
+        # Health plane: /timeseries serves the sampler ring (wait for
+        # the first tick) with derived series; /alerts serves the rule
+        # table with no false positives on a healthy mesh.
+        import time as _time
+
+        deadline = _time.monotonic() + 15
+        tsbody = {}
+        while _time.monotonic() < deadline:
+            conn.request("GET", "/timeseries")
+            tsbody = json.loads(conn.getresponse().read())
+            if tsbody.get("depth", 0) >= 2 and \
+                    "horovod_cycle_seconds" in tsbody.get("derived", {}):
+                break
+            _time.sleep(0.1)
+        assert tsbody.get("depth", 0) >= 2, tsbody
+        assert "horovod_allreduce_bytes_total" in tsbody["derived"], \
+            sorted(tsbody["derived"])[:10]
+        conn.request("GET", "/alerts")
+        alerts = json.loads(conn.getresponse().read())
+        assert "persistent_straggler" in alerts["local"]["rules"], alerts
+        assert alerts["local"]["firing"] == [], alerts
+        assert "fleet" in alerts, alerts
         conn.request("GET", "/status")
         status = json.loads(conn.getresponse().read())
+        assert "timeseries" in status and "alerts" in status, \
+            sorted(status)
         assert status["rank"] == 0 and status["size"] == 2, status
         assert "fleet" in status, status
         # Pipelined-execution view: per-channel executor state + the
@@ -74,6 +111,80 @@ def worker():
     return checks
 
 
+def worker_straggler():
+    """Health-plane acceptance: rank 1 arms a delay fault on its own
+    sends (it becomes the straggler every negotiation); rank 0 watches
+    /alerts until the rank-attributed fire, the ranks coordinate the
+    clear over an allreduce, and rank 0 watches until the resolve."""
+    import http.client
+    import json
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics, fault_injection
+    from horovod_tpu.common.fault_injection import Rule
+    from horovod_tpu.common.metrics_export import MetricsHTTPServer
+
+    hvd.init()
+    r = hvd.rank()
+    if r == 1:
+        # Installed only in THIS process — every send rank 1 makes is
+        # late, so the coordinator's straggler gauge pins to 1.
+        fault_injection.injector.install(
+            [Rule(action="delay", peer=0, op="send", secs=0.03)])
+
+    port = None
+    if r == 0:
+        servers = [e for e in basics.engine()._exporters
+                   if isinstance(e, MetricsHTTPServer)]
+        assert servers, "metrics endpoint did not start"
+        port = servers[0].port
+
+    def alerts_body():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/alerts")
+        return json.loads(conn.getresponse().read())
+
+    phase = 0  # 0: waiting for fire, 1: waiting for resolve, 2: done
+    detail = None
+    cleared = False
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        # Keep collectives flowing (the straggler gauge and the
+        # activity guard both need live negotiations).
+        hvd.allreduce(np.ones(256, np.float32), name="work")
+        if r == 0:
+            body = alerts_body()
+            firing = body["local"]["firing"]
+            if phase == 0 and "persistent_straggler" in firing:
+                detail = body["local"]["rules"][
+                    "persistent_straggler"]["detail"]
+                assert detail["rank"] == 1, detail
+                phase = 1
+            elif phase == 1 and "persistent_straggler" not in firing:
+                phase = 2
+        # Phase word: rank 0 contributes the phase, rank 1 zero, so the
+        # sum IS rank 0's phase on every rank — the clear coordination.
+        sig = np.asarray(hvd.allreduce(
+            np.full(1, float(phase if r == 0 else 0), np.float32),
+            name="phase", op=hvd.Sum))
+        if r == 1 and sig[0] >= 1 and not cleared:
+            fault_injection.injector.clear()
+            cleared = True
+        if sig[0] >= 2:
+            break
+        time.sleep(0.02)
+    checks = {"rank": r, "phase": phase, "detail": detail,
+              "cleared": cleared}
+    if r == 0:
+        assert phase == 2, (
+            "straggler alert never completed fire->resolve", checks)
+    hvd.shutdown()
+    return checks
+
+
 def main():
     from horovod_tpu.runner import run
 
@@ -82,11 +193,31 @@ def main():
         "HOROVOD_CYCLE_TIME": "1",
         "HOROVOD_METRICS_PORT": "0",
         "HOROVOD_METRICS_SYNC_SECONDS": "0.05",
+        "HOROVOD_METRICS_SAMPLE_SECONDS": "0.2",
     })
     assert len(results) == 2, results
     r0 = results[0]
     assert r0["status_ranks"] == [0, 1], r0
-    print("telemetry smoke OK:", results)
+    print("telemetry smoke OK (phase 1):", results)
+
+    # Phase 2: the injected-straggler fire -> attribute -> resolve
+    # round-trip. Fast sampler + a smoke-scaled rule override (the
+    # production default needs 90% dominance over 10 samples held 30s).
+    results = run(worker_straggler, np=2, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_METRICS_PORT": "0",
+        "HOROVOD_METRICS_SYNC_SECONDS": "0.05",
+        "HOROVOD_METRICS_SAMPLE_SECONDS": "0.2",
+        "HOROVOD_ALERT_RULES":
+            "persistent_straggler:k=4:n=5:for_seconds=0.3",
+    })
+    assert len(results) == 2, results
+    assert results[0]["phase"] == 2, results
+    assert results[0]["detail"]["rank"] == 1, results
+    assert results[1]["cleared"], results
+    print("telemetry smoke OK (phase 2, straggler fire/resolve):",
+          results)
 
 
 if __name__ == "__main__":

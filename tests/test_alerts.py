@@ -1,0 +1,638 @@
+"""Alert-engine tests: each rule type (fire -> latch -> resolve,
+duration hysteresis, stale-data never fires), the HOROVOD_ALERT_RULES
+grammar, fleet folding with rank attribution, and the end-to-end
+persistent-straggler scenario on a 2-engine TCP mesh with an injected
+`delay:` fault (docs/health.md)."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import alerts, telemetry, timeseries as ts
+from horovod_tpu.common.fault_injection import Rule as FaultRule
+from horovod_tpu.common import fault_injection
+
+
+def _store(points, key="m", capacity=64, base=None):
+    """Synthetic store: [(t, value-or-snapdict)] with mono stamps offset
+    from a base >= now, so last_age() reads ~0 (never stale)."""
+    base = time.monotonic() if base is None else base
+    st = ts.TimeSeriesStore(capacity)
+    for t, v in points:
+        snap = v if isinstance(v, dict) else {key: v}
+        st.add_sample(snap, wall=t, mono=base + t)
+    return st, base
+
+
+def _engine(store, rules, stale_after=1e9, registry=None, tracer=None):
+    return alerts.AlertEngine(
+        store, registry or telemetry.MetricsRegistry(), rules=rules,
+        rules_spec="", tracer=tracer, stale_after=stale_after)
+
+
+# ---------------------------------------------------------------------------
+# Threshold: fire -> latch -> resolve with duration hysteresis
+
+
+def test_threshold_fire_latch_resolve_with_hysteresis():
+    reg = telemetry.MetricsRegistry()
+    rule = alerts.ThresholdRule("hot", "m", threshold=10.0,
+                                for_seconds=15.0, clear_seconds=15.0)
+    st, base = _store([(0, 20.0)])
+    eng = _engine(st, [rule], registry=reg)
+
+    eng.evaluate(st, now=base + 0)     # breach starts: not yet firing
+    assert eng.firing() == []
+    st.add_sample({"m": 25.0}, wall=10, mono=base + 10)
+    eng.evaluate(st, now=base + 10)    # 10s < for_seconds
+    assert eng.firing() == []
+    st.add_sample({"m": 25.0}, wall=16, mono=base + 16)
+    eng.evaluate(st, now=base + 16)    # 16s >= 15 -> FIRE
+    assert [f["rule"] for f in eng.firing()] == ["hot"]
+    # Clear hysteresis: a momentary dip must not resolve.
+    st.add_sample({"m": 1.0}, wall=20, mono=base + 20)
+    eng.evaluate(st, now=base + 20)
+    assert eng.firing(), "resolved without clear_seconds"
+    # Dip interrupted by a new breach: clear window resets.
+    st.add_sample({"m": 30.0}, wall=25, mono=base + 25)
+    eng.evaluate(st, now=base + 25)
+    st.add_sample({"m": 1.0}, wall=30, mono=base + 30)
+    eng.evaluate(st, now=base + 30)
+    eng.evaluate(st, now=base + 40)
+    assert eng.firing(), "clear window did not reset on re-breach"
+    eng.evaluate(st, now=base + 46)    # 16s clear -> RESOLVE
+    assert eng.firing() == []
+    snap = reg.snapshot()
+    assert snap['horovod_alerts_total{rule="hot",state="fire"}'] == 1
+    assert snap['horovod_alerts_total{rule="hot",state="resolve"}'] == 1
+    assert snap["horovod_alerts_firing"] == 0
+
+
+def test_threshold_breach_window_resets_on_data_gap():
+    rule = alerts.ThresholdRule("hot", "m", threshold=10.0,
+                                for_seconds=10.0)
+    st, base = _store([(0, 20.0)])
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 0)
+    # The metric disappears (owner went away): pending breach drops.
+    st.add_sample({}, wall=5, mono=base + 5)
+    eng.evaluate(st, now=base + 5)
+    st.add_sample({"m": 20.0}, wall=11, mono=base + 11)
+    eng.evaluate(st, now=base + 11)  # breach restarts at t=11
+    assert eng.firing() == []
+
+
+def test_threshold_below_and_rate_modes():
+    below = alerts.ThresholdRule("low", "m", threshold=5.0, op="below")
+    st, base = _store([(0, 2.0)])
+    eng = _engine(st, [below])
+    eng.evaluate(st, now=base)
+    assert [f["rule"] for f in eng.firing()] == ["low"]
+
+    rate = alerts.ThresholdRule("fast", "c", threshold=5.0, mode="rate",
+                                window_s=100)
+    st2, base2 = _store([(0, 0), (10, 200)], key="c")
+    eng2 = _engine(st2, [rate])
+    eng2.evaluate(st2, now=base2 + 10)  # 20/s > 5
+    assert [f["rule"] for f in eng2.firing()] == ["fast"]
+
+
+def test_threshold_family_max_names_series():
+    rule = alerts.ThresholdRule("hb", "age", threshold=4.0,
+                                mode="family_max")
+    st, base = _store([(0, {'age{peer="1"}': 1.0, 'age{peer="2"}': 9.0})])
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base)
+    f = eng.firing()[0]
+    assert f["detail"]["series"] == 'age{peer="2"}'
+    assert f["value"] == 9.0
+
+
+# ---------------------------------------------------------------------------
+# Stale data never fires
+
+
+def test_stale_data_never_fires():
+    rule = alerts.ThresholdRule("hot", "m", threshold=10.0)
+    st = ts.TimeSeriesStore(8)
+    # Newest sample is 100 s old (real monotonic clock).
+    st.add_sample({"m": 99.0}, wall=0, mono=time.monotonic() - 100)
+    eng = _engine(st, [rule], stale_after=5.0)
+    eng.evaluate(st)
+    assert eng.firing() == []
+    assert eng.status()["stale"] is True
+    # An empty store is stale too.
+    empty = ts.TimeSeriesStore(8)
+    eng2 = _engine(empty, [alerts.ThresholdRule("h", "m", threshold=0)],
+                   stale_after=5.0)
+    eng2.evaluate(empty)
+    assert eng2.status()["stale"] is True and eng2.firing() == []
+
+
+def test_stale_data_never_resolves_either():
+    rule = alerts.ThresholdRule("hot", "m", threshold=10.0)
+    st, base = _store([(0, 20.0)])
+    eng = _engine(st, [rule], stale_after=1e9)
+    eng.evaluate(st, now=base)
+    assert eng.firing()
+    # Data stops arriving; the latched alert must stay latched.
+    eng.stale_after = 0.0
+    eng.evaluate(st)
+    assert eng.firing(), "stale evaluation resolved a latched alert"
+
+
+# ---------------------------------------------------------------------------
+# Burn rate
+
+
+def _hist(counts, bounds=(0.05, 0.1, 0.2), total=None, s=0.0):
+    counts = list(counts)
+    return {"count": total if total is not None else sum(counts),
+            "sum": s, "bounds": list(bounds), "counts": counts}
+
+
+def test_burn_rate_needs_both_windows():
+    rule = alerts.BurnRateRule("slo", "h", target_s=0.1, quantile=0.5,
+                               fast_window_s=10, slow_window_s=100,
+                               min_count=1)
+    # Slow history healthy (1000 obs in (0.05, 0.1] across the slow
+    # window), recent burst slow (40 obs in (0.1, 0.2] in the fast
+    # window) -> fast breaches, slow does not: no fire.
+    st, base = _store([
+        (0, {"h": _hist([0, 0, 0, 0])}),
+        (90, {"h": _hist([0, 1000, 0, 0])}),
+        (100, {"h": _hist([0, 1000, 40, 0])}),
+    ])
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 100)
+    assert eng.firing() == []
+    # Sustained: the slow window's quantile crosses too.
+    st2, base2 = _store([
+        (0, {"h": _hist([0, 10, 0, 0])}),
+        (95, {"h": _hist([0, 10, 3000, 0])}),
+        (100, {"h": _hist([0, 10, 4000, 0])}),
+    ])
+    eng2 = _engine(st2, [rule])
+    eng2.evaluate(st2, now=base2 + 100)
+    assert [f["rule"] for f in eng2.firing()] == ["slo"]
+    assert eng2.firing()[0]["detail"]["target_s"] == 0.1
+
+
+def test_burn_rate_disarmed_without_target():
+    rule = alerts.BurnRateRule("slo", "h", target_s=0.0, min_count=1)
+    st, base = _store([(0, {"h": _hist([0, 0, 1000, 0])}),
+                       (100, {"h": _hist([0, 0, 9000, 0])})])
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 100)
+    assert eng.firing() == []
+
+
+def test_burn_rate_min_count_guard():
+    rule = alerts.BurnRateRule("slo", "h", target_s=0.01, min_count=50,
+                               fast_window_s=10, slow_window_s=100)
+    st, base = _store([(0, {"h": _hist([0, 0, 2, 0])}),
+                       (100, {"h": _hist([0, 0, 4, 0])})])
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 100)
+    assert eng.firing() == []  # 2 in-window obs < min_count
+
+
+# ---------------------------------------------------------------------------
+# Regression
+
+
+def _cycle_hist_samples(slow_from=None, n=12, step=30.0):
+    """n samples 30 s apart of a cycle-seconds histogram: fast buckets
+    fill at 100 obs/sample; from `slow_from` (sample index) on, new
+    observations land 2 buckets higher (4x slower)."""
+    bounds = [0.01, 0.02, 0.04, 0.08]
+    fast = 0
+    slow = 0
+    out = []
+    for i in range(n):
+        if slow_from is not None and i >= slow_from:
+            slow += 100
+        else:
+            fast += 100
+        out.append((i * step, {
+            "h": {"count": fast + slow, "sum": 0.0, "bounds": bounds,
+                  "counts": [0, fast, 0, slow, 0]}}))
+    return out
+
+
+def test_regression_fires_on_slowdown():
+    rule = alerts.RegressionRule("slow", "h", window_s=30, baselines=5,
+                                 min_baselines=2, tolerance=0.75,
+                                 min_count=20)
+    pts = _cycle_hist_samples(slow_from=11)
+    st, base = _store(pts)
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + pts[-1][0])
+    f = eng.firing()
+    assert [x["rule"] for x in f] == ["slow"], eng.status()["rules"]["slow"]
+    assert f[0]["detail"]["ratio"] > 1.75
+
+
+def test_regression_quiet_on_steady_state_and_cold_start():
+    rule = alerts.RegressionRule("slow", "h", window_s=30, baselines=5,
+                                 min_baselines=2, tolerance=0.75,
+                                 min_count=20)
+    pts = _cycle_hist_samples(slow_from=None)
+    st, base = _store(pts)
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + pts[-1][0])
+    assert eng.firing() == []
+    # Cold start: only one window of history -> no baselines -> silent.
+    st2, base2 = _store(pts[:2])
+    eng2 = _engine(st2, [rule])
+    eng2.evaluate(st2, now=base2 + pts[1][0])
+    assert eng2.firing() == []
+
+
+# ---------------------------------------------------------------------------
+# Straggler
+
+
+def _straggler_samples(ranks, act_step=10):
+    """Each sample: straggler gauge value + advancing activity."""
+    return [(i * 10.0, {"horovod_straggler_rank": r,
+                        "horovod_responses_total": (i + 1) * act_step})
+            for i, r in enumerate(ranks)]
+
+
+def test_straggler_k_of_n_with_attribution():
+    rule = alerts.StragglerRule("strag", k=4, n=5, for_seconds=0)
+    st, base = _store(_straggler_samples([1, 1, 0, 1, 1]))
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 40)
+    f = eng.firing()
+    assert f and f[0]["detail"]["rank"] == 1 and f[0]["detail"]["hits"] == 4
+
+
+def test_straggler_balanced_mesh_quiet():
+    rule = alerts.StragglerRule("strag", k=4, n=5, for_seconds=0)
+    st, base = _store(_straggler_samples([0, 1, 0, 1, 0]))
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 40)
+    assert eng.firing() == []
+
+
+def test_straggler_idle_mesh_never_fires():
+    """A frozen gauge on an idle mesh (no negotiations) is history,
+    not evidence: the activity guard must keep the rule silent."""
+    rule = alerts.StragglerRule("strag", k=4, n=5, for_seconds=0)
+    pts = [(i * 10.0, {"horovod_straggler_rank": 1,
+                       "horovod_responses_total": 50})  # frozen counter
+           for i in range(5)]
+    st, base = _store(pts)
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 40)
+    assert eng.firing() == []
+
+
+# ---------------------------------------------------------------------------
+# Overdue (checkpoint cadence)
+
+
+def _commit_samples(commit_times, until, step=10.0):
+    out = []
+    commits = 0
+    t = 0.0
+    while t <= until:
+        commits += sum(1 for ct in commit_times if t - step < ct <= t)
+        out.append((t, {"horovod_checkpoint_commits_total": commits}))
+        t += step
+    return out
+
+
+def test_overdue_fires_after_factor_times_cadence():
+    rule = alerts.OverdueRule("ckpt", "horovod_checkpoint_commits_total",
+                              factor=2.0)
+    # Commits every ~30 s until t=120, then silence until t=250:
+    # age 130 > 2 x 30.
+    st, base = _store(_commit_samples([30, 60, 90, 120], until=250))
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 250)
+    f = eng.firing()
+    assert f and f[0]["detail"]["overdue_seconds"] > 120
+    # And it resolves when commits restart.
+    st.add_sample({"horovod_checkpoint_commits_total": 5},
+                  wall=260, mono=base + 260)
+    eng.evaluate(st, now=base + 260)
+    assert eng.firing() == []
+
+
+def test_overdue_quiet_on_healthy_cadence_and_without_history():
+    rule = alerts.OverdueRule("ckpt", "horovod_checkpoint_commits_total",
+                              factor=2.0)
+    st, base = _store(_commit_samples([30, 60, 90, 120], until=140))
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base + 140)
+    assert eng.firing() == []
+    # One commit ever: no cadence to calibrate -> silent forever.
+    st2, base2 = _store(_commit_samples([30], until=500))
+    eng2 = _engine(st2, [rule])
+    eng2.evaluate(st2, now=base2 + 500)
+    assert eng2.firing() == []
+
+
+# ---------------------------------------------------------------------------
+# Rule spec grammar
+
+
+def test_rules_spec_disable_enable_override():
+    rules = alerts.default_rules()
+    alerts.apply_rules_spec(
+        "-cycle_time_regression,"
+        "persistent_straggler:k=3:n=4:for_seconds=1.5", rules)
+    by = {r.name: r for r in rules}
+    assert by["cycle_time_regression"].enabled is False
+    strag = by["persistent_straggler"]
+    assert strag.enabled and strag.k == 3 and strag.n == 4
+    assert strag.for_seconds == pytest.approx(1.5)
+
+
+def test_rules_spec_none_disables_all():
+    rules = alerts.apply_rules_spec("none", alerts.default_rules())
+    assert all(not r.enabled for r in rules)
+
+
+def test_rules_spec_unknown_rule_and_param_are_loud():
+    with pytest.raises(ValueError, match="unknown alert rule"):
+        alerts.apply_rules_spec("no_such_rule", alerts.default_rules())
+    with pytest.raises(ValueError, match="no parameter"):
+        alerts.apply_rules_spec("persistent_straggler:bogus=1",
+                                alerts.default_rules())
+    with pytest.raises(ValueError, match="bad alert override"):
+        alerts.apply_rules_spec("persistent_straggler:k",
+                                alerts.default_rules())
+
+
+def test_default_serving_rule_armed_by_env(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SERVING_SLO_P99_MS", raising=False)
+    by = {r.name: r for r in alerts.default_rules()}
+    assert by["serving_p99_slo"].target_s == 0.0  # disarmed
+    monkeypatch.setenv("HOROVOD_SERVING_SLO_P99_MS", "250")
+    by = {r.name: r for r in alerts.default_rules()}
+    assert by["serving_p99_slo"].target_s == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# Spans + fleet fold
+
+
+def test_alert_spans_land_in_flight_recorder():
+    from horovod_tpu.common import tracing
+
+    reg = telemetry.MetricsRegistry()
+    tracer = tracing.Tracer(registry=reg, capacity=64)
+    rule = alerts.ThresholdRule("hot", "m", threshold=1.0)
+    st, base = _store([(0, 5.0)])
+    eng = _engine(st, [rule], registry=reg, tracer=tracer)
+    eng.evaluate(st, now=base)
+    st.add_sample({"m": 0.0}, wall=10, mono=base + 10)
+    eng.evaluate(st, now=base + 10)
+    names = [(e[2], e[7]) for e in tracer.recorder.snapshot()]
+    assert ("alert.fire", {"rule": "hot", "value": 5.0,
+                           "threshold": 1.0}) in names
+    assert any(n == "alert.resolve" for n, _ in names)
+
+
+def test_fleet_alerts_fold_and_attribution():
+    fleet = alerts.FleetAlerts(4)
+    blob = telemetry.encode_push(
+        telemetry.MetricsRegistry(), 2,
+        extra={"alerts": {"firing": [
+            {"rule": "persistent_straggler", "value": 3.0,
+             "detail": {"rank": 3}, "since": 1.0}]}})
+    fleet.ingest_blob(2, blob)
+    fleet.ingest_blob(1, telemetry.encode_push(
+        telemetry.MetricsRegistry(), 1, extra={"alerts": {"firing": []}}))
+    fleet.ingest_blob(0, b"not json")  # must not throw
+    snap = fleet.snapshot()
+    assert snap["firing_by_rule"] == {"persistent_straggler": [2]}
+    assert snap["ranks"][2]["firing"][0]["detail"]["rank"] == 3
+    assert snap["ranks"][1]["firing"] == []
+
+
+# ---------------------------------------------------------------------------
+# End to end: 2-engine TCP mesh, injected delay fault -> rank-attributed
+# straggler alert fires at the coordinator, resolves after the clear.
+
+
+def _tcp_engine_pair(scope, monkeypatch):
+    from test_fault_tolerance import _tcp_pair
+
+    from horovod_tpu.engine.engine import Engine
+
+    server, backends = _tcp_pair(scope, monkeypatch)
+    regs = [telemetry.MetricsRegistry() for _ in range(2)]
+    engines = [Engine(rank=r, size=2, backend=backends[r],
+                      registry=regs[r]) for r in range(2)]
+    for e in engines:
+        e.cycle_time_s = 0.001
+    errs = []
+
+    def _start(e):
+        try:
+            e.start()
+        except BaseException as exc:  # pragma: no cover - init bug
+            errs.append(exc)
+
+    threads = [threading.Thread(target=_start, args=(e,)) for e in engines]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs, errs
+    return server, engines
+
+
+def test_straggler_alert_end_to_end_with_injected_delay(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TCP_TIMEOUT_SECONDS", "30")
+    monkeypatch.setenv("HOROVOD_METRICS_SYNC_SECONDS", "0.05")
+    monkeypatch.setenv("HOROVOD_METRICS_SAMPLE_SECONDS", "0.1")
+    monkeypatch.setenv("HOROVOD_METRICS_HISTORY_SAMPLES", "64")
+    monkeypatch.setenv(
+        "HOROVOD_ALERT_RULES",
+        "persistent_straggler:k=4:n=5:for_seconds=0.2")
+    server, engines = _tcp_engine_pair("t_alert_strag", monkeypatch)
+    stop = threading.Event()
+    errors = []
+
+    def traffic(r):
+        i = 0
+        try:
+            while not stop.is_set():
+                h = engines[r].enqueue_allreduce(
+                    np.ones(256, np.float32), name="t")
+                engines[r].synchronize(h, timeout=60)
+                i += 1
+                time.sleep(0.01)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=traffic, args=(r,))
+               for r in range(2)]
+    try:
+        # Rank 1's sends are always late -> it is the straggler on
+        # every negotiation the coordinator sees.
+        fault_injection.injector.install(
+            [FaultRule(action="delay", rank=1, peer=0, op="send",
+                       secs=0.02)])
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        fired = None
+        while time.monotonic() < deadline and not errors:
+            al = engines[0].alerts
+            if al is not None:
+                f = [x for x in al.firing()
+                     if x["rule"] == "persistent_straggler"]
+                if f:
+                    fired = f[0]
+                    break
+            time.sleep(0.05)
+        assert fired is not None, (errors,
+                                   engines[0].alerts.status())
+        assert fired["detail"]["rank"] == 1, fired
+        # The alert is visible on the /alerts view body and in /status.
+        body = engines[0]._alerts_view()
+        assert "persistent_straggler" in body["local"]["firing"]
+        st = engines[0].status()
+        assert "persistent_straggler" in st["alerts"]["firing"]
+        # Fleet fold: rank 0's own firing set reaches the fleet view
+        # through the ordinary telemetry piggyback.
+        fdeadline = time.monotonic() + 30
+        while time.monotonic() < fdeadline:
+            fleet = engines[0]._fleet_alerts.snapshot()
+            if fleet["firing_by_rule"].get("persistent_straggler") == [0]:
+                break
+            time.sleep(0.05)
+        assert fleet["firing_by_rule"]["persistent_straggler"] == [0]
+        # Clear the fault: dominance breaks, the alert resolves.
+        fault_injection.injector.clear()
+        rdeadline = time.monotonic() + 60
+        while time.monotonic() < rdeadline and not errors:
+            if not engines[0].alerts.firing():
+                break
+            time.sleep(0.05)
+        assert engines[0].alerts.firing() == [], \
+            engines[0].alerts.status()
+        assert not errors, errors
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        fault_injection.injector.clear()
+        stops = [threading.Thread(target=e.shutdown) for e in engines]
+        for t in stops:
+            t.start()
+        for t in stops:
+            t.join(timeout=60)
+        server.stop()
+
+
+def test_post_mortem_dump_carries_timeseries_and_alerts(
+        tmp_path, monkeypatch):
+    """The flight dump written on a fatal latch embeds the scalar
+    series and the alert state — the 'what was trending wrong before
+    it died' half of the post-mortem."""
+    monkeypatch.setenv("HOROVOD_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_METRICS_SAMPLE_SECONDS", "0.1")
+    monkeypatch.setenv("HOROVOD_METRICS_HISTORY_SAMPLES", "32")
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(mode="process")  # single-rank process engine
+    try:
+        from horovod_tpu.common import basics
+
+        eng = basics.engine()
+        assert eng.sampler is not None and eng.alerts is not None
+        eng.synchronize(eng.enqueue_allreduce(
+            np.ones(8, np.float32), name="x"), timeout=30)
+        eng._dump_post_mortem(RuntimeError("injected for test"))
+        flight = json.load(open(tmp_path / "flight_rank0.json"))
+        assert flight["timeseries"]["samples"], flight.get("timeseries")
+        scalars = flight["timeseries"]["samples"][-1][1]
+        assert "horovod_allreduce_bytes_total" in scalars
+        assert "firing" in flight["alerts"]
+        # And the stitched post-mortem summary counts the series.
+        from horovod_tpu.common import tracing
+
+        out = tracing.stitch_post_mortem(str(tmp_path), verdict="test",
+                                         expect_ranks=1)
+        pm = json.load(open(out))["horovod_postmortem"]
+        assert pm["per_rank"]["0"]["timeseries_samples"] > 0
+    finally:
+        hvd.shutdown()
+
+
+def test_default_heartbeat_rule_names_the_silent_peer(monkeypatch):
+    """The heartbeat_stale default rule: armed from the liveness
+    knobs, fires on the max peer age approaching the declaration
+    bound, and the detail names the peer's series."""
+    monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL_SECONDS", "1")
+    monkeypatch.setenv("HOROVOD_HEARTBEAT_MISS_LIMIT", "5")
+    by = {r.name: r for r in alerts.default_rules()}
+    rule = by["heartbeat_stale"]
+    assert rule.enabled and rule.threshold == pytest.approx(4.0)
+    st, base = _store([(0, {
+        'horovod_heartbeat_age_seconds{peer="1"}': 0.2,
+        'horovod_heartbeat_age_seconds{peer="2"}': 4.5,
+    })])
+    eng = _engine(st, [rule])
+    eng.evaluate(st, now=base)
+    f = eng.firing()
+    assert f and 'peer="2"' in f[0]["detail"]["series"]
+    # Liveness plane off -> rule disabled entirely.
+    monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL_SECONDS", "0")
+    by = {r.name: r for r in alerts.default_rules()}
+    assert not by["heartbeat_stale"].enabled
+
+
+def test_serving_rule_wiring_respects_user_overrides(monkeypatch):
+    """serve()'s live re-wiring (queue capacity, SLO target) must not
+    clobber parameters the user pinned via HOROVOD_ALERT_RULES."""
+    import horovod_tpu.serving as serving_mod
+    from horovod_tpu.common import basics
+
+    rules = alerts.apply_rules_spec(
+        "serving_p99_slo:target_s=0.05,"
+        "admission_queue_saturated:threshold=10",
+        alerts.default_rules())
+    by = {r.name: r for r in rules}
+
+    class _StubAlerts:
+        pass
+
+    class _StubEngine:
+        alerts = _StubAlerts()
+
+    _StubEngine.alerts.rules = rules
+
+    class _StubQueue:
+        maxsize = 512
+
+    class _StubFrontend:
+        queue = _StubQueue()
+
+    monkeypatch.setattr(basics, "engine", lambda: _StubEngine())
+    monkeypatch.delenv("HOROVOD_SERVING_SLO_P99_MS", raising=False)
+    serving_mod._wire_alert_rules(_StubFrontend())
+    # Pinned values survive; without the pin they would have become
+    # 0.0 (env unset) and 0.9*512.
+    assert by["serving_p99_slo"].target_s == pytest.approx(0.05)
+    assert by["admission_queue_saturated"].threshold == pytest.approx(10.0)
+
+    # And WITHOUT user pins the wiring does derive from live config.
+    rules2 = alerts.default_rules()
+    _StubEngine.alerts.rules = rules2
+    serving_mod._wire_alert_rules(_StubFrontend())
+    by2 = {r.name: r for r in rules2}
+    assert by2["admission_queue_saturated"].threshold == pytest.approx(
+        0.9 * 512)

@@ -400,8 +400,14 @@ def test_driver_recovery_duration_histogram(monkeypatch):
         before = driver._m_recovery.count
         procs[("b", 0)].exit(1)
         server.handle_put("ready_e0/a:0", b"1")
+        # Poll for the OBSERVATION, not the epoch: the ready put can
+        # drive the epoch-1 activation before the exit monitor notes
+        # the failure, in which case the recovery sample lands on a
+        # later re-activation (same epoch) — waiting on the epoch
+        # alone races that by design.
         deadline = time.monotonic() + 5
-        while driver.epoch < 1 and time.monotonic() < deadline:
+        while (driver._m_recovery.count < before + 1
+               and time.monotonic() < deadline):
             time.sleep(0.05)
         assert driver.epoch >= 1
         assert driver._m_recovery.count == before + 1

@@ -497,3 +497,116 @@ def test_metrics_callback_logs_summary():
     assert "allreduce" in lines[0] and "cache hit" in lines[0]
     with pytest.raises(ValueError):
         MetricsCallback(interval=0)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance: the round-trip audit
+# (docs/health.md satellite). Whatever to_prometheus emits must parse
+# back — escapes included — into exactly the registry's snapshot.
+
+
+def test_prometheus_roundtrip_against_registry_snapshot():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("rt_ops_total",
+                    help="ops with a\nnewline and a \\ backslash",
+                    labels={"op": 'all"re\\duce', "phase": "x\ny"})
+    c.inc(7)
+    g = reg.gauge("rt_depth", help="plain")
+    g.set(2.5)
+    h = reg.histogram("rt_lat_seconds", min_exp=-3, max_exp=2)
+    for v in (0.1, 0.1, 0.3, 1.5, 9.0):  # 9.0 -> +Inf bucket
+        h.observe(v)
+    text = metrics_export.to_prometheus(reg)
+    samples, types, helps = metrics_export.parse_prometheus(text)
+
+    # Scalars: exact values under the snapshot-identical keys.
+    snap = reg.snapshot()
+    ckey = [k for k in snap if k.startswith("rt_ops_total")][0]
+    assert samples[ckey] == 7
+    assert samples["rt_depth"] == 2.5
+    # Escaped label values round-trip verbatim.
+    assert 'op="all"re\\duce"' not in text  # raw quote must be escaped
+    assert ckey in samples
+
+    # HELP/TYPE: escaping round-trips, kinds are right.
+    assert helps["rt_ops_total"] == "ops with a\nnewline and a \\ backslash"
+    assert types["rt_ops_total"] == "counter"
+    assert types["rt_depth"] == "gauge"
+    assert types["rt_lat_seconds"] == "histogram"
+
+    # Histogram: cumulative le-buckets + +Inf + _sum/_count must
+    # reconstruct the registry's per-bucket counts exactly.
+    hsnap = snap["rt_lat_seconds"]
+    assert samples["rt_lat_seconds_count"] == hsnap["count"] == 5
+    assert samples["rt_lat_seconds_sum"] == pytest.approx(hsnap["sum"])
+    cums = []
+    for b in hsnap["bounds"]:
+        le = metrics_export._fmt(float(b))
+        cums.append(samples[f'rt_lat_seconds_bucket{{le="{le}"}}'])
+    cums.append(samples['rt_lat_seconds_bucket{le="+Inf"}'])
+    assert cums == sorted(cums), "buckets must be cumulative"
+    assert cums[-1] == hsnap["count"], "+Inf bucket must equal _count"
+    per_bucket = [cums[0]] + [b - a for a, b in zip(cums, cums[1:])]
+    assert per_bucket == hsnap["counts"]
+
+
+def test_prometheus_labeled_families_stay_contiguous():
+    """Strict exposition parsers reject interleaved families; all
+    series of one family must render contiguously with one TYPE."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("fam_total", labels={"op": "a"}).inc()
+    reg.counter("zz_other_total").inc()
+    reg.counter("fam_total", labels={"op": "b"}).inc()
+    text = metrics_export.to_prometheus(reg)
+    fam_lines = [i for i, ln in enumerate(text.splitlines())
+                 if ln.startswith("fam_total")]
+    assert fam_lines == list(range(fam_lines[0], fam_lines[0] + 2))
+    assert text.count("# TYPE fam_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# critical_path --from-url: pull a live /trace endpoint.
+
+
+def test_critical_path_from_url_pulls_live_trace():
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "critical_path",
+        _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "scripts", "critical_path.py"))
+    critical_path = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(critical_path)
+
+    doc = {"traceEvents": [
+        {"ph": "X", "name": "exec.allreduce", "cat": "exec", "pid": 0,
+         "tid": 1, "ts": 0.0, "dur": 50.0, "args": {"trace_id": 2}},
+        {"ph": "X", "name": "exec.allreduce", "cat": "exec", "pid": 1,
+         "tid": 1, "ts": 0.0, "dur": 90.0, "args": {"trace_id": 2}},
+    ]}
+    srv = metrics_export.MetricsHTTPServer(
+        0, registry=telemetry.MetricsRegistry())
+    srv.add_view("trace", lambda: json.dumps(doc))
+    srv.start()
+    try:
+        for url in (f"127.0.0.1:{srv.port}",
+                    f"http://127.0.0.1:{srv.port}",
+                    f"http://127.0.0.1:{srv.port}/trace"):
+            events, full = critical_path.fetch_url(url)
+            out = critical_path.analyze(events)
+            assert out["collectives_analyzed"] == 1
+            assert out["stragglers"] == {
+                "1": {"times_last": 1, "total_margin_us": 40.0}}
+    finally:
+        srv.stop()
+
+
+def test_prometheus_help_backslash_n_roundtrip():
+    """A literal backslash followed by 'n' in help text must survive
+    the escape/unescape round-trip (chained replaces corrupt it)."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("esc_total", help=r"matches \n in input").inc()
+    text = metrics_export.to_prometheus(reg)
+    _, _, helps = metrics_export.parse_prometheus(text)
+    assert helps["esc_total"] == r"matches \n in input"

@@ -1,0 +1,331 @@
+"""Health-plane time-series tests: sampler ring capacity/drop
+accounting, windowed-quantile math vs exact values on synthetic bucket
+deltas, rate computation across counter resets, gauge windows, and the
+env knobs (docs/health.md)."""
+import time
+
+import pytest
+
+from horovod_tpu.common import telemetry, timeseries as ts
+from horovod_tpu.utils import env as env_cfg
+
+
+def _mk_samples(points, key="m"):
+    """[(t, value)] -> Sample list (wall == mono == t)."""
+    return [(t, t, {key: v}) for t, v in points]
+
+
+# ---------------------------------------------------------------------------
+# Ring capacity / drop accounting
+
+
+def test_ring_capacity_and_drop_accounting():
+    reg = telemetry.MetricsRegistry()
+    store = ts.TimeSeriesStore(5, registry=reg)
+    for i in range(8):
+        store.add_sample({"v": i}, wall=float(i), mono=float(i))
+    assert store.depth() == 5
+    assert store.dropped == 3
+    snap = reg.snapshot()
+    assert snap["horovod_timeseries_samples_total"] == 8
+    assert snap["horovod_timeseries_samples_dropped_total"] == 3
+    # Oldest retained is sample 3 — the ring keeps the newest.
+    assert store.samples()[0][2]["v"] == 3
+
+
+def test_zero_capacity_disables():
+    store = ts.TimeSeriesStore(0)
+    assert not store.enabled
+    store.add_sample({"v": 1})
+    assert store.depth() == 0
+
+
+def test_last_age_before_first_sample():
+    store = ts.TimeSeriesStore(4)
+    assert store.last_age() == -1.0
+
+
+# ---------------------------------------------------------------------------
+# Counter rates (incl. resets)
+
+
+def test_counter_rate_simple():
+    samples = _mk_samples([(0, 0), (10, 100), (20, 300)])
+    # 300 over 20s
+    assert ts.counter_rate(samples, "m", window_s=100) == pytest.approx(15.0)
+
+
+def test_counter_rate_across_reset():
+    # 0 -> 100, reset to 5 (contributes 5, not -95), then 25 (+20):
+    # total 125 over 30 s.
+    samples = _mk_samples([(0, 0), (10, 100), (20, 5), (30, 25)])
+    assert ts.counter_rate(samples, "m", window_s=100) == pytest.approx(
+        125 / 30)
+
+
+def test_counter_rate_windows_and_insufficient_data():
+    samples = _mk_samples([(0, 0), (10, 100), (20, 200), (30, 330)])
+    # Window catches only the last two samples: 130 over 10 s.
+    assert ts.counter_rate(samples, "m", window_s=15) == pytest.approx(13.0)
+    assert ts.counter_rate(samples[:1], "m", window_s=15) is None
+    assert ts.counter_rate([], "m", window_s=15) is None
+    assert ts.counter_rate(samples, "missing", window_s=15) is None
+
+
+# ---------------------------------------------------------------------------
+# Windowed histogram quantiles
+
+
+def test_quantile_from_counts_exact():
+    bounds = [0.5, 1.0, 2.0, 4.0]
+    # 90 obs in (0.5, 1], 10 in (1, 2].
+    counts = [0, 90, 10, 0, 0]
+    # p50: target 50 inside the (0.5,1] bucket -> 0.5 + 0.5*50/90
+    assert ts.quantile_from_counts(bounds, counts, 0.5) == pytest.approx(
+        0.5 + 0.5 * 50 / 90)
+    # p99: target 99, cum 90 -> (1,2] bucket -> 1 + 1*(99-90)/10
+    assert ts.quantile_from_counts(bounds, counts, 0.99) == pytest.approx(
+        1.0 + (99 - 90) / 10)
+
+
+def test_quantile_overflow_and_empty():
+    bounds = [1.0, 2.0]
+    assert ts.quantile_from_counts(bounds, [0, 0, 5], 0.5) == 2.0  # +Inf
+    assert ts.quantile_from_counts(bounds, [0, 0, 0], 0.5) is None
+
+
+def test_histogram_window_deltas():
+    h0 = {"count": 10, "sum": 5.0, "bounds": [1.0, 2.0],
+          "counts": [10, 0, 0]}
+    h1 = {"count": 40, "sum": 50.0, "bounds": [1.0, 2.0],
+          "counts": [10, 30, 0]}
+    samples = [(0, 0, {"h": h0}), (30, 30, {"h": h1})]
+    w = ts.histogram_window(samples, "h", window_s=20, now=30)
+    assert w["count"] == 30 and w["counts"] == [0, 30, 0]
+    assert w["sum"] == pytest.approx(45.0)
+    # p50 of the window is inside (1,2] even though the all-time p50
+    # straddles both buckets — windowing works.
+    assert 1.0 < ts.quantile_from_counts(w["bounds"], w["counts"], 0.5) <= 2.0
+
+
+def test_histogram_window_reset_falls_back_to_current():
+    big = {"count": 100, "sum": 50.0, "bounds": [1.0, 2.0],
+           "counts": [100, 0, 0]}
+    fresh = {"count": 7, "sum": 3.5, "bounds": [1.0, 2.0],
+             "counts": [7, 0, 0]}
+    samples = [(0, 0, {"h": big}), (30, 30, {"h": fresh})]
+    w = ts.histogram_window(samples, "h", window_s=20, now=30)
+    assert w["count"] == 7  # not -93
+
+
+def test_window_quantile_matches_live_registry_histogram():
+    """End to end against a REAL registry histogram: observations made
+    between two snapshots must be quantile-recoverable from the
+    deltas."""
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("lat", min_exp=-10, max_exp=4)
+    for _ in range(50):
+        h.observe(0.004)  # noise before the window
+    s0 = (0.0, 0.0, reg.snapshot())
+    for _ in range(99):
+        h.observe(0.010)
+    for _ in range(1):
+        h.observe(3.0)
+    s1 = (60.0, 60.0, reg.snapshot())
+    q50 = ts.window_quantile([s0, s1], "lat", 0.5, window_s=50, now=60)
+    # 0.010 lands in the (2^-7, 2^-6] bucket.
+    assert 2 ** -7 < q50 <= 2 ** -6, q50
+    q999 = ts.window_quantile([s0, s1], "lat", 0.999, window_s=50, now=60)
+    assert q999 > 2.0, q999
+
+
+# ---------------------------------------------------------------------------
+# Gauge windows + family scan
+
+
+def test_gauge_window_min_max_last():
+    samples = _mk_samples([(0, 5.0), (10, 1.0), (20, 3.0)])
+    w = ts.gauge_window(samples, "m", window_s=100)
+    assert w == {"min": 1.0, "max": 5.0, "last": 3.0, "count": 3}
+    assert ts.gauge_window(samples, "m", window_s=5) == {
+        "min": 3.0, "max": 3.0, "last": 3.0, "count": 1}
+    assert ts.gauge_window(samples, "nope", window_s=100) is None
+
+
+def test_gauge_window_skips_nan():
+    samples = _mk_samples([(0, 1.0), (10, float("nan")), (20, 2.0)])
+    assert ts.gauge_window(samples, "m", 100)["count"] == 2
+
+
+def test_family_items():
+    snap = {"hb": 1.0, 'hb{peer="1"}': 2.0, 'hb{peer="2"}': 3.0,
+            "hbx": 9.0}
+    fam = ts.family_items(snap, "hb")
+    assert sorted(fam) == ["hb", 'hb{peer="1"}', 'hb{peer="2"}']
+
+
+def test_flatten_scalars():
+    snap = {"c": 3, "g": 1.5,
+            "h": {"count": 4, "sum": 2.0, "bounds": [1], "counts": [4, 0]},
+            "nan": float("nan")}
+    flat = ts.flatten_scalars(snap)
+    assert flat == {"c": 3, "g": 1.5, "h_count": 4, "h_sum": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# The sampler thread
+
+
+def test_sampler_thread_ticks_and_callbacks():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("work_total")
+    sampler = ts.MetricsSampler(reg, capacity=16, interval=0.05)
+    ticks = []
+    sampler.add_tick_callback(lambda store: ticks.append(store.depth()))
+    sampler.start()
+    try:
+        deadline = time.monotonic() + 5
+        while sampler.store.depth() < 3 and time.monotonic() < deadline:
+            c.inc()
+            time.sleep(0.02)
+        assert sampler.store.depth() >= 3
+        assert ticks, "tick callback never ran"
+        assert sampler.store.rate("work_total", 60) is not None
+        st = sampler.status()
+        assert st["enabled"] and st["capacity"] == 16
+    finally:
+        sampler.stop()
+    depth = sampler.store.depth()
+    time.sleep(0.15)
+    assert sampler.store.depth() == depth  # stopped means stopped
+
+
+def test_sampler_disabled_by_zero_interval_or_capacity():
+    reg = telemetry.MetricsRegistry()
+    assert not ts.MetricsSampler(reg, capacity=0, interval=1).enabled
+    assert not ts.MetricsSampler(reg, capacity=10, interval=0).enabled
+    s = ts.MetricsSampler(reg, capacity=10, interval=0)
+    s.start()
+    assert s._thread is None
+
+
+def test_sampler_broken_pull_gauge_does_not_kill_loop():
+    reg = telemetry.MetricsRegistry()
+    g = reg.gauge("broken")
+    g.set_function(lambda: 1 / 0)
+    sampler = ts.MetricsSampler(reg, capacity=8, interval=0.05)
+    sampler.sample_once()
+    # Gauge.value catches the exception and reports NaN; the sample
+    # itself lands.
+    assert sampler.store.depth() == 1
+
+
+def test_store_view_shape():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("c_total")
+    h = reg.histogram("h_seconds", min_exp=-10, max_exp=2)
+    store = ts.TimeSeriesStore(8, registry=reg)
+    for i in range(4):
+        c.inc(10)
+        h.observe(0.01)
+        store.add_sample(reg.snapshot(), wall=float(i), mono=float(i))
+    view = store.view(window_s=100)
+    assert view["depth"] == 4
+    assert view["derived"]["c_total"]["rate_per_s"] > 0
+    assert view["derived"]["h_seconds"]["kind"] == "histogram"
+    assert view["derived"]["h_seconds"]["p50"] is not None
+    assert view["points"]["c_total"][-1][1] == 40
+    dump = store.dump_scalars(max_samples=2)
+    assert len(dump["samples"]) == 2
+    assert dump["samples"][-1][1]["h_seconds_count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Env knobs (the house parse-test convention)
+
+
+def test_env_sample_seconds(monkeypatch):
+    monkeypatch.delenv("HOROVOD_METRICS_SAMPLE_SECONDS", raising=False)
+    assert env_cfg.metrics_sample_seconds() == pytest.approx(10.0)
+    monkeypatch.setenv("HOROVOD_METRICS_SAMPLE_SECONDS", "2.5")
+    assert env_cfg.metrics_sample_seconds() == pytest.approx(2.5)
+    monkeypatch.setenv("HOROVOD_METRICS_SAMPLE_SECONDS", "0")
+    assert env_cfg.metrics_sample_seconds() == 0.0
+    assert not env_cfg.health_plane_enabled()
+    # Floor: a tiny positive cadence must not busy-loop.
+    monkeypatch.setenv("HOROVOD_METRICS_SAMPLE_SECONDS", "0.001")
+    assert env_cfg.metrics_sample_seconds() == pytest.approx(0.05)
+
+
+def test_env_history_samples(monkeypatch):
+    monkeypatch.delenv("HOROVOD_METRICS_HISTORY_SAMPLES", raising=False)
+    assert env_cfg.metrics_history_samples() == 360
+    monkeypatch.setenv("HOROVOD_METRICS_HISTORY_SAMPLES", "7")
+    assert env_cfg.metrics_history_samples() == 7
+    monkeypatch.setenv("HOROVOD_METRICS_HISTORY_SAMPLES", "0")
+    assert env_cfg.metrics_history_samples() == 0
+    assert not env_cfg.health_plane_enabled()
+    monkeypatch.setenv("HOROVOD_METRICS_HISTORY_SAMPLES", "-3")
+    assert env_cfg.metrics_history_samples() == 0
+
+
+def test_env_health_plane_enabled_default(monkeypatch):
+    monkeypatch.delenv("HOROVOD_METRICS_SAMPLE_SECONDS", raising=False)
+    monkeypatch.delenv("HOROVOD_METRICS_HISTORY_SAMPLES", raising=False)
+    assert env_cfg.health_plane_enabled()
+
+
+def test_env_serving_slo(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SERVING_SLO_P99_MS", raising=False)
+    assert env_cfg.serving_slo_p99_ms() == 0.0
+    monkeypatch.setenv("HOROVOD_SERVING_SLO_P99_MS", "150")
+    assert env_cfg.serving_slo_p99_ms() == pytest.approx(150.0)
+    monkeypatch.setenv("HOROVOD_SERVING_SLO_P99_MS", "-5")
+    assert env_cfg.serving_slo_p99_ms() == 0.0
+
+
+def test_env_alert_rules_spec(monkeypatch):
+    monkeypatch.delenv("HOROVOD_ALERT_RULES", raising=False)
+    assert env_cfg.alert_rules_spec() == ""
+    monkeypatch.setenv("HOROVOD_ALERT_RULES", "-cycle_time_regression")
+    assert env_cfg.alert_rules_spec() == "-cycle_time_regression"
+    # HVD_TPU_ alias prefix works here like every other knob.
+    monkeypatch.delenv("HOROVOD_ALERT_RULES", raising=False)
+    monkeypatch.setenv("HVD_TPU_ALERT_RULES", "none")
+    assert env_cfg.alert_rules_spec() == "none"
+
+
+def test_build_info_registration():
+    reg = telemetry.MetricsRegistry()
+    info = telemetry.register_build_info(reg)
+    assert info["version"]
+    snap = reg.snapshot()
+    key = [k for k in snap if k.startswith("horovod_build_info")]
+    assert len(key) == 1 and snap[key[0]] == 1
+    assert "jax=" in key[0] and "version=" in key[0]
+    assert snap["horovod_uptime_seconds"] > 0
+    # Idempotent (init + elastic re-init both call it).
+    telemetry.register_build_info(reg)
+    assert len([k for k in reg.snapshot()
+                if k.startswith("horovod_build_info")]) == 1
+
+
+def test_histogram_window_honors_past_upper_edge():
+    """A window ending in the past (trailing-baseline windows) must not
+    absorb observations newer than its `now` — otherwise a regression's
+    own slow data inflates every baseline and masks itself."""
+    bounds = [1.0, 2.0]
+    h0 = {"count": 10, "sum": 0.0, "bounds": bounds, "counts": [10, 0, 0]}
+    h1 = {"count": 20, "sum": 0.0, "bounds": bounds, "counts": [20, 0, 0]}
+    h2 = {"count": 60, "sum": 0.0, "bounds": bounds, "counts": [20, 40, 0]}
+    samples = [(0, 0, {"h": h0}), (30, 30, {"h": h1}),
+               (60, 60, {"h": h2})]
+    # Baseline window [0, 30]: upper edge = sample@30, base = sample@0
+    # -> 10 fast obs only; the 40 slow obs at t=60 must NOT appear.
+    w = ts.histogram_window(samples, "h", window_s=30, now=30)
+    assert w["counts"] == [10, 0, 0], w
+    # Current window [30, 60] sees exactly the slow burst.
+    w2 = ts.histogram_window(samples, "h", window_s=30, now=60)
+    assert w2["counts"] == [0, 40, 0], w2
+    # A `now` before any sample has no upper edge -> None.
+    assert ts.histogram_window(samples, "h", window_s=30, now=-5) is None
