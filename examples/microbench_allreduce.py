@@ -235,6 +235,82 @@ def _bench_transport(hvd, np, args, seg_bytes):
     }
 
 
+def _bench_compression(hvd, np, args):
+    """Wire-compression acceptance measurement (docs/running.md "Wire
+    compression"): order-alternated paired rounds of the SAME allreduce
+    with the codec flipped none<->bf16 between barrier-separated timed
+    loops, at 1MB and 16MB. Per-arm steady-state tensor names: the
+    codec id is negotiated once per name and replays from the response
+    cache (codec choice is cache-replay-stable), so each arm's loops
+    measure the data plane under its own codec, not renegotiation.
+    Wire bytes are measured from the transport byte counters — exact
+    counter accounting, not computed from shapes."""
+    import os as _os
+    import time as _time
+
+    # Every response in the sweep must be eligible regardless of size.
+    _os.environ["HOROVOD_WIRE_COMPRESSION_MIN_BYTES"] = "0"
+
+    def wire_sent(snap):
+        return sum(v for k, v in snap.items()
+                   if k.startswith("horovod_transport_bytes_total")
+                   and 'direction="sent"' in k)
+
+    def timed(mode, x, iters):
+        _os.environ["HOROVOD_WIRE_COMPRESSION"] = mode
+        hvd.barrier()
+        before = wire_sent(hvd.metrics()["metrics"])
+        t0 = _time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(x, name=f"cb.{mode}.{x.size}", op=hvd.Sum)
+        dt = (_time.perf_counter() - t0) / iters
+        hvd.barrier()
+        sent = (wire_sent(hvd.metrics()["metrics"]) - before) / iters
+        return dt, sent
+
+    sizes = [262144, 4194304]  # 1MB / 16MB fp32
+    out = []
+    for count in sizes:
+        x = np.ones(count, np.float32)
+        timed("none", x, 2)  # warmup: negotiate both arms' names
+        timed("bf16", x, 2)
+        saved = hvd.metrics()["metrics"].get(
+            'horovod_wire_bytes_saved_total{codec="bf16"}', 0)
+        assert saved > 0, (
+            "compression mode measured nothing on the bf16 arm — did "
+            "the coordinator assign the codec?")
+        pairs = []
+        for r in range(args.compression_rounds):
+            if r % 2 == 0:
+                a = timed("none", x, args.compression_iters)
+                b = timed("bf16", x, args.compression_iters)
+            else:
+                b = timed("bf16", x, args.compression_iters)
+                a = timed("none", x, args.compression_iters)
+            pairs.append((a, b))
+        ratios = sorted(a[0] / b[0] for a, b in pairs)
+        none_ms = _percentile(sorted(a[0] for a, _ in pairs), 0.5) * 1e3
+        bf16_ms = _percentile(sorted(b[0] for _, b in pairs), 0.5) * 1e3
+        none_wire = _percentile(sorted(a[1] for a, _ in pairs), 0.5)
+        bf16_wire = _percentile(sorted(b[1] for _, b in pairs), 0.5)
+        out.append({
+            "bytes": int(x.nbytes),
+            "pairs_ms": [[round(a[0] * 1e3, 2), round(b[0] * 1e3, 2)]
+                         for a, b in pairs],
+            "none_ms_median": round(none_ms, 2),
+            "bf16_ms_median": round(bf16_ms, 2),
+            "none_wire_bytes_per_op": int(none_wire),
+            "bf16_wire_bytes_per_op": int(bf16_wire),
+            "wire_reduction": round(none_wire / max(bf16_wire, 1), 3),
+            "ratios": [round(v, 3) for v in ratios],
+            "median_speedup": round(_percentile(ratios, 0.5), 3),
+        })
+    _os.environ["HOROVOD_WIRE_COMPRESSION"] = "none"
+    return {"rows": out,
+            "wire_bytes_saved": hvd.metrics()["metrics"].get(
+                'horovod_wire_bytes_saved_total{codec="bf16"}', 0)}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="16384,262144,4194304",
@@ -252,14 +328,18 @@ def main():
                    help="HOROVOD_RING_SEGMENT_BYTES for the segmented "
                         "ring (default: the library default)")
     p.add_argument("--mode",
-                   choices=["bw", "latency", "pipeline", "transport"],
+                   choices=["bw", "latency", "pipeline", "transport",
+                            "compression"],
                    default="bw",
                    help="bw: the throughput sweep (default); latency: "
                         "small-op p50/p99 enqueue-to-complete, 1-vs-N "
                         "channels; pipeline: mixed-size async window, "
                         "channels=1 vs N paired rounds; transport: "
                         "tcp-vs-shm order-alternated paired rounds of "
-                        "the segmented ring on co-located ranks")
+                        "the segmented ring on co-located ranks; "
+                        "compression: none-vs-bf16 order-alternated "
+                        "paired rounds at 1MB/16MB with exact wire-byte "
+                        "counter accounting")
     p.add_argument("--channels", type=int, default=2,
                    help="the N in the 1-vs-N channel comparisons")
     p.add_argument("--lat-count", type=int, default=16384,
@@ -277,6 +357,10 @@ def main():
                    help="allreduces per timed arm in transport mode")
     p.add_argument("--transport-rounds", type=int, default=5,
                    help="tcp/shm paired rounds in transport mode")
+    p.add_argument("--compression-iters", type=int, default=5,
+                   help="allreduces per timed arm in compression mode")
+    p.add_argument("--compression-rounds", type=int, default=5,
+                   help="none/bf16 paired rounds in compression mode")
     args = p.parse_args()
 
     if args.mode == "transport":
@@ -330,6 +414,22 @@ def main():
                   f"shm {summary['shm_busbw_GBps']} GB/s busbw)")
             print(json.dumps(dict(
                 {"metric": "eager_allreduce_transport", "np": n},
+                **summary)))
+        return
+
+    if args.mode == "compression":
+        summary = _bench_compression(hvd, np, args)
+        if r == 0:
+            for row in summary["rows"]:
+                print(f"compression {row['bytes']} B: none "
+                      f"{row['none_ms_median']}ms vs bf16 "
+                      f"{row['bf16_ms_median']}ms "
+                      f"({row['median_speedup']}x), wire bytes "
+                      f"{row['none_wire_bytes_per_op']} -> "
+                      f"{row['bf16_wire_bytes_per_op']} "
+                      f"({row['wire_reduction']}x fewer)")
+            print(json.dumps(dict(
+                {"metric": "eager_allreduce_compression", "np": n},
                 **summary)))
         return
 

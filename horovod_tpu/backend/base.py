@@ -73,18 +73,56 @@ def channel_scope(channel: int):
             _channel_ctx.channel = prev
 
 
+# Active wire codec (docs/running.md "Wire compression"), thread-scoped
+# exactly like the channel: the engine sets it around each response
+# whose coordinator-assigned codec id is non-zero, and the data-plane
+# paths (ring segments, star frames, shm arena deposits) read it
+# instead of having a codec argument threaded through every collective
+# signature. Outside any scope — direct backend use, control plane —
+# there is no codec and every path behaves exactly as before.
+_codec_ctx = threading.local()
+
+
+def current_wire_codec():
+    """The calling thread's active wire codec (common/compression.py
+    WireCodec), or None."""
+    return getattr(_codec_ctx, "codec", None)
+
+
+def wire_codec_stats():
+    """The active codec scope's telemetry sink
+    (common/compression.py CompressionStats), or None."""
+    return getattr(_codec_ctx, "stats", None)
+
+
+@contextlib.contextmanager
+def wire_codec_scope(codec, stats=None):
+    prev = (getattr(_codec_ctx, "codec", None),
+            getattr(_codec_ctx, "stats", None))
+    _codec_ctx.codec, _codec_ctx.stats = codec, stats
+    try:
+        yield
+    finally:
+        _codec_ctx.codec, _codec_ctx.stats = prev
+
+
 def desync_message(got, want, rank: Optional[int] = None,
                    peer: Optional[int] = None) -> str:
     """The one place the frame-length-mismatch ("desynced peer") error
-    text and its HOROVOD_RING_SEGMENT_BYTES hint live. Ring protocols
-    are size-deterministic, so a length mismatch means the stream
-    position is unrecoverable — every transport (TCP, shm, in-process)
-    raises this same message so the hint can never drift."""
+    text and its env-knob hint live. Ring protocols are
+    size-deterministic, so a length mismatch means the stream position
+    is unrecoverable — every transport (TCP, shm, in-process) raises
+    this same message so the hint can never drift. The two knobs that
+    change frame sizes are the ring segment size and the wire codec
+    (a half-width bf16 frame meeting a full-width reader is exactly
+    this error); both are collectively agreed in-band (wire-carried
+    codec ids, launcher-propagated segment bytes), so hitting this
+    means version skew or hand-driven backends disagreeing."""
     who = f"rank {rank}: " if rank is not None else ""
     src = f" from peer {peer}" if peer is not None else ""
     return (f"{who}frame length {got} != expected {want}{src} "
-            f"(desynced peer; check HOROVOD_RING_SEGMENT_BYTES matches "
-            f"on every rank)")
+            f"(desynced peer; check HOROVOD_RING_SEGMENT_BYTES and "
+            f"HOROVOD_WIRE_COMPRESSION match on every rank)")
 
 
 class Backend(ControllerTransport):

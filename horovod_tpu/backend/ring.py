@@ -31,13 +31,19 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..common.exceptions import HorovodInternalError
 from ..common.types import ReduceOp
-from .base import _reduce, desync_message
+from .base import (
+    _reduce,
+    current_wire_codec,
+    desync_message,
+    wire_codec_stats,
+)
 from .transport import COMPLETED as _COMPLETED
 from .star import (
     StarCollectivesMixin,
@@ -83,6 +89,21 @@ def _reduce_into(op: ReduceOp, tgt: np.ndarray, incoming: np.ndarray):
         tgt[:] = _reduce(op, [tgt, incoming])
     else:
         ufunc(tgt, incoming, out=tgt)
+
+
+def _ring_codec(dtype):
+    """The ring phases' active wire codec: fixed-width (the ring
+    segments frames by ELEMENT offsets, so a codec with a per-tensor
+    header cannot be cut mid-stream — variable-width codecs ship
+    full-width here) and applicable to the payload dtype. Both inputs
+    are collectively consistent: the codec id rides the Response wire
+    message and the dtype is negotiated, so every rank takes the same
+    branch and frame sizes always agree."""
+    codec = current_wire_codec()
+    if (codec is not None and codec.wire_itemsize
+            and codec.applicable(dtype)):
+        return codec
+    return None
 
 
 # _COMPLETED (imported above): the transport layer's shared no-op
@@ -504,13 +525,29 @@ class RingCollectivesMixin(StarCollectivesMixin):
         max_chunk = max(bounds[i + 1] - bounds[i] for i in range(n))
         seg_cap = min(seg, max_chunk) if seg else max_chunk
         seg_cap = max(seg_cap, 1)
+        # Wire compression (docs/running.md "Wire compression"): with
+        # an active fixed-width codec each step encodes its send chunk
+        # once (segments are memoryview slices of the encoded buffer),
+        # receives the incoming chunk's encoded segments into a byte
+        # scratch, and decompresses-then-reduces per segment — the
+        # accumulation stays full-width, only the wire narrows.
+        # Segment bounds stay in ELEMENT space on both sides, so the
+        # sender's and receiver's frame byte counts agree by
+        # construction ((b-a) * wire_itemsize).
+        codec = _ring_codec(flat.dtype)
+        stats = wire_codec_stats() if codec is not None else None
+        wis = codec.wire_itemsize if codec is not None else 0
         # Two alternating scratch halves. Today recv and reduce run
         # sequentially on this thread (only the SEND side truly
         # overlaps, via the queued sender), so the second half buys no
         # wall-clock yet — it exists so segment k's recv target never
         # aliases segment k-1's reduce source, which is the invariant
         # an async recv/reduce split will need.
-        scratch = self._ring_scratch(flat.dtype, 2 * seg_cap)
+        if codec is None:
+            scratch = self._ring_scratch(flat.dtype, 2 * seg_cap)
+        else:
+            scratch = self._ring_scratch(
+                np.dtype(np.uint8), 2 * seg_cap * wis)
 
         def chunk(i):
             i %= n
@@ -526,18 +563,41 @@ class RingCollectivesMixin(StarCollectivesMixin):
             send_c = chunk(pos - s)
             tgt = chunk(pos - s - 1)
             sb = self._segment_bounds(send_c.size, seg)
-            tickets = [self.send_async(right, send_c[a:b])
-                       for a, b in zip(sb, sb[1:])]
+            if codec is None:
+                tickets = [self.send_async(right, send_c[a:b])
+                           for a, b in zip(sb, sb[1:])]
+            else:
+                t0 = time.perf_counter()
+                enc = codec.encode(send_c)
+                if stats is not None:
+                    stats.observe("encode", time.perf_counter() - t0)
+                    stats.saved(codec.name, send_c.nbytes - enc.nbytes)
+                # `enc` stays referenced until the tickets complete
+                # below, so the queued memoryview slices never dangle.
+                tickets = [self.send_async(right, enc[a * wis:b * wis])
+                           for a, b in zip(sb, sb[1:])]
             self._count_segments(len(tickets))
             rb = self._segment_bounds(tgt.size, seg)
+            dec_s = 0.0
             for k, (a, b) in enumerate(zip(rb, rb[1:])):
-                half = scratch[(k % 2) * seg_cap:][: b - a]
+                if codec is None:
+                    half = scratch[(k % 2) * seg_cap:][: b - a]
+                else:
+                    half = scratch[(k % 2) * seg_cap * wis:][: (b - a) * wis]
                 with tr.span("ring.recv", cat="xfer",
-                             args={"bytes": (b - a) * flat.itemsize}):
+                             args={"bytes": int(half.nbytes)}):
                     self.recv_into_from(left, half)
                 if b > a:
                     with tr.span("ring.reduce", cat="compute"):
-                        _reduce_into(red, tgt[a:b], half)
+                        if codec is None:
+                            _reduce_into(red, tgt[a:b], half)
+                        else:
+                            t0 = time.perf_counter()
+                            dec = codec.decode(half, b - a)
+                            dec_s += time.perf_counter() - t0
+                            _reduce_into(red, tgt[a:b], dec)
+            if stats is not None and dec_s:
+                stats.observe("decode", dec_s)
             with tr.span("ring.send_wait", cat="xfer",
                          args={"segments": len(tickets)}):
                 for t in tickets:
@@ -547,30 +607,75 @@ class RingCollectivesMixin(StarCollectivesMixin):
         """Ring allgather of the per-position chunks: position p starts
         owning chunk (p+1)%n; after n-1 rotations every rank holds all.
         Pipelined like the reduce-scatter, except incoming segments land
-        straight in their final chunk slice — no scratch, no copy."""
+        straight in their final chunk slice — no scratch, no copy (a
+        small decode scratch returns when a wire codec is active)."""
         n = len(group)
         pos = group.index(self.rank)
         right, left = group[(pos + 1) % n], group[(pos - 1) % n]
         bounds = self._bounds(flat.size, n)
         seg = self._segment_elems(flat.itemsize)
+        codec = _ring_codec(flat.dtype)
+        stats = wire_codec_stats() if codec is not None else None
+        wis = codec.wire_itemsize if codec is not None else 0
 
         def chunk(i):
             i %= n
             return flat[bounds[i]: bounds[i + 1]]
+
+        scratch = None
+        seg_cap = 0
+        if codec is not None:
+            # Project the chunk this rank OWNS (fully reduced in the
+            # scatter phase) onto the codec grid before the first send:
+            # receivers hold decode(encode(chunk)), so the owner must
+            # hold the same value or ranks finish with different
+            # results. Later rotations forward already-projected
+            # values, whose re-encode is lossless for the fixed-width
+            # codecs — so one projection at the source is enough.
+            own = chunk(pos + 1)
+            if own.size:
+                own[:] = codec.decode(codec.encode(own), own.size)
+            max_chunk = max(bounds[i + 1] - bounds[i] for i in range(n))
+            seg_cap = min(seg, max_chunk) if seg else max_chunk
+            seg_cap = max(seg_cap, 1)
+            scratch = self._ring_scratch(
+                np.dtype(np.uint8), 2 * seg_cap * wis)
 
         tr = self.tracer
         for s in range(n - 1):
             send_c = chunk(pos - s + 1)
             tgt = chunk(pos - s)
             sb = self._segment_bounds(send_c.size, seg)
-            tickets = [self.send_async(right, send_c[a:b])
-                       for a, b in zip(sb, sb[1:])]
+            if codec is None:
+                tickets = [self.send_async(right, send_c[a:b])
+                           for a, b in zip(sb, sb[1:])]
+            else:
+                t0 = time.perf_counter()
+                enc = codec.encode(send_c)
+                if stats is not None:
+                    stats.observe("encode", time.perf_counter() - t0)
+                    stats.saved(codec.name, send_c.nbytes - enc.nbytes)
+                tickets = [self.send_async(right, enc[a * wis:b * wis])
+                           for a, b in zip(sb, sb[1:])]
             self._count_segments(len(tickets))
             rb = self._segment_bounds(tgt.size, seg)
-            for a, b in zip(rb, rb[1:]):
+            dec_s = 0.0
+            for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                if codec is None:
+                    with tr.span("ring.recv", cat="xfer",
+                                 args={"bytes": (b - a) * flat.itemsize}):
+                        self.recv_into_from(left, tgt[a:b])
+                    continue
+                half = scratch[(k % 2) * seg_cap * wis:][: (b - a) * wis]
                 with tr.span("ring.recv", cat="xfer",
-                             args={"bytes": (b - a) * flat.itemsize}):
-                    self.recv_into_from(left, tgt[a:b])
+                             args={"bytes": int(half.nbytes)}):
+                    self.recv_into_from(left, half)
+                if b > a:
+                    t0 = time.perf_counter()
+                    tgt[a:b] = codec.decode(half, b - a)
+                    dec_s += time.perf_counter() - t0
+            if stats is not None and dec_s:
+                stats.observe("decode", dec_s)
             with tr.span("ring.send_wait", cat="xfer",
                          args={"segments": len(tickets)}):
                 for t in tickets:
@@ -615,13 +720,22 @@ class RingCollectivesMixin(StarCollectivesMixin):
         red = op if op != ReduceOp.AVERAGE else ReduceOp.SUM
         ufunc = _INPLACE_UFUNC[red]
         arena = self.arena_set.get(current_channel())
+        # Wire compression: the arena deposits ENCODED slots (halving
+        # the aggregate private->shared memcpy that bounds this box's
+        # shm throughput) and each reducer decodes peers' subslices on
+        # the fly; the shared result stays full-width, so the copy-out
+        # and the returned values are fp32 (docs/running.md "Wire
+        # compression"). Fixed-width codecs only, like the ring.
+        codec = _ring_codec(flat.dtype)
         tr = self.tracer
         try:
             with tr.span("shm.arena_allreduce", cat="xfer",
                          args={"bytes": int(flat.nbytes)}):
                 arena.allreduce_into(
                     flat, lambda dst, src: ufunc(dst, src, out=dst),
-                    out=out)
+                    out=out, codec=codec,
+                    stats=wire_codec_stats() if codec is not None
+                    else None)
         except (OSError, TimeoutError) as exc:
             from ..common.exceptions import TransportError
 

@@ -655,7 +655,8 @@ class ShmArena:
         return self._slot(self.size)
 
     # -- collectives ---------------------------------------------------
-    def allreduce_into(self, flat, reduce_fn, out=None) -> None:
+    def allreduce_into(self, flat, reduce_fn, out=None, codec=None,
+                       stats=None) -> None:
         """Allreduce of a contiguous 1-D numpy array: reads ``flat``,
         writes ``out`` (defaults to ``flat`` — in place). Separate
         src/dst is what lets the caller skip the ring path's defensive
@@ -666,12 +667,26 @@ class ShmArena:
         arena: deposit → barrier → every rank reduces its equal
         subslice straight from all slots into the shared result →
         barrier → copy out → barrier (so the next chunk can never
-        clobber a result a laggard is still reading)."""
+        clobber a result a laggard is still reading).
+
+        With a fixed-width wire ``codec`` (docs/running.md "Wire
+        compression") the DEPOSIT leg is encoded — the private→shared
+        memcpy that dominates this path halves — and each reducer
+        decodes peers' subslices on the fly; the shared result and the
+        copy-out stay full-width, so results are bitwise identical on
+        every rank exactly as before. Chunk layout is unchanged (the
+        encoded chunk always fits the slot its full-width form fits),
+        so compressed and uncompressed runs stream the same chunks.
+        The per-transport byte counters stay wire truth: ``sent``
+        counts deposited (encoded) bytes, ``recv`` counts the
+        full-width copy-out — under compression the two legitimately
+        differ (docs/metrics.md)."""
         import numpy as np
 
         if out is None:
             out = flat
         itemsize = flat.itemsize
+        wis = codec.wire_itemsize if codec is not None else itemsize
         chunk_elems = max(self.slot_bytes // itemsize, 1)
         total = flat.size
         src_u8 = flat.view(np.uint8).reshape(-1)
@@ -680,9 +695,19 @@ class ShmArena:
         for start in range(0, max(total, 1), chunk_elems):
             n = min(chunk_elems, total - start)
             nbytes = n * itemsize
-            # Phase 1: deposit my chunk.
-            self._slot(self.index)[:nbytes] = \
-                src_u8[start * itemsize:start * itemsize + nbytes]
+            # Phase 1: deposit my chunk (encoded when a codec rides).
+            if codec is None:
+                dep_bytes = nbytes
+                self._slot(self.index)[:nbytes] = \
+                    src_u8[start * itemsize:start * itemsize + nbytes]
+            else:
+                t0 = time.perf_counter()
+                enc = codec.encode(flat[start:start + n])
+                dep_bytes = enc.nbytes
+                self._slot(self.index)[:dep_bytes] = enc
+                if stats is not None:
+                    stats.observe("encode", time.perf_counter() - t0)
+                    stats.saved(codec.name, nbytes - dep_bytes)
             self._publish(g + 1)
             self._wait_all(g + 1, "deposit barrier")
             # Phase 2: reduce my subslice from every slot into the
@@ -693,13 +718,30 @@ class ShmArena:
             lo = self.index * base + min(self.index, rem)
             hi = lo + base + (1 if self.index < rem else 0)
             if hi > lo:
-                span = slice(lo * itemsize, hi * itemsize)
-                res = np.frombuffer(self._result[span], dtype=flat.dtype)
-                res[:] = np.frombuffer(
-                    self._slot(0)[span], dtype=flat.dtype)
-                for r in range(1, self.size):
-                    reduce_fn(res, np.frombuffer(
-                        self._slot(r)[span], dtype=flat.dtype))
+                res = np.frombuffer(
+                    self._result[lo * itemsize:hi * itemsize],
+                    dtype=flat.dtype)
+                if codec is None:
+                    span = slice(lo * itemsize, hi * itemsize)
+                    res[:] = np.frombuffer(
+                        self._slot(0)[span], dtype=flat.dtype)
+                    for r in range(1, self.size):
+                        reduce_fn(res, np.frombuffer(
+                            self._slot(r)[span], dtype=flat.dtype))
+                else:
+                    span = slice(lo * wis, hi * wis)
+                    t0 = time.perf_counter()
+                    res[:] = codec.decode(self._slot(0)[span], hi - lo)
+                    for r in range(1, self.size):
+                        reduce_fn(res, codec.decode(
+                            self._slot(r)[span], hi - lo))
+                    if stats is not None:
+                        # decode+reduce fused over peers' slots — the
+                        # decode share dominates, close enough for the
+                        # pays-off-here comparison docs/metrics.md
+                        # prescribes.
+                        stats.observe("decode",
+                                      time.perf_counter() - t0)
             self._publish(g + 2)
             self._wait_all(g + 2, "reduce barrier")
             # Phase 3: copy the finished chunk out and PUBLISH the
@@ -716,7 +758,7 @@ class ShmArena:
             self._publish(g + 3)
             g += 3
             if self.m_sent is not None:
-                self.m_sent.inc(nbytes)
+                self.m_sent.inc(dep_bytes)
             if self.m_recv is not None:
                 self.m_recv.inc(nbytes)
         self._gen = g

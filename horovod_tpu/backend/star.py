@@ -10,12 +10,13 @@ this path serves CPU process-mode and tests.)
 from __future__ import annotations
 
 import struct
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from ..common.types import ReduceOp
-from .base import Backend, _reduce
+from .base import Backend, _reduce, current_wire_codec, wire_codec_stats
 
 _LEN = struct.Struct("<Q")
 
@@ -45,6 +46,39 @@ def unpack_array(buf) -> np.ndarray:
     dtype_str, shape_str = head.split(";")
     shape = tuple(int(s) for s in shape_str.split(",")) if shape_str else ()
     return np.frombuffer(view[8 + hn :], dtype=np.dtype(dtype_str)).reshape(shape)
+
+
+def pack_wire(arr: np.ndarray, codec, enc: np.ndarray) -> list:
+    """Compressed array frame [header, encoded-payload] (docs/running.md
+    "Wire compression"): like pack_array but the payload is the codec's
+    wire bytes and the header names the codec, so the peer decodes
+    without out-of-band state. `enc` is passed in (not recomputed) so
+    call sites can count wire savings and reuse the encode."""
+    head = (f"{arr.dtype.str};{','.join(map(str, arr.shape))};"
+            f"{codec.name}").encode()
+    return [_LEN.pack(len(head)) + head, memoryview(enc)]
+
+
+def unpack_wire(buf) -> np.ndarray:
+    """Decode a pack_wire frame back to a full-width array. The result
+    is freshly allocated by the codec decode — always owned and
+    writable, unlike unpack_array's aliasing view."""
+    from ..common import compression
+
+    view = buf if isinstance(buf, memoryview) else memoryview(buf)
+    (hn,) = _LEN.unpack(view[:8])
+    head = bytes(view[8: 8 + hn]).decode()
+    dtype_str, shape_str, codec_name = head.split(";")
+    shape = tuple(int(s) for s in shape_str.split(",")) if shape_str else ()
+    codec = compression.codec_by_name(codec_name)
+    if codec is None:
+        raise ValueError(f"unknown wire codec {codec_name!r} in frame "
+                         f"(version skew between ranks?)")
+    count = 1
+    for d in shape:
+        count *= d
+    out = codec.decode(view[8 + hn:], count)
+    return out.astype(np.dtype(dtype_str), copy=False).reshape(shape)
 
 
 def own_array(a: np.ndarray) -> np.ndarray:
@@ -92,6 +126,9 @@ class StarCollectivesMixin(Backend):
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         if self.size == 1:
             return arr.copy()
+        codec = current_wire_codec()
+        if codec is not None and codec.applicable(arr.dtype):
+            return self._allreduce_compressed(arr, op, codec)
         # Tracing-plane phase spans (docs/tracing.md): gather / reduce /
         # bcast, inheriting the executor's trace scope so the merged
         # trace shows which phase of WHICH collective ate the time.
@@ -112,6 +149,63 @@ class StarCollectivesMixin(Backend):
         with tr.span("star.bcast", cat="xfer"):
             out = own_array(unpack_array(self.bcast_bytes(None)))
         return out.reshape(arr.shape) if arr.size and out.size == arr.size else out
+
+    def _allreduce_compressed(self, arr: np.ndarray, op: ReduceOp,
+                              codec) -> np.ndarray:
+        """Compressed star allreduce (docs/running.md "Wire
+        compression"): every rank gathers its payload ENCODED, the
+        root decodes and reduces in full-width fp32, then broadcasts
+        the result encoded again — both legs ship the codec's bytes.
+        The root's own return value is the DECODED result (not its
+        full-width reduction): every rank must finish holding the
+        bitwise-identical value its peers decoded off the wire, the
+        same determinism contract the uncompressed path has."""
+        tr = self.tracer
+        stats = wire_codec_stats()
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        t0 = time.perf_counter()
+        enc = codec.encode(flat)
+        if stats is not None:
+            stats.observe("encode", time.perf_counter() - t0)
+            if self.rank != 0:
+                # Only frames that actually hit a transport count as
+                # wire savings; rank 0's gather contribution is local.
+                stats.saved(codec.name, flat.nbytes - enc.nbytes)
+        with tr.span("star.gather", cat="xfer",
+                     args={"bytes": int(enc.nbytes), "codec": codec.name}):
+            gathered = self.gather_bytes(pack_wire(flat, codec, enc))
+        if self.rank == 0:
+            with tr.span("star.reduce", cat="compute"):
+                t0 = time.perf_counter()
+                arrays = [unpack_wire(b) for b in gathered]
+                if stats is not None:
+                    stats.observe("decode", time.perf_counter() - t0)
+                nonempty = [a for a in arrays if a.size > 0]
+                out = _reduce(op, nonempty) if nonempty else arrays[0]
+            out_flat = np.ascontiguousarray(out).reshape(-1)
+            t0 = time.perf_counter()
+            enc_out = codec.encode(out_flat)
+            # What every peer will decode — and what this rank must
+            # return for bitwise cross-rank agreement.
+            result = codec.decode(enc_out, out_flat.size)
+            if stats is not None:
+                stats.observe("encode", time.perf_counter() - t0)
+                stats.saved(codec.name, (self.size - 1)
+                            * (out_flat.nbytes - enc_out.nbytes))
+            with tr.span("star.bcast", cat="xfer",
+                         args={"bytes": int(enc_out.nbytes)}):
+                self.bcast_bytes(pack_wire(out_flat, codec, enc_out))
+            result = result.astype(arr.dtype, copy=False)
+            return result.reshape(arr.shape) if arr.size else result
+        with tr.span("star.bcast", cat="xfer"):
+            blob = self.bcast_bytes(None)
+        t0 = time.perf_counter()
+        out = unpack_wire(blob)
+        if stats is not None:
+            stats.observe("decode", time.perf_counter() - t0)
+        out = out.reshape(-1).astype(arr.dtype, copy=False)
+        return (out.reshape(arr.shape)
+                if arr.size and out.size == arr.size else out)
 
     def adasum_allreduce_all(self, arr: np.ndarray) -> np.ndarray:
         if self.size == 1:

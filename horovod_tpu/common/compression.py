@@ -1,0 +1,428 @@
+"""Gradient compression — the single-source core.
+
+Two layers live here, deliberately in one file so they can never drift:
+
+* the **framework `Compression` API** (ref: horovod/torch/compression.py:
+  20-74, horovod/tensorflow/compression.py:46-64): tensor-level
+  compress/decompress pairs applied by `DistributedOptimizer` wrappers
+  BEFORE a tensor is enqueued. `ops/compression.py`,
+  `tensorflow/compression.py` and `torch/compression.py` are thin
+  re-exports of the interface plus their tensor-type adapters (jnp / tf
+  / torch casts) — the same single-source treatment PR 8 gave
+  `base.desync_message`.
+
+* the **wire codec layer** (docs/running.md "Wire compression"): numpy
+  byte-level codecs the collective data plane applies to the frames it
+  actually ships — ring segments, star gather/bcast payloads, shm arena
+  deposits. Unlike the framework API (which converts the tensor the
+  engine then carries end-to-end), a wire codec halves the bytes ON THE
+  WIRE while the engine, the reduction arithmetic and the user-visible
+  result stay full-width fp32. The coordinator picks a codec per
+  `Response` (engine/controller.py `_assign_codecs`) and carries its id
+  in the wire message next to the PR 4 channel id, so the choice is
+  collectively agreed and cache-replay-stable by construction.
+
+Error feedback (`ErrorFeedback` below) is the accuracy device: each
+rank keeps a per-tensor residual, adds it to the gradient before
+encoding, and stores the new residual = pre-encode value minus the
+decoded wire value — the construction of 1-bit SGD (Seide et al. 2014)
+formalized by Karimireddy et al. 2019 ("Error Feedback Fixes
+SignSGD"): the quantization error is not lost, it is re-injected into
+the next step, so compressed SGD converges to the uncompressed
+optimum.
+
+Rank-consistency contract: the engine projects every contribution onto
+the codec grid (decode∘encode) BEFORE the collective runs, and every
+data-plane path that ships a full-width-held value compressed
+re-projects it on the sending side (ring allgather owners, star root),
+so all ranks finish a collective holding bitwise-identical results —
+the same determinism the uncompressed planes guarantee.
+"""
+from __future__ import annotations
+
+import collections
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# framework Compression API (interface + identity; adapters live in the
+# framework modules)
+
+
+class Compressor:
+    """Interface for framework-level gradient compression
+    (ref: compression.py:24-35)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity compressor (ref: compression.py NoneCompressor) —
+    framework-agnostic, so every binding shares this one."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+# ---------------------------------------------------------------------------
+# wire codecs (data-plane, numpy)
+
+CODEC_NONE = 0
+CODEC_BF16 = 1
+CODEC_FP16 = 2
+CODEC_INT8 = 3
+
+_SCALE = struct.Struct("<f")
+
+# ml_dtypes (a jax dependency) implements bfloat16 as a native numpy
+# dtype: one C cast pass each way, ~4x faster than the pure-numpy bit
+# path below and bit-identical to it (round-to-nearest-even, NaN
+# preserved — asserted by the codec property tests). The bit path is
+# the no-dependency fallback, so the codec layer never *requires*
+# anything beyond numpy.
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _BF16_DTYPE = np.dtype(_ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - jax images ship ml_dtypes
+    _BF16_DTYPE = None
+
+
+class WireCodec:
+    """One on-wire encoding: fp32 elements -> wire bytes and back.
+
+    ``encode`` returns a flat uint8 array (scale headers included for
+    variable-width codecs); ``decode`` reconstructs fp32 from any
+    buffer-protocol object. ``wire_itemsize`` is the fixed bytes per
+    element, or None for codecs with a per-tensor header (int8+scale)
+    — the ring and the arena slice frames/slots by element offsets,
+    so they only engage fixed-width codecs; the star path (whole
+    tensors per frame) handles all of them.
+    """
+
+    id = CODEC_NONE
+    name = "none"
+    wire_itemsize: Optional[int] = None
+
+    def applicable(self, dtype) -> bool:
+        """Wire codecs narrow fp32 payloads; everything else ships
+        full-width. dtype is negotiated, so the gate is collectively
+        consistent."""
+        return np.dtype(dtype) == np.float32
+
+    def wire_bytes(self, count: int) -> int:
+        raise NotImplementedError
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def decode(self, buf, count: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def roundtrip(self, arr: np.ndarray) -> np.ndarray:
+        """Project onto the codec grid: decode(encode(arr))."""
+        return self.decode(self.encode(arr), int(np.asarray(arr).size))
+
+
+class Bf16Codec(WireCodec):
+    """bfloat16 on the wire — the TPU-native reduced type: same 8-bit
+    exponent as fp32 (no overflow on gradients), 7 mantissa bits.
+    Encode is a round-to-nearest-even narrowing of the fp32 high half,
+    decode a widening — one C cast pass each way via ml_dtypes when
+    present, else vectorized numpy bit manipulation (bit-identical,
+    ~4x slower; numpy has no native bf16)."""
+
+    id = CODEC_BF16
+    name = "bf16"
+    wire_itemsize = 2
+
+    def wire_bytes(self, count: int) -> int:
+        return 2 * count
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        if _BF16_DTYPE is not None:
+            return a.astype(_BF16_DTYPE).view(np.uint8)
+        u = a.view(np.uint32)
+        lsb = (u >> np.uint32(16)) & np.uint32(1)
+        out = ((u + np.uint32(0x7FFF) + lsb) >> np.uint32(16)).astype(
+            np.uint16)
+        special = (u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)
+        if special.any():
+            # inf/nan: truncate (rounding could wrap a NaN payload into
+            # +0) and keep NaNs NaN by pinning a mantissa bit.
+            t = (u >> np.uint32(16)).astype(np.uint16)
+            nan = special & ((u & np.uint32(0x007FFFFF)) != 0)
+            t = np.where(nan, t | np.uint16(0x0040), t)
+            out = np.where(special, t, out)
+        return out.view(np.uint8)
+
+    def decode(self, buf, count: int) -> np.ndarray:
+        if _BF16_DTYPE is not None:
+            return np.frombuffer(
+                buf, dtype=_BF16_DTYPE, count=count).astype(np.float32)
+        u16 = np.frombuffer(buf, dtype=np.uint16, count=count)
+        return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
+
+
+class Fp16Codec(WireCodec):
+    """IEEE fp16 on the wire: 10 mantissa bits (finer than bf16) but a
+    5-bit exponent — values past ~65504 saturate to inf. numpy-native
+    casts both ways."""
+
+    id = CODEC_FP16
+    name = "fp16"
+    wire_itemsize = 2
+
+    def wire_bytes(self, count: int) -> int:
+        return 2 * count
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        with np.errstate(over="ignore"):  # >65504 saturates to inf
+            return a.astype(np.float16).view(np.uint8)
+
+    def decode(self, buf, count: int) -> np.ndarray:
+        return np.frombuffer(
+            buf, dtype=np.float16, count=count).astype(np.float32)
+
+
+class Int8Codec(WireCodec):
+    """Linear int8 quantization with one per-encode fp32 scale carried
+    as a 4-byte payload header (wire cost: count + 4 bytes — 4x fewer
+    than fp32 for anything non-trivial). scale = max|finite value|/127;
+    non-finite inputs clip to the extremes (the error-feedback residual
+    keeps what quantization drops). Opt-in for the latency channel —
+    small control-ish tensors where 4x on a ~latency-bound frame
+    matters and coarse quantization is tolerable."""
+
+    id = CODEC_INT8
+    name = "int8"
+    wire_itemsize = None  # variable (scale header): star path only
+
+    def wire_bytes(self, count: int) -> int:
+        return count + _SCALE.size
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        scale = 0.0
+        if a.size:
+            finite = a[np.isfinite(a)]
+            if finite.size:
+                scale = float(np.max(np.abs(finite))) / 127.0
+        if not np.isfinite(scale) or scale <= 0.0:
+            scale = 0.0
+        out = np.empty(_SCALE.size + a.size, np.uint8)
+        out[:_SCALE.size] = np.frombuffer(_SCALE.pack(scale), np.uint8)
+        if scale:
+            q = np.clip(np.round(a / np.float32(scale)), -127, 127)
+            q = np.nan_to_num(q, nan=0.0, posinf=127.0, neginf=-127.0)
+            out[_SCALE.size:] = q.astype(np.int8).view(np.uint8)
+        else:
+            out[_SCALE.size:] = 0
+        return out
+
+    def decode(self, buf, count: int) -> np.ndarray:
+        view = memoryview(buf)
+        (scale,) = _SCALE.unpack(bytes(view[:_SCALE.size]))
+        q = np.frombuffer(view, dtype=np.int8, count=count,
+                          offset=_SCALE.size)
+        return q.astype(np.float32) * np.float32(scale)
+
+
+_CODECS_BY_ID: Dict[int, WireCodec] = {
+    c.id: c for c in (Bf16Codec(), Fp16Codec(), Int8Codec())
+}
+_CODECS_BY_NAME: Dict[str, WireCodec] = {
+    c.name: c for c in _CODECS_BY_ID.values()
+}
+
+
+def codec_by_id(codec_id: int) -> Optional[WireCodec]:
+    """Resolve a wire-carried codec id; 0/unknown -> None (ship
+    full-width — an unknown id from a newer coordinator degrades to
+    uncompressed rather than desyncing, because the id is collectively
+    agreed so every rank degrades identically)."""
+    return _CODECS_BY_ID.get(codec_id)
+
+
+def codec_by_name(name: str) -> Optional[WireCodec]:
+    return _CODECS_BY_NAME.get(name)
+
+
+# ---------------------------------------------------------------------------
+# error feedback
+
+
+class ErrorFeedback:
+    """Per-(tensor-name) quantization residuals for one engine/rank.
+
+    Lifecycle: owned by the Engine, so an elastic reset (which builds a
+    fresh Engine) starts from zero residuals on every rank at the same
+    step — the consistency the reset protocol needs. Cache-replayed
+    responses carry the same tensor names in the same order on every
+    rank, so the keys line up across ranks without any coordination. A
+    residual whose size no longer matches (re-negotiated shape; the
+    response cache invalidates in the same cycle on every rank) is
+    dropped rather than misapplied.
+
+    Capacity: the store holds at most ``capacity`` residuals (default
+    matching the response cache's 1024), evicting the least recently
+    updated — a workload enqueueing uniquely-named allreduces (or a
+    fusion regrouping churning the joined-name keys) must leak
+    warnings' worth of accuracy, never unbounded full-width fp32
+    buffers. An evicted steady-state tensor simply restarts error
+    feedback from a zero residual, exactly like a fresh engine.
+
+    Thread model: response keys are disjoint across channel executors
+    (one response runs on one channel at a time); the recency
+    bookkeeping shares one lock — one acquire per op, noise next to
+    the multi-MB codec passes it brackets.
+    """
+
+    # Mirrors DEFAULT_CACHE_CAPACITY (utils/env.py): residual keys are
+    # response-cache keys (joined tensor names), so the two populations
+    # are the same order of magnitude in steady state.
+    DEFAULT_CAPACITY = 1024
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(int(capacity), 1)
+        self._store: "collections.OrderedDict[str, np.ndarray]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: str, size: int) -> Optional[np.ndarray]:
+        with self._lock:
+            r = self._store.get(key)
+        return r if r is not None and r.size == size else None
+
+    def put(self, key: str, residual: np.ndarray) -> None:
+        # Quantizer saturation defense: fp16 overflows finite inputs
+        # to inf, making residual = pre - inf = -inf; next round
+        # pre + (-inf) is -inf and the round after that NaN — a
+        # permanently poisoned tensor from one out-of-range gradient.
+        # A saturated lane's difference is meaningless anyway, so a
+        # non-finite residual entry resets to 0 (the wire value still
+        # carries the inf/NaN to the user for THIS round).
+        if not np.isfinite(residual).all():
+            residual = np.nan_to_num(residual, nan=0.0, posinf=0.0,
+                                     neginf=0.0)
+        with self._lock:
+            self._store[key] = residual
+            self._store.move_to_end(key)
+            while len(self._store) > self.capacity:
+                self._store.popitem(last=False)
+
+    def update(self, key: str, pre: np.ndarray,
+               wire: np.ndarray) -> None:
+        """Store residual = pre - wire, reusing the previous residual's
+        buffer when shapes match (a 16MB np.subtract into fresh memory
+        pays page-fault cost every step; the residual is dead the
+        moment the new one is computed, so it is the natural scratch).
+        ``pre`` may alias the old residual's CONSUMER (the engine adds
+        the residual into the gradient buffer, not into the residual),
+        never the residual itself, so the in-place write is safe."""
+        with self._lock:
+            old = self._store.get(key)
+        if old is not None and old.size == pre.size \
+                and old.dtype == pre.dtype:
+            np.subtract(pre, wire, out=old)
+            if not np.isfinite(old).all():  # see put()
+                np.nan_to_num(old, copy=False, nan=0.0, posinf=0.0,
+                              neginf=0.0)
+            with self._lock:
+                self._store[key] = old
+                self._store.move_to_end(key)
+        else:
+            self.put(key, pre - wire)
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+    def size(self) -> int:
+        return len(self._store)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(int(r.nbytes) for r in self._store.values())
+
+
+# ---------------------------------------------------------------------------
+# telemetry sink
+
+
+class CompressionStats:
+    """The wire-compression telemetry sink the engine threads through
+    the codec scope (backend/base.py wire_codec_scope) so every
+    data-plane encode site — ring segments, star frames, arena
+    deposits — counts into the SAME per-engine registry:
+
+    * ``horovod_wire_bytes_saved_total{codec=}`` — wire bytes NOT
+      moved thanks to the codec, counted per frame actually handed to
+      a transport (a star root's broadcast counts once per peer; its
+      own local contribution never counts — the number is wire truth,
+      not an estimate);
+    * ``horovod_compression_seconds{phase=}`` — encode / decode /
+      feedback (the engine's error-feedback projection) latency.
+    """
+
+    def __init__(self, registry=None):
+        from . import telemetry
+
+        self._registry = (registry if registry is not None
+                          else telemetry.default_registry())
+        self._saved: Dict[str, object] = {}
+        self._seconds: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def saved(self, codec_name: str, nbytes: int) -> None:
+        c = self._saved.get(codec_name)
+        if c is None:
+            with self._lock:
+                c = self._saved.get(codec_name)
+                if c is None:
+                    c = self._registry.counter(
+                        "horovod_wire_bytes_saved_total",
+                        "Wire bytes not moved thanks to on-wire "
+                        "compression (per transport frame)",
+                        labels={"codec": codec_name})
+                    self._saved[codec_name] = c
+        c.inc(nbytes)
+
+    def observe(self, phase: str, seconds: float) -> None:
+        h = self._seconds.get(phase)
+        if h is None:
+            with self._lock:
+                h = self._seconds.get(phase)
+                if h is None:
+                    h = self._registry.histogram(
+                        "horovod_compression_seconds",
+                        "Wire codec encode/decode latency by phase",
+                        labels={"phase": phase})
+                    self._seconds[phase] = h
+        h.observe(seconds)
+
+    def saved_snapshot(self) -> Dict[str, float]:
+        # Under the lock: a /status scrape iterating here races the
+        # first compressed op of a new codec inserting its counter.
+        with self._lock:
+            return {name: c.value for name, c in self._saved.items()}

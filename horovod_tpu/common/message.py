@@ -190,10 +190,18 @@ class Response:
     # sequence instead (odd id space; the cache fast path exchanges no
     # per-response bytes).
     trace_id: int = 0
+    # Wire codec id (common/compression.py CODEC_*) the coordinator
+    # assigned for this response's data-plane frames — 0 = full-width.
+    # Wire-carried next to the channel id for the same reason: codec
+    # choice MUST be collectively agreed (a half-width frame meeting a
+    # full-width reader is a desync) and cache-replay-stable (the
+    # cached Response carries it, so every replay re-applies the codec
+    # it was negotiated with, on every rank, joined ranks included).
+    codec: int = 0
 
     def serialize(self) -> bytes:
         out = struct.pack(
-            "<iiddiiiq",
+            "<iiddiiiqi",
             int(self.response_type),
             int(self.tensor_type),
             self.prescale_factor,
@@ -202,6 +210,7 @@ class Response:
             self.reduce_op,
             self.channel,
             self.trace_id,
+            self.codec,
         )
         out += struct.pack("<I", len(self.tensor_names))
         for n in self.tensor_names:
@@ -216,9 +225,9 @@ class Response:
 
     @staticmethod
     def deserialize(buf: bytes, off: int = 0) -> Tuple["Response", int]:
-        rt, tt, pre, post, ljr, rop, chan, trace_id = struct.unpack_from(
-            "<iiddiiiq", buf, off)
-        off += struct.calcsize("<iiddiiiq")
+        rt, tt, pre, post, ljr, rop, chan, trace_id, codec = \
+            struct.unpack_from("<iiddiiiqi", buf, off)
+        off += struct.calcsize("<iiddiiiqi")
         (n,) = struct.unpack_from("<I", buf, off)
         off += 4
         names = []
@@ -237,7 +246,7 @@ class Response:
         return (
             Response(ResponseType(rt), names, err, [int(d) for d in devices],
                      sizes, DataType(tt), pre, post, ljr, shapes, rop, chan,
-                     trace_id),
+                     trace_id, codec),
             off,
         )
 

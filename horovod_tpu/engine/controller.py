@@ -413,6 +413,7 @@ class Controller:
                 new_responses = [self._construct_response(n) for n in ready_names]
                 fused = self._fuse_responses(new_responses)
                 self._assign_channels(fused)
+                self._assign_codecs(fused)
                 negotiated.extend(fused)
                 if join_resp is not None:
                     negotiated.append(join_resp)
@@ -610,6 +611,64 @@ class Controller:
                 self._next_channel = 0
             resp.channel = self._next_channel
             self._next_channel = (self._next_channel + 1) % bulk
+
+    # ------------------------------------------------------------------
+    def _assign_codecs(self, responses: List[Response]):
+        """Wire-codec assignment (coordinator side; the codec id rides
+        the Response wire message next to the channel id, so every
+        rank — workers and joined ranks replaying cached responses
+        alike — applies the same codec to the same response's frames;
+        a per-rank env read here would half-compress a collective and
+        desync the stream). Policy (docs/running.md "Wire
+        compression"): fp32 SUM allreduces at or above
+        HOROVOD_WIRE_COMPRESSION_MIN_BYTES get the configured codec
+        (auto = bf16, the TPU-native pick); with the int8 opt-in,
+        responses on the size policy's latency lane quantize to
+        int8-with-scale instead. MIN/MAX/PRODUCT reduces and non-fp32
+        payloads always ship full-width — quantizing a comparison
+        reduce changes its semantics, not just its precision. Every
+        input is negotiated, so the decision is deterministic from the
+        wire message alone."""
+        mode = env_cfg.wire_compression_mode()
+        if mode == "none":
+            return
+        from ..common import compression
+
+        wide = (compression.CODEC_FP16 if mode == "fp16"
+                else compression.CODEC_BF16)
+        min_bytes = env_cfg.wire_compression_min_bytes()
+        nchan = env_cfg.num_channels()
+        latency_ch = (nchan - 1
+                      if nchan > 1 and env_cfg.channel_policy() == "size"
+                      else None)
+        int8_on = env_cfg.wire_compression_int8()
+        for resp in responses:
+            if (resp.response_type != ResponseType.ALLREDUCE
+                    or resp.error_message):
+                continue
+            if DataType(resp.tensor_type) != DataType.FLOAT32:
+                continue
+            if resp.reduce_op not in (0, int(ReduceOp.SUM)):
+                continue
+            nbytes = sum(self._byte_size(resp, n)
+                         for n in resp.tensor_names)
+            if (int8_on and latency_ch is not None
+                    and resp.channel == latency_ch):
+                # int8 is variable-width (scale header), so only the
+                # star path ships it compressed — and only STAR-BOUND
+                # sizes may carry the assignment: a ring/arena-eligible
+                # payload would pay the engine's coarse int8 grid
+                # projection (4x accuracy loss) while shipping
+                # full-width anyway (zero savings). ring_threshold is
+                # launcher-propagated like every data-plane knob, so
+                # the gate is collectively consistent.
+                from ..backend.ring import ring_threshold
+
+                if nbytes < ring_threshold():
+                    resp.codec = compression.CODEC_INT8
+                    continue
+            if nbytes >= min_bytes:
+                resp.codec = wide
 
     # ------------------------------------------------------------------
     # tracing plane (docs/tracing.md)

@@ -10,6 +10,16 @@ queued and cached) and OR their invalid bits. Capacity default 1024
 Under jit this machinery is unnecessary (the op set is static — the
 cache's fast path is the compiled program itself); it serves the eager
 process-mode engine.
+
+Wire-compression note (docs/running.md "Wire compression"): the cached
+object is the full negotiated Response, so the coordinator-assigned
+wire codec id replays with it — on every rank, joined ranks included —
+exactly like the executor channel. That is what makes codec choice
+cache-replay-stable: a steady-state tensor keeps the codec it was
+negotiated with even if HOROVOD_WIRE_COMPRESSION changes on rank 0
+mid-run (the new policy applies from the next renegotiation, e.g.
+after a shape-change invalidation), and no rank can ever replay a
+response at a different wire width than its peers.
 """
 from __future__ import annotations
 

@@ -1,36 +1,39 @@
 """Gradient compression (ref: horovod/torch/compression.py:20-74,
 horovod/tensorflow/compression.py:46-64).
 
-The reference ships a none-compressor and an fp16 compressor. On TPU the
-natural compressed wire type is bfloat16 (same byte savings as fp16,
-wider exponent range, native MXU type), so `Compression.fp16` maps to
-bf16 by default; `Compression.true_fp16` keeps IEEE fp16 for parity.
+The interface (`Compressor`) and the identity compressor live ONCE in
+`common/compression.py` — the same module that implements the
+data-plane wire codecs (docs/running.md "Wire compression") — so the
+three framework namespaces (this one, `tensorflow/compression.py`,
+`torch/compression.py`) can never drift: each is a thin re-export plus
+its tensor-type adapters. This module carries the JAX adapters.
+
+The reference ships a none-compressor and an fp16 compressor. On TPU
+the natural compressed wire type is bfloat16 (same byte savings as
+fp16, wider exponent range, native MXU type), so `Compression.fp16`
+maps to bf16 by default; `Compression.true_fp16` keeps IEEE fp16 for
+parity.
+
+Note the division of labor: these compressors convert the TENSOR the
+engine then carries end to end (framework-level, opt-in per
+optimizer); the wire codec layer in `common/compression.py` narrows
+only the BYTES ON THE WIRE while the engine math stays fp32, with
+error feedback — prefer `HOROVOD_WIRE_COMPRESSION` for gradient
+traffic.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..common.compression import Compressor, NoneCompressor
 
-class Compressor:
-    @staticmethod
-    def compress(tensor):
-        raise NotImplementedError
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        raise NotImplementedError
-
-
-class NoneCompressor(Compressor):
-    """(ref: compression.py NoneCompressor)"""
-
-    @staticmethod
-    def compress(tensor):
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor
+__all__ = [
+    "Compressor",
+    "NoneCompressor",
+    "BF16Compressor",
+    "FP16Compressor",
+    "Compression",
+]
 
 
 class BF16Compressor(Compressor):

@@ -1,28 +1,16 @@
 """Gradient compression for the TF binding
-(ref: horovod/tensorflow/compression.py:24-74)."""
+(ref: horovod/tensorflow/compression.py:24-74).
+
+Thin re-export of the single-source interface in
+`common/compression.py` plus the TensorFlow tensor-type adapter — see
+`ops/compression.py` for the layering note (framework compressors vs
+the data-plane wire codecs)."""
 from __future__ import annotations
 
+from ..common.compression import Compressor, NoneCompressor
 
-class Compressor:
-    """Interface (ref: compression.py:24-35)."""
-
-    @staticmethod
-    def compress(tensor):
-        raise NotImplementedError
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        raise NotImplementedError
-
-
-class NoneCompressor(Compressor):
-    @staticmethod
-    def compress(tensor):
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor
+__all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
+           "Compression"]
 
 
 class FP16Compressor(Compressor):

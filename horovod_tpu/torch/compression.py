@@ -1,19 +1,19 @@
 """Gradient compression for the torch adapter
-(ref: horovod/torch/compression.py — fp16 on-the-wire compression)."""
+(ref: horovod/torch/compression.py — fp16 on-the-wire compression).
+
+Thin re-export of the single-source interface in
+`common/compression.py` plus the torch tensor-type adapter — see
+`ops/compression.py` for the layering note (framework compressors vs
+the data-plane wire codecs)."""
 from __future__ import annotations
 
+from ..common.compression import Compressor, NoneCompressor
 
-class NoneCompressor:
-    @staticmethod
-    def compress(tensor):
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor
+__all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
+           "Compression"]
 
 
-class FP16Compressor:
+class FP16Compressor(Compressor):
     @staticmethod
     def compress(tensor):
         import torch

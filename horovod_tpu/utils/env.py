@@ -173,6 +173,32 @@ DEFAULT_NUM_CHANNELS = 2
 MAX_CHANNELS = 16
 DEFAULT_LATENCY_CHANNEL_BYTES = 65536
 
+# -- wire-compression knobs (docs/running.md "Wire compression") -------
+# On-wire codec policy for the collective data plane: none (default —
+# every byte ships full-width), bf16 / fp16 (that codec for eligible
+# fp32 allreduce responses), auto (bf16 — the TPU-native pick: same
+# byte savings as fp16 with the full fp32 exponent range). Read per
+# negotiation cycle ON THE COORDINATOR only: the chosen codec id rides
+# the Response wire message next to the channel id, so workers follow
+# rank 0's policy and the choice is collectively agreed and
+# cache-replay-stable by construction (flipping the env mid-run on a
+# worker changes nothing; flipping it on rank 0 affects newly
+# negotiated responses only — cached ones keep their codec).
+WIRE_COMPRESSION = "HOROVOD_WIRE_COMPRESSION"
+# Responses below this negotiated payload size ship full-width even
+# when a codec is configured: encode/decode overhead beats the byte
+# savings on small frames (the latency channel's int8 opt-in below is
+# the deliberate exception).
+WIRE_COMPRESSION_MIN_BYTES = "HOROVOD_WIRE_COMPRESSION_MIN_BYTES"
+# Opt-in: responses riding the latency channel (the size policy's
+# highest lane) additionally quantize to int8-with-scale (4x fewer
+# bytes) when a non-none codec mode is active. Off by default — int8
+# is coarse; error feedback recovers the mean but per-step noise is
+# real.
+WIRE_COMPRESSION_INT8 = "HOROVOD_WIRE_COMPRESSION_INT8"
+
+DEFAULT_WIRE_COMPRESSION_MIN_BYTES = 65536
+
 # -- tracing knobs (docs/tracing.md) -----------------------------------
 # Merged Perfetto/Chrome trace file rank 0 writes at shutdown (every
 # rank writes its own when the path contains `{rank}`). Unset = no file
@@ -472,6 +498,26 @@ def latency_channel_bytes() -> int:
 
 def cycle_event_driven() -> bool:
     return get_bool(CYCLE_EVENT, True)
+
+
+def wire_compression_mode() -> str:
+    """HOROVOD_WIRE_COMPRESSION normalized to none|bf16|fp16|auto
+    (unknown values fall back to none — a typo must never change what
+    the data plane ships). Coordinator-side only, like num_channels:
+    the assigned codec id rides the Response wire message."""
+    v = get_str(WIRE_COMPRESSION, "none").lower()
+    return v if v in ("none", "bf16", "fp16", "auto") else "none"
+
+
+def wire_compression_min_bytes() -> int:
+    """Smallest negotiated payload a codec engages on; floor 0."""
+    return max(get_int(WIRE_COMPRESSION_MIN_BYTES,
+                       DEFAULT_WIRE_COMPRESSION_MIN_BYTES), 0)
+
+
+def wire_compression_int8() -> bool:
+    """int8-with-scale on the latency channel (opt-in)."""
+    return get_bool(WIRE_COMPRESSION_INT8, False)
 
 
 def trace_buffer_events() -> int:

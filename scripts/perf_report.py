@@ -84,6 +84,7 @@ def _engine_worker():
     lat_x = np.ones(16384, np.float32)     # 64KB
     bw_x = np.ones(262144, np.float32)     # 1MB
     tr_x = np.ones(1048576, np.float32)    # 4MB
+    cmp_x = np.ones(4194304, np.float32)   # 16MB
 
     def set_algo(ring: bool, seg_bytes: int):
         os.environ.pop("HOROVOD_CPU_OPERATIONS", None)
@@ -142,11 +143,33 @@ def _engine_worker():
         os.environ["HOROVOD_TRANSPORT"] = "auto"
         return {"tcp": tcp, "shm": shm}
 
+    def stage_compression(tag):
+        """none-vs-bf16 paired inside the stage at 16MB (order
+        alternates with the round parity, like the transport stage).
+        Per-arm steady-state names: the codec id is negotiated once
+        per name and replays from the response cache."""
+        set_algo(True, 1 << 18)
+        os.environ["HOROVOD_WIRE_COMPRESSION_MIN_BYTES"] = "0"
+
+        def arm(mode):
+            os.environ["HOROVOD_WIRE_COMPRESSION"] = mode
+            return _timed_allreduce(cmp_x, f"pr.cmp.{mode}", tr_iters)
+
+        if tag % 2 == 0:
+            none = arm("none")
+            bf16 = arm("bf16")
+        else:
+            bf16 = arm("bf16")
+            none = arm("none")
+        os.environ["HOROVOD_WIRE_COMPRESSION"] = "none"
+        return {"none": none, "bf16": bf16}
+
     stages = [
         ("latency_small_p50_s", stage_latency),
         ("ring_1mb_s", stage_ring),
         ("segring_1mb_s", stage_segring),
         ("transport_4mb_s", stage_transport),
+        ("compression_16mb_s", stage_compression),
     ]
     out = {name: [] for name, _ in stages}
     # Warmup round (negotiation, cache fill, shm establishment) —
@@ -269,6 +292,19 @@ def measure(rounds: int, quick: bool) -> dict:
     for arm in ("tcp", "shm"):
         vals = [d[arm] for d in tr]
         stages[f"transport_{arm}_4mb_ms"] = {
+            "unit": "ms",
+            "rounds": [round(v * 1e3, 4) for v in vals],
+            "value": round(_median(vals) * 1e3, 4),
+        }
+    # Wire compression (docs/running.md "Wire compression"):
+    # `compression_16mb_ms` is the tracked bf16 arm; the none arm rides
+    # along so the report shows the codec's cost/benefit on THIS box
+    # (loopback has no wire to save — real NICs are where bf16 wins).
+    cmp = raw["compression_16mb_s"]
+    for arm, name in (("bf16", "compression_16mb_ms"),
+                      ("none", "compression_none_16mb_ms")):
+        vals = [d[arm] for d in cmp]
+        stages[name] = {
             "unit": "ms",
             "rounds": [round(v * 1e3, 4) for v in vals],
             "value": round(_median(vals) * 1e3, 4),

@@ -174,6 +174,94 @@ def worker_shm():
     return checks
 
 
+def worker_compression():
+    """Wire-compression smoke over the pinned tcp plane: compressed
+    RING and STAR legs with EXACT accounting of BOTH sides of the
+    ledger — `horovod_allreduce_bytes_total` keeps counting negotiated
+    INPUT bytes (codec-independent by design: the engine records what
+    the user enqueued), while `horovod_wire_bytes_saved_total{codec=}`
+    must equal the closed-form per-frame savings:
+
+    * ring (np=n, COUNT fp32 elems, bf16): each rank sends one
+      COUNT/n-elem chunk per reduce-scatter step and one per allgather
+      step (n-1 each), saving 2 bytes/elem -> per rank per op
+      2*(n-1)*(COUNT/n)*2 bytes;
+    * star: a worker's gather frame saves COUNT*2; the root saves
+      (n-1)*COUNT*2 on its result broadcast (its own gather
+      contribution never touches a wire and must NOT count).
+
+    Compression counters fold into the per-transport accounting as
+    true wire bytes: the same schedule's tcp sent bytes must SHRINK
+    vs an uncompressed control leg (asserted), because the transport
+    counters see the encoded frames — nothing is estimated."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    os.environ.update({"HOROVOD_WIRE_COMPRESSION_MIN_BYTES": "0",
+                       "HOROVOD_RING_THRESHOLD": "0",
+                       "HOROVOD_RING_SEGMENT_BYTES": "0"})
+
+    def tcp_sent(snap):
+        return snap.get(
+            'horovod_transport_bytes_total'
+            '{direction="sent",transport="tcp"}', 0)
+
+    expect_bytes = 0
+    expect_saved = 0
+    per_elem = 2  # fp32 -> bf16
+    tcp_deltas = {}
+    legs = [
+        ("none_ring", "none", {}),
+        ("ring", "bf16", {}),
+        ("star", "bf16", {"HOROVOD_CPU_OPERATIONS": "star"}),
+    ]
+    for name, mode, env in legs:
+        os.environ.pop("HOROVOD_CPU_OPERATIONS", None)
+        os.environ.update(env)
+        os.environ["HOROVOD_WIRE_COMPRESSION"] = mode
+        before = tcp_sent(hvd.metrics()["metrics"])
+        for i in range(ITERS):
+            # rank+1 is exactly representable in bf16, so the reduced
+            # values — and the zero error-feedback residuals — stay
+            # exact and the correctness assert needs no tolerance.
+            x = np.full(COUNT, float(hvd.rank() + 1), np.float32)
+            out = np.asarray(hvd.allreduce(
+                x, name=f"pcmp.{name}.{i}", op=hvd.Sum))
+            assert out.shape == (COUNT,), out.shape
+            assert float(out[0]) == sum(range(1, n + 1)), (name, out[0])
+            expect_bytes += x.nbytes
+            if mode == "bf16":
+                if name == "ring":
+                    expect_saved += 2 * (n - 1) * (COUNT // n) * per_elem
+                else:  # star
+                    expect_saved += (n - 1) * COUNT * per_elem \
+                        if hvd.rank() == 0 else COUNT * per_elem
+        hvd.barrier()
+        tcp_deltas[name] = tcp_sent(hvd.metrics()["metrics"]) - before
+    os.environ["HOROVOD_WIRE_COMPRESSION"] = "none"
+
+    snap = hvd.metrics()["metrics"]
+    got = snap["horovod_allreduce_bytes_total"]
+    assert got == expect_bytes, (
+        f"allreduce_bytes_total drifted under compression: got {got}, "
+        f"expected exactly {expect_bytes}")
+    saved = snap.get('horovod_wire_bytes_saved_total{codec="bf16"}', 0)
+    assert saved == expect_saved, (
+        f"wire_bytes_saved accounting drifted: got {saved}, expected "
+        f"exactly {expect_saved}")
+    # True-wire-bytes fold: same ring schedule, compressed frames ->
+    # fewer tcp bytes on the wire than the uncompressed control.
+    assert tcp_deltas["ring"] < tcp_deltas["none_ring"], tcp_deltas
+    checks = {"rank": hvd.rank(), "bytes": got, "saved": saved,
+              "tcp_ring": tcp_deltas["ring"],
+              "tcp_none": tcp_deltas["none_ring"]}
+    hvd.shutdown()
+    return checks
+
+
 def worker_hier():
     """Two-level hierarchical allreduce over a SIMULATED 2-host x
     2-slot topology (distinct HOROVOD_HOSTNAME per host): intra-host
@@ -236,6 +324,20 @@ def main():
     assert len(results) == 2, results
     assert all(r["bytes"] == results[0]["bytes"] for r in results), results
     print("perf smoke OK (tcp):", results)
+
+    # Compression stage: tcp pinned (the per-transport shrink assert
+    # compares raw socket bytes), codec engaged via env on every rank
+    # (only rank 0's matters — the codec id rides the wire).
+    cmp_results = run(worker_compression, np=2, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_TCP_TIMEOUT_SECONDS": "60",
+        "HOROVOD_TRANSPORT": "tcp",
+    })
+    assert len(cmp_results) == 2, cmp_results
+    assert all(r["bytes"] == cmp_results[0]["bytes"]
+               for r in cmp_results), cmp_results
+    print("perf smoke OK (compression):", cmp_results)
 
     # Deliberately NO HOROVOD_TRANSPORT here: this stage doubles as the
     # default-route assertion — on a co-located mesh the `auto` default

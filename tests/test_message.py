@@ -73,6 +73,24 @@ def test_response_channel_rides_the_wire():
     assert Response.deserialize(Response().serialize())[0].channel == 0
 
 
+def test_response_codec_rides_the_wire():
+    """The wire-codec id must survive serialization — codec choice is
+    collectively agreed through the Response message, exactly like the
+    channel id (a per-rank env read would desync frame widths)."""
+    resp = Response(
+        response_type=ResponseType.ALLREDUCE,
+        tensor_names=["t"],
+        tensor_shapes=[(2, 3)],
+        channel=1,
+        codec=1,
+    )
+    r2, _ = Response.deserialize(resp.serialize())
+    assert r2.codec == 1
+    assert r2 == resp
+    # default stays 0 (full-width) for every pre-codec payload
+    assert Response.deserialize(Response().serialize())[0].codec == 0
+
+
 def test_response_list_roundtrip():
     rl = ResponseList([Response(tensor_names=["x"]), Response(tensor_names=["y"])])
     rl2 = ResponseList.deserialize(rl.serialize())
