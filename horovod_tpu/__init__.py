@@ -73,6 +73,7 @@ from .ops import (
     poll,
     synchronize,
 )
+from .common.goodput import step
 from .ops.compression import Compression
 from .ops.sync_batch_norm import SyncBatchNorm, sync_batch_stats
 from .optim.distributed import (

@@ -73,10 +73,11 @@ class MetricAverageCallback(Callback):
 
 class MetricsCallback(Callback):
     """Log a one-line telemetry summary every `interval` batches: step
-    time, allreduce MB/s and response-cache hit rate over the window
-    (docs/metrics.md). `log_fn` overrides the destination (default: the
-    horovod logger at INFO); only `root_only` rank 0 logs by default so
-    an N-rank job prints one line, not N."""
+    time, allreduce MB/s, response-cache hit rate, window goodput% and
+    exposed-comm ms per batch (docs/metrics.md, docs/goodput.md).
+    `log_fn` overrides the destination (default: the horovod logger at
+    INFO); only `root_only` rank 0 logs by default so an N-rank job
+    prints one line, not N."""
 
     def __init__(self, interval: int = 100, log_fn=None, root_only: bool = True,
                  registry=None):

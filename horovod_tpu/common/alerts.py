@@ -376,6 +376,22 @@ def default_rules() -> List[Rule]:
             "checkpoint_overdue", "horovod_checkpoint_commits_total",
             description="No checkpoint commit within 2x the observed "
                         "commit cadence — durability is stalled"),
+        # Goodput plane (docs/goodput.md): the job-level efficiency
+        # number operators gate on. The ratio gauge is NaN until the
+        # first completed step, so a job that never demarcates steps
+        # stays silent here.
+        ThresholdRule(
+            "goodput_degraded", "horovod_goodput_ratio",
+            threshold=0.5, op="below", mode="last", for_seconds=120.0,
+            description="Goodput ratio (productive step compute / job "
+                        "wall-clock) held below the threshold — badput "
+                        "is eating the fleet; /goodput attributes it"),
+        RegressionRule(
+            "exposed_comm_regression", "horovod_exposed_comm_step_seconds",
+            description="Per-step exposed (training-thread-blocking) "
+                        "communication p50 regressed vs the trailing-"
+                        "window baseline — overlap got worse or a link "
+                        "got slower"),
     ]
     return rules
 

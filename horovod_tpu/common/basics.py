@@ -176,6 +176,15 @@ def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "
         # (and the perf regression reporter) can attribute numbers to a
         # build (docs/health.md).
         telemetry.register_build_info()
+        # Goodput ledger (docs/goodput.md): ensure the process ledger
+        # exists in BOTH modes — mesh mode has no engine to create it,
+        # and the optimizer's auto-step hook only feeds a live ledger.
+        # Rank is passed explicitly: mesh mode is selected precisely
+        # when HOROVOD_RANK is absent, so the env default would make
+        # every multi-host mesh process a rank-0 stamp owner.
+        from . import goodput
+
+        goodput.current(rank=_state.rank)
         logger.debug(
             "horovod_tpu initialized: mode=%s rank=%d size=%d local=%d/%d cross=%d/%d",
             _state.mode, _state.rank, _state.size, _state.local_rank,

@@ -438,17 +438,26 @@ class CheckpointManager:
         is durable (and, on the coordinator, the manifest committed) —
         tests and final-checkpoint-at-exit use that; training loops
         never should."""
+        from . import goodput
+
         if step is None:
             step = self._commit_count
         rank, size = self._world()
+        t0 = time.perf_counter()
         with self.tracer().span("ckpt.snapshot", cat=CAT_CKPT,
                                 args={"step": step}):
             snap = _Snapshot(step, rank, size,
                              state.checkpoint_objects(),
                              state.checkpoint_trees())
+        # Goodput plane (docs/goodput.md): the snapshot reference copy
+        # runs on the training thread — checkpoint-stall badput. The
+        # background pickle+write overlaps and is deliberately NOT
+        # counted here.
+        goodput.note_ckpt_stall(time.perf_counter() - t0)
         with self._cond:
             if self._pending is not None:
                 self._m_skipped.inc()
+                goodput.note_ckpt_skip()
                 logger.warning(
                     "checkpoint at step %d skipped: previous shard write "
                     "still in flight", step)

@@ -436,7 +436,10 @@ class FleetView:
 
 class StepSummary:
     """Computes deltas between calls: step time, allreduce MB/s, response
-    cache hit rate. Shared by the JAX-loop and Keras MetricsCallbacks."""
+    cache hit rate, plus the goodput plane's window view — goodput% of
+    the window's wall-clock and exposed-comm ms per batch
+    (docs/goodput.md). Shared by the JAX-loop and Keras
+    MetricsCallbacks."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.registry = registry or default_registry()
@@ -444,30 +447,42 @@ class StepSummary:
         # Seed baselines from the live counters: the first window must
         # not absorb pre-training traffic (initial parameter broadcast,
         # cold-start negotiation misses).
-        self._bytes0, self._hits0, self._misses0 = self._read()
+        (self._bytes0, self._hits0, self._misses0, self._exposed0,
+         self._stall0) = self._read()
 
-    def _read(self) -> Tuple[float, float, float]:
+    def _read(self) -> Tuple[float, float, float, float, float]:
         s = self.registry.scalars()
         return (
             s.get("horovod_allreduce_bytes_total", 0.0),
             s.get("horovod_response_cache_hits_total", 0.0),
             s.get("horovod_response_cache_misses_total", 0.0),
+            s.get("horovod_exposed_comm_seconds_total", 0.0),
+            s.get("horovod_ckpt_stall_seconds_total", 0.0),
         )
 
     def line(self, steps: int) -> str:
         """Summary line covering the `steps` batches since the last call."""
         now = time.monotonic()
-        b, h, m = self._read()
+        b, h, m, ex, stall = self._read()
         dt = max(now - self._t0, 1e-9)
         db = b - self._bytes0
         dh, dm = h - self._hits0, m - self._misses0
-        self._t0, self._bytes0, self._hits0, self._misses0 = now, b, h, m
+        dex = max(ex - self._exposed0, 0.0)
+        dstall = max(stall - self._stall0, 0.0)
+        (self._t0, self._bytes0, self._hits0, self._misses0,
+         self._exposed0, self._stall0) = now, b, h, m, ex, stall
         step_ms = dt / max(steps, 1) * 1e3
         mbps = db / dt / 1e6
         lookups = dh + dm
         hit_pct = (100.0 * dh / lookups) if lookups else 0.0
+        # Window goodput%: the share of this window's wall-clock NOT
+        # lost to exposed comm or checkpoint stalls (the in-window form
+        # of the ledger's job-level ratio).
+        good_pct = 100.0 * max(dt - dex - dstall, 0.0) / dt
+        comm_ms = dex / max(steps, 1) * 1e3
         return (f"step {step_ms:.1f}ms | allreduce {mbps:.1f}MB/s | "
-                f"cache hit {hit_pct:.0f}%")
+                f"cache hit {hit_pct:.0f}% | goodput {good_pct:.0f}% | "
+                f"comm {comm_ms:.1f}ms")
 
 
 class StepSummaryLogger:

@@ -71,6 +71,10 @@ CAT_QUEUE = "queue"
 CAT_EXEC = "exec"
 CAT_XFER = "xfer"
 CAT_COMPUTE = "compute"
+# Step-demarcation spans from the goodput ledger (docs/goodput.md):
+# one X event per training step, args carry the step number and its
+# exposed-comm share — what critical_path.py groups collectives under.
+CAT_STEP = "step"
 
 
 # ---------------------------------------------------------------------------
@@ -608,6 +612,13 @@ def stitch_post_mortem(trace_dir: str, verdict: str = "",
                         (d.get("timeseries") or {}).get("samples", [])),
                     "alerts_firing": (d.get("alerts") or {}).get(
                         "firing", []),
+                    # Goodput ledger (docs/goodput.md): how much of the
+                    # job had become training when it died — the badput
+                    # breakdown rides the flight dump itself.
+                    "goodput_ratio": ((d.get("goodput") or {})
+                                      .get("goodput") or {}).get("ratio"),
+                    "goodput_steps": ((d.get("goodput") or {})
+                                      .get("steps") or {}).get("total"),
                 } for d in docs
             },
         },

@@ -4,7 +4,7 @@ from __future__ import annotations
 import functools
 from typing import Callable
 
-from ..common import basics, telemetry
+from ..common import basics, goodput, telemetry
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 from .state import State
@@ -88,20 +88,33 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
         if restored is not None:
             logger.info("resuming from durable checkpoint at step %d",
                         restored)
+            # Goodput (docs/goodput.md): the durable ledger stamp knows
+            # how far the previous lifetime got; everything between the
+            # restored step and that cursor will be re-executed —
+            # replay badput, counted once here.
+            goodput.note_restore(restored)
     skip_sync = False
     try:
         while True:
             if not skip_sync:
                 state.sync()
+            # Training is live again: close any open disruption window
+            # into the restart-badput bucket (no-op on the first pass).
+            goodput.disruption_end()
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 logger.warning("collective failure; restoring last commit")
+                goodput.disruption_begin("collective failure")
                 _m_restores.inc()
                 state.restore()
+                # In-memory rollback to the last commit: steps past it
+                # are replay badput.
+                goodput.note_restore()
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
                 logger.info("hosts updated; re-initializing")
+                goodput.disruption_begin("hosts updated")
                 _m_host_updates.inc()
                 skip_sync = e.skip_sync
             _reset()

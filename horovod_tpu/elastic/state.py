@@ -51,6 +51,14 @@ class State:
         mgr = getattr(self, "_checkpoint_manager", None)
         if mgr is not None:
             mgr.maybe_save(self)
+        # Goodput plane (docs/goodput.md): a commit is a step boundary
+        # (the lowest-priority demarcation source) and advances the
+        # committed-step cursor replay accounting rewinds to. BEFORE
+        # the host-update check for the same reason the snapshot is:
+        # a HostsUpdatedInterrupt must not lose the committed step.
+        from ..common import goodput
+
+        goodput.note_commit()
         self.check_host_updates()
 
     def check_host_updates(self):

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common import compression, telemetry, tracing
+from ..common import compression, goodput as goodput_mod, telemetry, tracing
 from ..common.exceptions import HorovodInternalError, TransportError
 from ..common.message import Request, RequestType, Response, ResponseType
 from ..common.types import ReduceOp, Status, StatusType, to_wire_dtype
@@ -65,13 +65,19 @@ def _scale_np(arr: np.ndarray, factor: float) -> np.ndarray:
 
 
 class HandleManager:
-    """(ref: horovod/torch/handle_manager.{h,cc})"""
+    """(ref: horovod/torch/handle_manager.{h,cc})
 
-    def __init__(self):
+    `wait` reports the time the caller actually BLOCKED to the goodput
+    ledger (docs/goodput.md): a handle whose op completed while the
+    caller computed costs ~0 here, so overlapped communication never
+    reads as exposed-comm badput."""
+
+    def __init__(self, goodput=None):
         self._lock = threading.Lock()
         self._next = 0
         self._results: Dict[int, Tuple[Status, Optional[np.ndarray]]] = {}
         self._events: Dict[int, threading.Event] = {}
+        self._goodput = goodput
 
     def allocate(self) -> int:
         with self._lock:
@@ -93,8 +99,19 @@ class HandleManager:
 
     def wait(self, handle: int, timeout: Optional[float] = None):
         ev = self._events.get(handle)
-        if ev is not None and not ev.wait(timeout):
-            raise TimeoutError(f"handle {handle} did not complete")
+        if ev is not None and not ev.is_set():
+            # Exposed communication: only the blocked portion counts.
+            # The is_set() fast path keeps already-complete (overlapped)
+            # waits at zero cost and zero attribution.
+            gp = self._goodput
+            if gp is not None and gp.enabled:
+                t0 = time.monotonic()
+                done = ev.wait(timeout)
+                gp.note_exposed(time.monotonic() - t0)
+            else:
+                done = ev.wait(timeout)
+            if not done:
+                raise TimeoutError(f"handle {handle} did not complete")
         with self._lock:
             if handle not in self._results:
                 # Never allocated (or already waited on): a clear error
@@ -254,12 +271,18 @@ class Engine:
             "horovod_last_cycle_age_seconds",
             "Seconds since the background loop last completed a cycle",
         ).set_function(self._last_cycle_age)
-        self.handles = HandleManager()
         # Tracing plane (common/tracing.py, docs/tracing.md): the
         # always-on flight recorder behind the span API. Per-engine
         # like the registry so the in-process multi-rank harness keeps
         # per-"rank" recorders separable.
         self.tracer = tracing.Tracer(registry=self.registry)
+        # Goodput plane (common/goodput.py, docs/goodput.md): process-
+        # shared on the default registry (the ledger outlives this
+        # engine across elastic resets), private on injected registries
+        # so the in-process harness keeps per-"rank" accounting.
+        self.goodput = goodput_mod.for_engine(self.registry, rank,
+                                              tracer=self.tracer)
+        self.handles = HandleManager(goodput=self.goodput)
         self._pm_dumped = False
         self.timeline = (Timeline(registry=self.registry) if rank == 0
                          else Timeline(use_env=False, registry=self.registry))
@@ -441,6 +464,9 @@ class Engine:
             if fleet_alerts is not None:
                 st["alerts"]["fleet"] = \
                     fleet_alerts.snapshot()["firing_by_rule"]
+        # Goodput plane (docs/goodput.md): the step/badput ledger in
+        # compact form — "how much of this job became training".
+        st["goodput"] = self.goodput.status_summary()
         # Durability plane: last committed/pending checkpoint step,
         # last error (docs/checkpoint.md). The manager is owned by the
         # elastic run loop, not the engine — report whichever one is
@@ -500,6 +526,41 @@ class Engine:
             body["fleet"] = fleet_alerts.snapshot()
         return body
 
+    # -- goodput plane view (docs/goodput.md) ---------------------------
+    def _goodput_view(self) -> dict:
+        """The /goodput body: this rank's full ledger plus (coordinator)
+        the per-rank badput attribution folded from the goodput scalars
+        already riding the telemetry piggyback — which rank's exposed
+        comm is eating the fleet."""
+        body: dict = {"local": self.goodput.view()}
+        ctrl = self.controller
+        if ctrl is not None and ctrl.fleet is not None:
+            per_rank = {}
+            for r, scalars in sorted(ctrl.fleet.ranks().items()):
+                per_rank[str(r)] = {
+                    "steps": scalars.get(
+                        "horovod_goodput_steps_total", 0.0),
+                    "exposed_comm_seconds": scalars.get(
+                        "horovod_exposed_comm_seconds_total", 0.0),
+                    "ckpt_stall_seconds": scalars.get(
+                        "horovod_ckpt_stall_seconds_total", 0.0),
+                    "restart_downtime_seconds": scalars.get(
+                        "horovod_restart_downtime_seconds_total", 0.0),
+                    "replayed_steps": scalars.get(
+                        "horovod_replayed_steps_total", 0.0),
+                    "goodput_ratio": scalars.get(
+                        "horovod_goodput_ratio"),
+                }
+            fleet: dict = {"ranks": per_rank}
+            if per_rank:
+                worst = max(per_rank.items(),
+                            key=lambda kv: kv[1]["exposed_comm_seconds"])
+                fleet["max_exposed_comm_rank"] = int(worst[0])
+                fleet["max_exposed_comm_seconds"] = \
+                    worst[1]["exposed_comm_seconds"]
+            body["fleet"] = fleet
+        return body
+
     # ------------------------------------------------------------------
     def start(self):
         self._thread = threading.Thread(
@@ -549,6 +610,12 @@ class Engine:
                 if isinstance(exp, metrics_export.MetricsHTTPServer):
                     exp.add_view("timeseries", self._timeseries_view)
                     exp.add_view("alerts", self._alerts_view)
+        # Goodput plane: the efficiency ledger rides the same endpoint
+        # (independent of the health plane — the ledger has no sampler
+        # thread to disable).
+        for exp in self._exporters:
+            if isinstance(exp, metrics_export.MetricsHTTPServer):
+                exp.add_view("goodput", self._goodput_view)
 
     def _background_loop(self):
         try:
@@ -1363,6 +1430,9 @@ class Engine:
             extra["timeseries"] = sampler.store.dump_scalars()
         if alert_eng is not None:
             extra["alerts"] = alert_eng.status()
+        # Goodput ledger: the post-mortem carries "how much of this job
+        # had become training by the time it died" next to the spans.
+        extra["goodput"] = self.goodput.view()
         path = self.tracer.dump_flight(
             tracing.flight_path(trace_dir, self.rank), self.rank,
             extra=extra)
@@ -1400,6 +1470,10 @@ class Engine:
         self._wake.set()  # end any coalescing wait immediately
         self._thread.join(timeout=60)
         self._thread = None
+        # Goodput ledger: persist a final stamp so the very next
+        # lifetime measures downtime from THIS moment, not the last
+        # commit (the ledger itself is process-shared and survives).
+        self.goodput.stamp(force=True)
         # Health plane down first: a final sample captures shutdown
         # state, then no tick may fire against a dying registry.
         if self.sampler is not None:

@@ -83,6 +83,20 @@ def DistributedOptimizer(
         return optimizer.init(params)
 
     def update_fn(grads, state, params=None, **extra):
+        # Goodput step demarcation (docs/goodput.md): every eager
+        # optimizer update is one training step. Under jit this body
+        # runs once at trace time, not per step, so traced updates are
+        # skipped — jit loops demarcate with an explicit `hvd.step()`
+        # scope (or via `state.commit()` in elastic loops). The ledger
+        # check comes first: with the plane off (or before init) the
+        # update path must not pay even the tree flatten.
+        from ..common import goodput
+
+        led = goodput.active()
+        if led is not None and led.enabled:
+            leaves = jax.tree.leaves(grads)
+            if not (leaves and _is_tracer(leaves[0])):
+                led.auto_step("optim")
         red = _allreduce_grads(
             grads, op, axis_name, prescale_factor, postscale_factor,
             compression, fuse,

@@ -285,6 +285,30 @@ DEFAULT_SERVING_QUEUE_DEPTH = 256
 DEFAULT_SERVING_REQUEST_TIMEOUT = 30.0
 DEFAULT_SERVING_WEIGHT_REFRESH = 10.0
 
+# -- goodput plane knobs (docs/goodput.md) -----------------------------
+# Master switch for the step-accounting ledger (step demarcation,
+# exposed-comm attribution, restart badput). Default on: every hook is
+# a float add; turn off to prove the <2% hot-path bar or to silence
+# the ledger entirely.
+GOODPUT = "HOROVOD_GOODPUT"
+# Directory for the durable ledger stamp (goodput.json) that carries
+# job start / step cursor / cumulative badput across process
+# lifetimes, so a kill-all restart's downtime and replayed steps are
+# counted. Defaults to HOROVOD_CHECKPOINT_DIR (the stamp lives next to
+# the checkpoints it accounts for); empty when neither is set = no
+# durable stamps (per-lifetime accounting only).
+GOODPUT_DIR = "HOROVOD_GOODPUT_DIR"
+# Rate limit on stamp persistence (written at commit boundaries).
+# 0 (default) = stamp every commit — the stamp is a ~1KB unfsynced
+# atomic write, far below step cost; raise it on very fast commit
+# loops or slow shared stores.
+GOODPUT_STAMP_SECONDS = "HOROVOD_GOODPUT_STAMP_SECONDS"
+# Declared flops of ONE training step (per rank). >0 adds achieved
+# FLOP/s to the /goodput view; with PEAK_FLOPS also set, MFU.
+STEP_FLOPS = "HOROVOD_STEP_FLOPS"
+# Peak per-rank FLOP/s of the hardware, for the MFU ratio.
+GOODPUT_PEAK_FLOPS = "HOROVOD_GOODPUT_PEAK_FLOPS"
+
 # -- health plane knobs (docs/health.md) -------------------------------
 # Cadence of the on-box metrics sampler: a daemon thread snapshots the
 # registry every this-many seconds into a bounded in-memory ring
@@ -628,6 +652,42 @@ def serving_weight_refresh_seconds() -> float:
     """Manifest-watch poll cadence; 0 disables weight hot-swap."""
     return max(get_float(SERVING_WEIGHT_REFRESH,
                          DEFAULT_SERVING_WEIGHT_REFRESH), 0.0)
+
+
+def goodput_enabled() -> bool:
+    """Goodput ledger master switch; default on (docs/goodput.md)."""
+    return get_bool(GOODPUT, True)
+
+
+def goodput_dir() -> str:
+    """Durable ledger-stamp directory; defaults to the checkpoint dir,
+    empty = no durable stamps."""
+    d = get_str(GOODPUT_DIR, "")
+    return d if d else checkpoint_dir()
+
+
+def goodput_stamp_seconds() -> float:
+    """Minimum seconds between ledger-stamp writes; floor 0 (= stamp
+    on every commit)."""
+    return max(get_float(GOODPUT_STAMP_SECONDS, 0.0), 0.0)
+
+
+def step_flops() -> float:
+    """Declared per-step flop count; 0 (default) = no FLOP/MFU rows.
+    Negative or unparsable values fall to 0 — a typo must never turn
+    into a bogus efficiency number."""
+    try:
+        return max(get_float(STEP_FLOPS, 0.0), 0.0)
+    except ValueError:
+        return 0.0
+
+
+def goodput_peak_flops() -> float:
+    """Peak per-rank FLOP/s for MFU; 0 disables the ratio."""
+    try:
+        return max(get_float(GOODPUT_PEAK_FLOPS, 0.0), 0.0)
+    except ValueError:
+        return 0.0
 
 
 def metrics_sample_seconds() -> float:

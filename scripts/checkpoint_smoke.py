@@ -85,6 +85,12 @@ WORKER = textwrap.dedent("""
     w = train(state)
     rdv.put("smoke_final", str(hvd.rank()),
             pickle.dumps((state.batch, np.asarray(w).tobytes())))
+    # Goodput plane (docs/goodput.md): rank 0's ledger is the one that
+    # loads the durable stamp, so a phase-2 (restarted) job reports the
+    # kill-all's downtime and the replayed steps after restore here.
+    if hvd.rank() == 0:
+        from horovod_tpu.common import goodput
+        rdv.put("smoke_goodput", "0", pickle.dumps(goodput.active().view()))
     print(f"rank {hvd.rank()}: finished at batch {state.batch}", flush=True)
 """)
 
@@ -212,6 +218,58 @@ def run_killall(args) -> int:
         print(f"rank {rank}: finished at step {fstep} "
               f"(final weights == uninterrupted run: {match})", flush=True)
         ok = ok and fstep == args.steps and match
+
+    # ---- goodput ledger audit (docs/goodput.md) ---------------------
+    # The restarted job's rank-0 ledger resumed from the durable stamp
+    # phase 1 wrote next to the checkpoints: the kill-all's downtime
+    # and the steps replayed between the restored manifest and the
+    # pre-crash step cursor must be attributed, and the goodput ratio
+    # must be < 1 and consistent with wall-clock (buckets + goodput
+    # sum to the job's wall within clamping tolerance).
+    blob = server.handle_get("smoke_goodput/0")
+    if blob is None:
+        print("FAIL: rank 0 reported no goodput ledger", flush=True)
+        ok = False
+    else:
+        gp = pickle.loads(blob)
+        bad = gp["badput"]
+        downtime = bad["restart_downtime_seconds"]
+        replayed = bad["replayed_steps"]
+        expect_replay = args.kill_step - step0
+        ratio = gp["goodput"]["ratio"]
+        wall = gp["wall_seconds"]
+        # In-step exposed/stall only: out-of-step waits already live
+        # inside other_seconds' wall time (the partition the ledger
+        # defines).
+        acct = (gp["goodput"]["seconds"]
+                + bad["exposed_comm_in_step_seconds"]
+                + bad["ckpt_stall_in_step_seconds"]
+                + bad["replay_seconds"]
+                + bad["restart_downtime_seconds"] + bad["other_seconds"])
+        print(f"goodput ledger: generation {gp['generation']}, "
+              f"wall {wall:.1f}s, ratio {ratio}, "
+              f"downtime {downtime:.2f}s, replayed {replayed} steps "
+              f"(expected {expect_replay}), accounted {acct:.1f}s",
+              flush=True)
+        if gp["generation"] < 2:
+            print("FAIL: ledger did not survive the restart", flush=True)
+            ok = False
+        if downtime <= 0:
+            print("FAIL: kill-all downtime not attributed", flush=True)
+            ok = False
+        if replayed != expect_replay:
+            print(f"FAIL: replayed steps {replayed} != {expect_replay}",
+                  flush=True)
+            ok = False
+        if not (ratio is not None and 0 <= ratio < 1):
+            print("FAIL: goodput ratio not in [0, 1)", flush=True)
+            ok = False
+        # The ledger's buckets partition wall-clock (up to the >=0
+        # clamps): accounted time within 10% of wall.
+        if not (0.9 * wall <= acct <= 1.1 * wall + 0.5):
+            print(f"FAIL: buckets sum to {acct:.1f}s but wall is "
+                  f"{wall:.1f}s", flush=True)
+            ok = False
     server.stop()
 
     # ---- debris audit ------------------------------------------------

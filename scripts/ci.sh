@@ -38,6 +38,14 @@ python scripts/serving_smoke.py
 echo "=== perf report (warn vs committed BENCH_BASELINE.json; docs/health.md) ==="
 python scripts/perf_report.py --quick --out /tmp/hvd_perf1.json
 
+# Resume the BENCH trajectory (empty since r05): archive this run's
+# perf report as the next BENCH_r<NN>.json next to BENCH_BASELINE.json.
+last=$( (ls BENCH_r[0-9]*.json 2>/dev/null || true) \
+  | sed -E 's/.*BENCH_r0*([0-9]+)\.json/\1/' | sort -n | tail -1)
+next=$(( ${last:-0} + 1 ))
+cp /tmp/hvd_perf1.json "$(printf 'BENCH_r%02d.json' "$next")"
+echo "BENCH trajectory: archived $(printf 'BENCH_r%02d.json' "$next")"
+
 echo "=== perf gate self-test (clean back-to-back must pass; injected 2x slowdown must trip) ==="
 python scripts/perf_report.py --quick --out /tmp/hvd_perf2.json \
     --baseline /tmp/hvd_perf1.json --gate

@@ -19,6 +19,12 @@ For each collective (trace id) the analyzer computes:
 The summary aggregates phase totals and names the worst stragglers
 (rank -> how many collectives it finished last, and by how much).
 
+When the trace carries the goodput ledger's ``step`` spans
+(docs/goodput.md), collectives are additionally grouped under them:
+per step and per rank, total executor communication time is split into
+the exposed share the training thread actually waited on (from the
+span args) and the overlapped remainder — the ``steps`` section.
+
     python scripts/critical_path.py trace.json
     python scripts/critical_path.py postmortem.json --top 10
     curl -s localhost:9099/trace | python scripts/critical_path.py -
@@ -62,6 +68,65 @@ def fetch_url(url: str, timeout: float = 30.0):
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         doc = json.load(resp)
     return chrome_trace.trace_events(doc), doc
+
+
+def analyze_steps(events, top: int = 5):
+    """Group collectives under the goodput ledger's `step` spans
+    (docs/goodput.md): for each demarcated step on each rank, the
+    executor time its collectives spent inside the step window is that
+    step's total communication; the ledger's exposed-comm share (in
+    the span args) is the part the training thread actually waited on;
+    the difference is overlapped — comm that cost nothing."""
+    step_spans = []
+    exec_by_rank = collections.defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        if name == "step" and e.get("cat") == "step":
+            step_spans.append(e)
+        elif name.startswith("exec.") and name != "exec.queue_wait" \
+                and (e.get("args") or {}).get("trace_id"):
+            exec_by_rank[e.get("pid")].append(
+                (e["ts"], e["ts"] + e.get("dur", 0), e.get("dur", 0)))
+    if not step_spans:
+        return None
+    steps = []
+    per_rank = collections.defaultdict(
+        lambda: {"steps": 0, "exposed_us": 0.0, "comm_us": 0.0,
+                 "overlapped_us": 0.0})
+    for e in step_spans:
+        rank = e.get("pid")
+        t0, t1 = e["ts"], e["ts"] + e.get("dur", 0)
+        args = e.get("args") or {}
+        exposed_us = float(args.get("exposed_comm_ms", 0.0)) * 1e3
+        comm_us = sum(
+            max(min(b, t1) - max(a, t0), 0.0)
+            for a, b, _ in exec_by_rank.get(rank, ())
+            if a < t1 and b > t0)
+        overlapped_us = max(comm_us - exposed_us, 0.0)
+        pr = per_rank[rank]
+        pr["steps"] += 1
+        pr["exposed_us"] += exposed_us
+        pr["comm_us"] += comm_us
+        pr["overlapped_us"] += overlapped_us
+        steps.append({
+            "rank": rank,
+            "step": args.get("step"),
+            "span_us": round(t1 - t0, 1),
+            "comm_us": round(comm_us, 1),
+            "exposed_us": round(exposed_us, 1),
+            "overlapped_us": round(overlapped_us, 1),
+        })
+    steps.sort(key=lambda s: -s["exposed_us"])
+    return {
+        "steps_analyzed": len(steps),
+        "per_rank": {
+            str(r): {k: (v if k == "steps" else round(v, 1))
+                     for k, v in d.items()}
+            for r, d in sorted(per_rank.items())},
+        "worst_exposed_steps": steps[:top],
+    }
 
 
 def analyze(events, top: int = 5):
@@ -117,8 +182,10 @@ def analyze(events, top: int = 5):
 
     collectives.sort(key=lambda c: -c["span_us"])
     total = sum(phase_totals.values()) or 1.0
+    steps = analyze_steps(events, top=top)
     return {
         "collectives_analyzed": len(collectives),
+        **({"steps": steps} if steps else {}),
         "phase_attribution_us": {
             k: round(v, 1) for k, v in phase_totals.most_common()},
         "phase_attribution_pct": {

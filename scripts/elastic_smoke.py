@@ -56,9 +56,15 @@ WORKER = textwrap.dedent("""
         return list(state.history)
 
     hist = train(state)
+    # Goodput plane (docs/goodput.md): the eviction's disruption window
+    # (failure -> re-meshed training) must have landed in the ledger's
+    # restart-badput bucket on every survivor.
+    from horovod_tpu.common import goodput
+    gp = goodput.active().view()
     rdv = RendezvousClient(env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR),
                            env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0))
-    rdv.put("smoke_results", spawn_identity(), pickle.dumps(hist))
+    rdv.put("smoke_results", spawn_identity(),
+            pickle.dumps({"hist": hist, "goodput": gp}))
     print(f"worker {spawn_identity()} done as rank {hvd.rank()} "
           f"size {hvd.size()}", flush=True)
 """)
@@ -132,11 +138,28 @@ def main() -> int:
                           flush=True)
                     ok = False
                     continue
-                hist = pickle.loads(blob)
+                doc = pickle.loads(blob)
+                hist, gp = doc["hist"], doc["goodput"]
                 final_np = hist[-1][1]
-                print(f"{h}: finished batch {len(hist)} at np={final_np}",
+                downtime = gp["badput"]["restart_downtime_seconds"]
+                ratio = gp["goodput"]["ratio"]
+                print(f"{h}: finished batch {len(hist)} at np={final_np}, "
+                      f"restart badput {downtime:.2f}s "
+                      f"(goodput ratio "
+                      f"{'none' if ratio is None else format(ratio, '.3f')})",
                       flush=True)
                 ok = ok and final_np == 3
+                # The eviction cost real wall time (detection + barrier
+                # + re-mesh); it must be attributed, not lost.
+                if downtime <= 0:
+                    print(f"FAIL: survivor {h} recorded no restart-"
+                          "badput for the eviction", flush=True)
+                    ok = False
+                if not (gp["goodput"]["ratio"] is not None
+                        and gp["goodput"]["ratio"] < 1.0):
+                    print(f"FAIL: survivor {h} goodput ratio not < 1",
+                          flush=True)
+                    ok = False
             if not driver.host_manager.blacklist_strikes(args.wedge_host):
                 print(f"FAIL: wedged host {args.wedge_host} was never "
                       "blacklisted", flush=True)

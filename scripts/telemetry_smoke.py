@@ -14,8 +14,15 @@ deterministic `delay` fault (the chaos harness) on its own data-plane
 sends, making it the persistent straggler; rank 0 polls its /alerts
 endpoint until `persistent_straggler` latches FIRING with rank 1 named
 in the detail, the ranks then coordinate clearing the fault over an
-ordinary allreduce, and rank 0 polls until the alert RESOLVES. Run by
-scripts/ci.sh; also a manual repro tool:
+ordinary allreduce, and rank 0 polls until the alert RESOLVES.
+
+Phase 3 is the goodput-plane acceptance scenario (docs/goodput.md):
+the same injected straggler delay, with training demarcated by
+`hvd.step()` scopes — the lost time must show up as EXPOSED-COMM
+badput at /goodput (the local ledger's exposed seconds cover most of
+the injected delay, the goodput ratio drops below 1, and the fleet
+fold attributes per-rank exposed comm). Run by scripts/ci.sh; also a
+manual repro tool:
 
     python scripts/telemetry_smoke.py
 """
@@ -185,6 +192,106 @@ def worker_straggler():
     return checks
 
 
+def worker_goodput():
+    """Goodput-plane acceptance: rank 1 delays every data-plane send by
+    DELAY_S, so each demarcated step's collective blocks the training
+    thread — exposed communication. Rank 0 asserts the /goodput view
+    attributes the lost time to the exposed-comm badput bucket."""
+    import http.client
+    import json
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics, fault_injection
+    from horovod_tpu.common.fault_injection import Rule
+    from horovod_tpu.common.metrics_export import MetricsHTTPServer
+
+    DELAY_S = 0.05
+    STEPS = 12
+    hvd.init()
+    r = hvd.rank()
+    if r == 1:
+        fault_injection.injector.install(
+            [Rule(action="delay", peer=0, op="send", secs=DELAY_S)])
+
+    for _ in range(STEPS):
+        # The demarcation under test: each step scope brackets one
+        # synchronous allreduce whose handle wait absorbs the delay.
+        with hvd.step():
+            hvd.allreduce(np.ones(1024, np.float32), name="gstep")
+
+    led = basics.engine().goodput
+    local = led.view()
+    checks = {"rank": r,
+              "steps": local["steps"]["total"],
+              "exposed_s": local["badput"]["exposed_comm_seconds"],
+              "ratio": local["goodput"]["ratio"]}
+    assert local["steps"]["total"] == STEPS, local["steps"]
+    # Every step blocked ~DELAY_S on the straggler: the ledger must
+    # attribute the bulk of the injected delay as exposed comm.
+    floor = 0.5 * DELAY_S * STEPS
+    assert local["badput"]["exposed_comm_seconds"] > floor, local
+    assert local["goodput"]["ratio"] is not None, local
+    assert local["goodput"]["ratio"] < 0.9, local
+
+    if r == 0:
+        servers = [e for e in basics.engine()._exporters
+                   if isinstance(e, MetricsHTTPServer)]
+        assert servers, "metrics endpoint did not start"
+        port = servers[0].port
+
+        def goodput_body():
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            conn.request("GET", "/goodput")
+            return json.loads(conn.getresponse().read())
+
+        # The fleet fold needs rank 1's piggybacked scalars; keep
+        # collectives flowing until both ranks appear (phase word
+        # below holds rank 1 in the loop meanwhile).
+        deadline = time.monotonic() + 60
+        body = goodput_body()
+        while time.monotonic() < deadline:
+            fleet = body.get("fleet", {}).get("ranks", {})
+            if ("0" in fleet and "1" in fleet
+                    and fleet["0"]["exposed_comm_seconds"] > 0
+                    and fleet["1"]["steps"] >= STEPS):
+                break
+            time.sleep(0.1)
+            body = goodput_body()
+        fleet = body.get("fleet", {}).get("ranks", {})
+        assert "0" in fleet and "1" in fleet, body
+        assert fleet["0"]["exposed_comm_seconds"] > floor, body
+        assert body["local"]["badput"]["exposed_comm_seconds"] > floor, \
+            body
+        assert "max_exposed_comm_rank" in body["fleet"], body
+        # /status carries the compact goodput section too.
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/status")
+        status = json.loads(conn.getresponse().read())
+        assert "goodput" in status, sorted(status)
+        assert status["goodput"]["steps"] >= STEPS, status["goodput"]
+        checks["fleet_ranks"] = sorted(fleet)
+
+    # Coordinated exit: rank 0 signals it is done asserting, so rank 1
+    # keeps answering the fleet-refresh collectives until then.
+    done = 0
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        sig = np.asarray(hvd.allreduce(
+            np.full(1, float(1 if r == 0 else 0), np.float32),
+            name="gp_done", op=hvd.Sum))
+        if sig[0] >= 1:
+            done = 1
+            break
+        time.sleep(0.02)
+    assert done == 1, "goodput phase never converged"
+    hvd.shutdown()
+    return checks
+
+
 def main():
     from horovod_tpu.runner import run
 
@@ -218,6 +325,23 @@ def main():
     assert results[1]["cleared"], results
     print("telemetry smoke OK (phase 2, straggler fire/resolve):",
           results)
+
+    # Phase 3: the injected straggler delay must land in the goodput
+    # ledger's exposed-comm badput bucket, attributed at /goodput
+    # (docs/goodput.md).
+    results = run(worker_goodput, np=2, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_METRICS_PORT": "0",
+        "HOROVOD_METRICS_SYNC_SECONDS": "0.05",
+        "HOROVOD_METRICS_SAMPLE_SECONDS": "0.2",
+    })
+    assert len(results) == 2, results
+    r0 = results[0]
+    assert r0["fleet_ranks"] == ["0", "1"], results
+    assert r0["exposed_s"] > 0 and r0["ratio"] < 0.9, results
+    print("telemetry smoke OK (phase 3, exposed-comm badput at "
+          "/goodput):", results)
 
 
 if __name__ == "__main__":
