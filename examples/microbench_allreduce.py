@@ -311,6 +311,117 @@ def _bench_compression(hvd, np, args):
                 'horovod_wire_bytes_saved_total{codec="bf16"}', 0)}
 
 
+def _bench_hier(hvd, np, args):
+    """Host-arena acceptance measurement (docs/running.md
+    "Transports"): order-alternated paired rounds of the SAME
+    leader-mode hierarchical allreduce with the intra-host legs
+    flipped per-pair-shm-rings <-> per-host-arena between
+    barrier-separated timed loops (HOROVOD_HIER_ARENA is read per
+    call; the arena capability bit was agreed at init). Launch over a
+    (simulated) multi-host topology:
+
+        HVDRUN_FORCE_LOCAL=1 hvdrun -np 4 -H hostA:2,hostB:2 \\
+            python examples/microbench_allreduce.py --mode hier
+
+    Two measurements per round, both order-alternated and paired:
+
+    * ``data_plane`` — the schedule itself, driven directly on the
+      backend under a channel scope (hvd.barrier()-synchronized starts,
+      back-to-back ops). This is the leg comparison the arena exists
+      for: both arms run the identical inter-host ring, only the
+      intra-host legs differ.
+    * ``engine`` — the same ops through the engine API (enqueue +
+      synchronize, steady names so the response cache engages). On a
+      box with cores >= ranks the two agree; on an oversubscribed box
+      the engine's background negotiation steals CPU from the arena
+      ROOT's critical path specifically (the root carries the whole
+      fused reduce + inter ring + bcast), so the engine ratio reads
+      lower — both are reported."""
+    import os as _os
+    import time as _time
+
+    from horovod_tpu.backend.base import channel_scope
+    from horovod_tpu.backend.ring import hierarchy_valid
+    from horovod_tpu.common import basics
+
+    eng = basics.engine()
+    backend = eng.backend
+    assert hierarchy_valid(backend), (
+        "hier mode needs a multi-host topology (simulate one with "
+        "-H hostA:2,hostB:2 and HVDRUN_FORCE_LOCAL=1)")
+    _os.environ["HOROVOD_RING_THRESHOLD"] = "0"
+    _os.environ["HOROVOD_HIERARCHICAL_MODE"] = "leader"
+    x = np.ones(args.hier_count, np.float32)
+
+    def timed_direct(arm):
+        _os.environ["HOROVOD_HIER_ARENA"] = (
+            "auto" if arm == "arena" else "off")
+        hvd.barrier()
+        t0 = _time.perf_counter()
+        with channel_scope(0):
+            for _ in range(args.hier_iters):
+                backend._hierarchical_allreduce(x, hvd.Sum, owned=False)
+        dt = (_time.perf_counter() - t0) / args.hier_iters
+        hvd.barrier()
+        return dt
+
+    def timed_engine(arm):
+        _os.environ["HOROVOD_HIER_ARENA"] = (
+            "auto" if arm == "arena" else "off")
+        hvd.barrier()
+        t0 = _time.perf_counter()
+        for i in range(args.hier_iters):
+            eng.synchronize(
+                eng.enqueue_allreduce(x, name=f"hb.{arm}"), timeout=300)
+        dt = (_time.perf_counter() - t0) / args.hier_iters
+        hvd.barrier()
+        return dt
+
+    for fn in (timed_direct, timed_engine):  # warmup both paths
+        fn("rings")
+        fn("arena")
+    # Fail loudly if the arena arm silently fell back to the per-pair
+    # rings (no host arena agreed): a ~1.0x "speedup" from
+    # rings-vs-rings is worse than an error.
+    arena_ops = hvd.metrics()["metrics"].get(
+        "horovod_hier_arena_ops_total", 0)
+    assert arena_ops > 0, (
+        "hier mode measured nothing on the arena arm — are the hosts' "
+        "slots co-located (distinct HOROVOD_HOSTNAME, shm writable)?")
+    pairs = {"data_plane": [], "engine": []}
+    for r in range(args.hier_rounds):
+        for label, fn in (("data_plane", timed_direct),
+                          ("engine", timed_engine)):
+            if r % 2 == 0:
+                a = fn("rings")
+                b = fn("arena")
+            else:
+                b = fn("arena")
+                a = fn("rings")
+            pairs[label].append((a, b))
+
+    def summarize(ps):
+        ratios = sorted(a / b for a, b in ps)
+        return {
+            "pairs_ms": [[round(a * 1e3, 2), round(b * 1e3, 2)]
+                         for a, b in ps],
+            "rings_ms_median": round(_percentile(
+                sorted(a for a, _ in ps), 0.5) * 1e3, 2),
+            "arena_ms_median": round(_percentile(
+                sorted(b for _, b in ps), 0.5) * 1e3, 2),
+            "ratios": [round(v, 3) for v in ratios],
+            "median_speedup": round(_percentile(ratios, 0.5), 3),
+        }
+
+    return {
+        "bytes": int(x.nbytes),
+        "iters": args.hier_iters,
+        "data_plane": summarize(pairs["data_plane"]),
+        "engine": summarize(pairs["engine"]),
+        "median_speedup": summarize(pairs["data_plane"])["median_speedup"],
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="16384,262144,4194304",
@@ -329,7 +440,7 @@ def main():
                         "ring (default: the library default)")
     p.add_argument("--mode",
                    choices=["bw", "latency", "pipeline", "transport",
-                            "compression"],
+                            "compression", "hier"],
                    default="bw",
                    help="bw: the throughput sweep (default); latency: "
                         "small-op p50/p99 enqueue-to-complete, 1-vs-N "
@@ -339,7 +450,11 @@ def main():
                         "the segmented ring on co-located ranks; "
                         "compression: none-vs-bf16 order-alternated "
                         "paired rounds at 1MB/16MB with exact wire-byte "
-                        "counter accounting")
+                        "counter accounting; hier: leader-mode "
+                        "hierarchical allreduce with the intra-host "
+                        "legs flipped per-pair-rings vs per-host-arena "
+                        "(needs a multi-host launch, e.g. simulated "
+                        "-H hostA:2,hostB:2 with HVDRUN_FORCE_LOCAL=1)")
     p.add_argument("--channels", type=int, default=2,
                    help="the N in the 1-vs-N channel comparisons")
     p.add_argument("--lat-count", type=int, default=16384,
@@ -361,7 +476,21 @@ def main():
                    help="allreduces per timed arm in compression mode")
     p.add_argument("--compression-rounds", type=int, default=5,
                    help="none/bf16 paired rounds in compression mode")
+    p.add_argument("--hier-count", type=int, default=4194304,
+                   help="hier-mode element count (default 16MB)")
+    p.add_argument("--hier-iters", type=int, default=5,
+                   help="allreduces per timed arm in hier mode")
+    p.add_argument("--hier-rounds", type=int, default=5,
+                   help="rings/arena paired rounds in hier mode")
     args = p.parse_args()
+
+    if args.mode == "hier":
+        # Overlay + arena establishment and the capability agreement
+        # happen at init; the timed loops then flip only the intra-host
+        # legs. Hard assignment like transport mode — an exported
+        # HOROVOD_TRANSPORT=tcp would turn this into rings-vs-rings.
+        os.environ["HOROVOD_TRANSPORT"] = "auto"
+        os.environ.setdefault("HOROVOD_HIERARCHICAL_ALLREDUCE", "auto")
 
     if args.mode == "transport":
         # Overlay establishment happens at init; the timed loops then
@@ -431,6 +560,21 @@ def main():
             print(json.dumps(dict(
                 {"metric": "eager_allreduce_compression", "np": n},
                 **summary)))
+        return
+
+    if args.mode == "hier":
+        summary = _bench_hier(hvd, np, args)
+        if r == 0:
+            for label in ("data_plane", "engine"):
+                s = summary[label]
+                print(f"hier {label} paired rounds (ms, rings vs "
+                      f"arena): {s['pairs_ms']}")
+                print(f"  median speedup arena legs vs per-pair rings "
+                      f"({label}): {s['median_speedup']}x  "
+                      f"(rings {s['rings_ms_median']}ms -> "
+                      f"arena {s['arena_ms_median']}ms)")
+            print(json.dumps(dict(
+                {"metric": "eager_allreduce_hier", "np": n}, **summary)))
         return
 
     if args.mode == "pipeline":

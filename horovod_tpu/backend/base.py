@@ -95,15 +95,40 @@ def wire_codec_stats():
     return getattr(_codec_ctx, "stats", None)
 
 
+def take_first_hop_encoded(expected_nbytes: int):
+    """Consume the scope's first-hop encoded bytes (the engine's
+    error-feedback grid projection already encoded this response's
+    contribution — docs/running.md "Wire compression"). Consume-once:
+    the FIRST data-plane hop of the op ships these bytes directly
+    instead of re-encoding; every later hop (which carries reduced,
+    i.e. different, values) sees None and encodes itself. Callers must
+    take this at their entry point, while their buffer still holds the
+    engine's projected values, and pass it down explicitly — a nested
+    ring on mutated data must never see the stash. The size check is
+    defense in depth: a buffer the engine didn't project (different
+    element count) can never match."""
+    enc = getattr(_codec_ctx, "first_hop", None)
+    if enc is None:
+        return None
+    _codec_ctx.first_hop = None
+    if enc.nbytes != int(expected_nbytes):
+        return None
+    return enc
+
+
 @contextlib.contextmanager
-def wire_codec_scope(codec, stats=None):
+def wire_codec_scope(codec, stats=None, first_hop=None):
     prev = (getattr(_codec_ctx, "codec", None),
-            getattr(_codec_ctx, "stats", None))
-    _codec_ctx.codec, _codec_ctx.stats = codec, stats
+            getattr(_codec_ctx, "stats", None),
+            getattr(_codec_ctx, "first_hop", None))
+    _codec_ctx.codec = codec
+    _codec_ctx.stats = stats
+    _codec_ctx.first_hop = first_hop
     try:
         yield
     finally:
-        _codec_ctx.codec, _codec_ctx.stats = prev
+        (_codec_ctx.codec, _codec_ctx.stats,
+         _codec_ctx.first_hop) = prev
 
 
 def desync_message(got, want, rank: Optional[int] = None,
@@ -152,15 +177,32 @@ class Backend(ControllerTransport):
     # a live shared-memory overlay — so no rank can pick a different
     # schedule. Tests may set it directly on hand-built backends.
     leader_hier_ok: bool = False
+    # Host-arena intra-host legs allowed (HOROVOD_HIER_ARENA=auto
+    # resolves through this): set by the ENGINE from a collectively
+    # AND-agreed capability bit — every host's local group is covered
+    # by a live shared-memory arena — so a host that cannot map its
+    # arena degrades the whole schedule to per-pair rings consistently.
+    # Tests may set it directly on hand-built backends.
+    arena_hier_ok: bool = False
     # Intra-host collective arena (backend/shm.py ShmArenaSet), set by
-    # mesh backends when the WHOLE group is co-located; the eligibility
-    # predicate (backend/ring.py arena_eligible) gates on it.
+    # mesh backends for the co-located group agreed via the rendezvous
+    # locality rows: the whole world when fully co-located (the
+    # SHM_ARENA_ALLREDUCE plane, backend/ring.py arena_eligible) or one
+    # host's local group on a multi-host mesh (the leader schedule's
+    # arena legs).
     arena_set = None
 
     def prefers_leader_hierarchy(self) -> bool:
         """This rank's LOCAL vote for the leader schedule (intra-host
         bytes ~free, e.g. over shm). Folded into the engine's validity
         agreement; never consulted directly by the data plane."""
+        return False
+
+    def prefers_arena_hierarchy(self) -> bool:
+        """This rank's LOCAL vote for host-arena intra-host legs: its
+        local group (from the negotiated topology) is exactly the
+        co-located group a live host arena covers. Folded into the
+        engine's validity agreement like the leader vote."""
         return False
     # Tracing plane (common/tracing.py): the engine installs its tracer
     # here so backend phase spans (ring segment recv/reduce, star

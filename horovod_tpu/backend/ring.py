@@ -36,12 +36,17 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..common import tracing
 from ..common.exceptions import HorovodInternalError
 from ..common.types import ReduceOp
+from ..utils import clock
 from .base import (
     _reduce,
+    channel_scope,
+    current_channel,
     current_wire_codec,
     desync_message,
+    take_first_hop_encoded,
     wire_codec_stats,
 )
 from .transport import COMPLETED as _COMPLETED
@@ -152,12 +157,16 @@ def ring_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
 def arena_eligible(backend, nbytes: int, op: ReduceOp) -> bool:
     """Intra-host arena allreduce (backend/shm.py ShmArena): highest-
     priority plane, available only when the mesh backend established a
-    whole-world co-located arena at init AND HOROVOD_TRANSPORT still
-    routes to shared memory at call time. Every input is collectively
+    WHOLE-WORLD co-located arena at init AND HOROVOD_TRANSPORT still
+    routes to shared memory at call time. Arenas are host-scoped now —
+    a multi-host mesh gets one per host for the leader schedule's
+    intra-host legs (_host_arena) — so the whole-world plane gates on
+    the arena's group covering every rank. Every input is collectively
     consistent: arena existence comes from rendezvous-agreed locality,
     the env knobs are launcher-propagated (benchmarks flip them between
     barriers), and nbytes/op are coordinator-negotiated."""
-    if getattr(backend, "arena_set", None) is None:
+    aset = getattr(backend, "arena_set", None)
+    if aset is None or getattr(aset, "size", 0) != backend.size:
         return False
     if os.environ.get("HOROVOD_CPU_OPERATIONS", "").lower() in (
             "star", "ring"):
@@ -504,7 +513,7 @@ class RingCollectivesMixin(StarCollectivesMixin):
             m.inc(k)
 
     def _ring_reduce_scatter(self, group: List[int], flat: np.ndarray,
-                             op: ReduceOp):
+                             op: ReduceOp, first_hop=None):
         """In-place, pipelined ring reduce-scatter over `group`. On
         return, the rank at position p holds group-chunk (p+1)%n fully
         reduced (ref: gloo ring reduce-scatter schedule,
@@ -515,7 +524,15 @@ class RingCollectivesMixin(StarCollectivesMixin):
         on the send side — while receiving the incoming chunk segment by
         segment into a double-buffered persistent scratch and reducing
         in place, so the wire write of segment k overlaps this rank's
-        recv+reduce of segment k-1."""
+        recv+reduce of segment k-1.
+
+        ``first_hop`` (zero-redundancy first hop, docs/running.md "Wire
+        compression") is the engine's already-encoded wire bytes for
+        the WHOLE of ``flat``: step 0 — the only step that ships
+        unmutated engine values — slices it instead of re-encoding.
+        Callers pass it explicitly from their entry point's
+        consume-once take; a nested ring on reduced values never sees
+        it."""
         n = len(group)
         pos = group.index(self.rank)
         right, left = group[(pos + 1) % n], group[(pos - 1) % n]
@@ -527,7 +544,7 @@ class RingCollectivesMixin(StarCollectivesMixin):
         seg_cap = max(seg_cap, 1)
         # Wire compression (docs/running.md "Wire compression"): with
         # an active fixed-width codec each step encodes its send chunk
-        # once (segments are memoryview slices of the encoded buffer),
+        # (segments are memoryview slices of the encoded bytes),
         # receives the incoming chunk's encoded segments into a byte
         # scratch, and decompresses-then-reduces per segment — the
         # accumulation stays full-width, only the wire narrows.
@@ -537,13 +554,12 @@ class RingCollectivesMixin(StarCollectivesMixin):
         codec = _ring_codec(flat.dtype)
         stats = wire_codec_stats() if codec is not None else None
         wis = codec.wire_itemsize if codec is not None else 0
-        # Two alternating scratch halves. Today recv and reduce run
-        # sequentially on this thread (only the SEND side truly
-        # overlaps, via the queued sender), so the second half buys no
-        # wall-clock yet — it exists so segment k's recv target never
-        # aliases segment k-1's reduce source, which is the invariant
-        # an async recv/reduce split will need.
+        # Two alternating scratch halves: segment k's recv target never
+        # aliases segment k-1's decode-reduce source — the invariant
+        # the overlapped path below depends on (its decode stage may
+        # still be reading half k-1 while half k receives).
         if codec is None:
+            first_hop = None
             scratch = self._ring_scratch(flat.dtype, 2 * seg_cap)
         else:
             scratch = self._ring_scratch(
@@ -559,56 +575,204 @@ class RingCollectivesMixin(StarCollectivesMixin):
         # the persistent sender's lane (tagged with this thread's trace
         # scope, captured at enqueue).
         tr = self.tracer
-        for s in range(n - 1):
-            send_c = chunk(pos - s)
-            tgt = chunk(pos - s - 1)
-            sb = self._segment_bounds(send_c.size, seg)
-            if codec is None:
-                tickets = [self.send_async(right, send_c[a:b])
-                           for a, b in zip(sb, sb[1:])]
-            else:
-                t0 = time.perf_counter()
-                enc = codec.encode(send_c)
-                if stats is not None:
-                    stats.observe("encode", time.perf_counter() - t0)
-                    stats.saved(codec.name, send_c.nbytes - enc.nbytes)
-                # `enc` stays referenced until the tickets complete
-                # below, so the queued memoryview slices never dangle.
-                tickets = [self.send_async(right, enc[a * wis:b * wis])
-                           for a, b in zip(sb, sb[1:])]
-            self._count_segments(len(tickets))
-            rb = self._segment_bounds(tgt.size, seg)
-            dec_s = 0.0
-            for k, (a, b) in enumerate(zip(rb, rb[1:])):
+        # Codec/wire overlap (HOROVOD_RING_CODEC_OVERLAP, default on):
+        # one bounded single-worker stage per direction — the encode
+        # stage encodes segment k+1 and hands it to the transport while
+        # segment k is on the wire; the decode stage decodes-reduces
+        # segment k-1 while this thread receives k. FIFO holds because
+        # every send of the phase funnels through the one encode worker
+        # in submission order; results are bitwise identical to the
+        # serial path because fixed-width encode is elementwise (a
+        # segment's encode == the same slice of the chunk's encode) and
+        # decode-reduce targets are disjoint per segment. Purely local:
+        # each rank may flip it independently. Single-segment chunks
+        # (small ops, or single-shot mode) have nothing to pipeline —
+        # they stay serial rather than paying 2 worker threads per
+        # phase on the latency lane.
+        from ..utils import env as env_cfg
+
+        overlap = (codec is not None and 0 < seg < max_chunk
+                   and env_cfg.ring_codec_overlap())
+        enc_stage = dec_stage = None
+        enc_secs: List[float] = []
+        dec_secs: List[float] = []
+        ch = current_channel()
+        if overlap:
+            from ..common.compression import PipelineStage
+
+            enc_stage = PipelineStage(f"ring-enc-c{ch}")
+            dec_stage = PipelineStage(f"ring-dec-c{ch}")
+        try:
+            for s in range(n - 1):
+                send_c = chunk(pos - s)
+                tgt = chunk(pos - s - 1)
+                sb = self._segment_bounds(send_c.size, seg)
+                send_futs = tickets = None
                 if codec is None:
-                    half = scratch[(k % 2) * seg_cap:][: b - a]
+                    tickets = [self.send_async(right, send_c[a:b])
+                               for a, b in zip(sb, sb[1:])]
                 else:
-                    half = scratch[(k % 2) * seg_cap * wis:][: (b - a) * wis]
-                with tr.span("ring.recv", cat="xfer",
-                             args={"bytes": int(half.nbytes)}):
-                    self.recv_into_from(left, half)
-                if b > a:
-                    with tr.span("ring.reduce", cat="compute"):
-                        if codec is None:
-                            _reduce_into(red, tgt[a:b], half)
+                    send_base = bounds[(pos - s) % n]
+                    reuse = first_hop if s == 0 else None
+                    if overlap:
+                        send_futs = []
+                        # Channel AND trace scope are captured on THIS
+                        # thread and re-entered in the worker, so the
+                        # sender-dwell spans stay attributed exactly as
+                        # in the serial path.
+                        tid = tracing.current_trace()
+                        for a, b in zip(sb, sb[1:]):
+                            def enc_job(a=a, b=b, send_c=send_c,
+                                        send_base=send_base, reuse=reuse,
+                                        tid=tid):
+                                if reuse is not None:
+                                    ev = reuse[(send_base + a) * wis:
+                                               (send_base + b) * wis]
+                                else:
+                                    t0 = time.perf_counter()
+                                    ev = codec.encode(send_c[a:b])
+                                    enc_secs.append(
+                                        time.perf_counter() - t0)
+                                if stats is not None:
+                                    stats.saved(
+                                        codec.name,
+                                        (b - a) * flat.itemsize
+                                        - ev.nbytes)
+                                with channel_scope(ch), \
+                                        tracing.trace_scope(tid):
+                                    return self.send_async(right, ev)
+
+                            send_futs.append(enc_stage.submit(enc_job))
+                    else:
+                        if reuse is not None:
+                            enc = reuse[send_base * wis:
+                                        (send_base + send_c.size) * wis]
+                            if stats is not None:
+                                stats.saved(codec.name,
+                                            send_c.nbytes - enc.nbytes)
                         else:
                             t0 = time.perf_counter()
-                            dec = codec.decode(half, b - a)
-                            dec_s += time.perf_counter() - t0
-                            _reduce_into(red, tgt[a:b], dec)
-            if stats is not None and dec_s:
-                stats.observe("decode", dec_s)
-            with tr.span("ring.send_wait", cat="xfer",
-                         args={"segments": len(tickets)}):
-                for t in tickets:
-                    t.wait()
+                            enc = codec.encode(send_c)
+                            if stats is not None:
+                                stats.observe(
+                                    "encode", time.perf_counter() - t0)
+                                stats.saved(codec.name,
+                                            send_c.nbytes - enc.nbytes)
+                        # `enc` stays referenced until the tickets
+                        # complete below, so the queued memoryview
+                        # slices never dangle.
+                        tickets = [
+                            self.send_async(right, enc[a * wis:b * wis])
+                            for a, b in zip(sb, sb[1:])]
+                self._count_segments(len(sb) - 1)
+                rb = self._segment_bounds(tgt.size, seg)
+                if overlap:
+                    dec_futs: List = []
+                    for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                        # Reusing half k%2 requires its last reader
+                        # (decode job k-2) to be done.
+                        if k >= 2 and dec_futs[k - 2] is not None:
+                            dec_futs[k - 2].result()
+                        half = scratch[(k % 2) * seg_cap * wis:][
+                            : (b - a) * wis]
+                        with tr.span("ring.recv", cat="xfer",
+                                     args={"bytes": int(half.nbytes)}):
+                            self.recv_into_from(left, half)
+                        if b > a:
+                            # The trace id is captured on THIS thread
+                            # (the worker has no trace scope), like the
+                            # sender-dwell spans — so the per-segment
+                            # ring.reduce spans docs/tracing.md
+                            # documents survive the overlap mode, on
+                            # the worker's tid sub-lane.
+                            tid = tracing.current_trace()
 
-    def _ring_allgather_chunks(self, group: List[int], flat: np.ndarray):
+                            def dec_job(a=a, b=b, half=half, tgt=tgt,
+                                        tid=tid):
+                                t_ns = clock.mono_ns()
+                                t0 = time.perf_counter()
+                                dec = codec.decode(half, b - a)
+                                dec_secs.append(time.perf_counter() - t0)
+                                _reduce_into(red, tgt[a:b], dec)
+                                if tr.enabled:
+                                    tr.emit("ring.reduce", "compute",
+                                            t_ns, clock.mono_ns() - t_ns,
+                                            trace_id=tid)
+
+                            dec_futs.append(dec_stage.submit(dec_job))
+                        else:
+                            dec_futs.append(None)
+                    # tgt must be fully reduced before the next step
+                    # may encode it as its send chunk.
+                    for f in dec_futs:
+                        if f is not None:
+                            f.result()
+                else:
+                    dec_s = 0.0
+                    for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                        if codec is None:
+                            half = scratch[(k % 2) * seg_cap:][: b - a]
+                        else:
+                            half = scratch[(k % 2) * seg_cap * wis:][
+                                : (b - a) * wis]
+                        with tr.span("ring.recv", cat="xfer",
+                                     args={"bytes": int(half.nbytes)}):
+                            self.recv_into_from(left, half)
+                        if b > a:
+                            with tr.span("ring.reduce", cat="compute"):
+                                if codec is None:
+                                    _reduce_into(red, tgt[a:b], half)
+                                else:
+                                    t0 = time.perf_counter()
+                                    dec = codec.decode(half, b - a)
+                                    dec_s += time.perf_counter() - t0
+                                    _reduce_into(red, tgt[a:b], dec)
+                    if stats is not None and dec_s:
+                        stats.observe("decode", dec_s)
+                with tr.span("ring.send_wait", cat="xfer",
+                             args={"segments": len(sb) - 1}):
+                    if send_futs is not None:
+                        for f in send_futs:
+                            f.result().wait()
+                    else:
+                        for t in tickets:
+                            t.wait()
+                if overlap and stats is not None:
+                    # One aggregated observation per step per phase —
+                    # the same count accounting as the serial path, so
+                    # horovod_compression_seconds{phase=} counts stay
+                    # mode-independent (the first-hop test relies on
+                    # per-op encode counts).
+                    if enc_secs:
+                        stats.observe("encode", sum(enc_secs))
+                        del enc_secs[:]
+                    if dec_secs:
+                        stats.observe("decode", sum(dec_secs))
+                        del dec_secs[:]
+        finally:
+            if enc_stage is not None:
+                enc_stage.stop()
+            if dec_stage is not None:
+                dec_stage.stop()
+
+    def _ring_allgather_chunks(self, group: List[int], flat: np.ndarray,
+                               on_chunk=None):
         """Ring allgather of the per-position chunks: position p starts
         owning chunk (p+1)%n; after n-1 rotations every rank holds all.
         Pipelined like the reduce-scatter, except incoming segments land
         straight in their final chunk slice — no scratch, no copy (a
-        small decode scratch returns when a wire codec is active)."""
+        small decode scratch returns when a wire codec is active).
+
+        ``on_chunk(lo_elem, hi_elem)`` fires the moment a SEGMENT of
+        ``flat`` is FINAL on this rank — the owned chunk's segments up
+        front, each received segment as it lands (after its decode
+        under a codec) — chunks in the deterministic order (pos+1),
+        (pos), (pos-1), ... and segments in order within each chunk,
+        exactly the ranges _segment_bounds yields, so any observer can
+        replay the identical range sequence from the schedule alone.
+        The leader-mode hierarchical allreduce hooks its intra-host
+        bcast here, so the fan-out of a segment overlaps the wire time
+        of the next (docs/running.md "Transports")."""
         n = len(group)
         pos = group.index(self.rank)
         right, left = group[(pos + 1) % n], group[(pos - 1) % n]
@@ -624,6 +788,7 @@ class RingCollectivesMixin(StarCollectivesMixin):
 
         scratch = None
         seg_cap = 0
+        own_enc = None
         if codec is not None:
             # Project the chunk this rank OWNS (fully reduced in the
             # scatter phase) onto the codec grid before the first send:
@@ -631,10 +796,18 @@ class RingCollectivesMixin(StarCollectivesMixin):
             # hold the same value or ranks finish with different
             # results. Later rotations forward already-projected
             # values, whose re-encode is lossless for the fixed-width
-            # codecs — so one projection at the source is enough.
+            # codecs — so one projection at the source is enough. The
+            # projection's encode does double duty: step 0 sends the
+            # SAME chunk, so it ships these bytes directly instead of
+            # re-encoding them (zero-redundancy first hop — the wire
+            # carries exactly decode's input, bitwise).
             own = chunk(pos + 1)
             if own.size:
-                own[:] = codec.decode(codec.encode(own), own.size)
+                t0 = time.perf_counter()
+                own_enc = codec.encode(own)
+                if stats is not None:
+                    stats.observe("encode", time.perf_counter() - t0)
+                own[:] = codec.decode(own_enc, own.size)
             max_chunk = max(bounds[i + 1] - bounds[i] for i in range(n))
             seg_cap = min(seg, max_chunk) if seg else max_chunk
             seg_cap = max(seg_cap, 1)
@@ -642,49 +815,176 @@ class RingCollectivesMixin(StarCollectivesMixin):
                 np.dtype(np.uint8), 2 * seg_cap * wis)
 
         tr = self.tracer
-        for s in range(n - 1):
-            send_c = chunk(pos - s + 1)
-            tgt = chunk(pos - s)
-            sb = self._segment_bounds(send_c.size, seg)
-            if codec is None:
-                tickets = [self.send_async(right, send_c[a:b])
-                           for a, b in zip(sb, sb[1:])]
-            else:
-                t0 = time.perf_counter()
-                enc = codec.encode(send_c)
-                if stats is not None:
-                    stats.observe("encode", time.perf_counter() - t0)
-                    stats.saved(codec.name, send_c.nbytes - enc.nbytes)
-                tickets = [self.send_async(right, enc[a * wis:b * wis])
-                           for a, b in zip(sb, sb[1:])]
-            self._count_segments(len(tickets))
-            rb = self._segment_bounds(tgt.size, seg)
-            dec_s = 0.0
-            for k, (a, b) in enumerate(zip(rb, rb[1:])):
+        if on_chunk is not None:
+            # The owned chunk is final before the first rotation.
+            i = (pos + 1) % n
+            lo = bounds[i]
+            sbo = self._segment_bounds(bounds[i + 1] - lo, seg)
+            for a, b in zip(sbo, sbo[1:]):
+                on_chunk(lo + a, lo + b)
+        # Same codec/wire overlap stages as the reduce-scatter (see
+        # there); the decode stage writes disjoint final slices, so no
+        # reduce ordering is involved at all. Single-segment chunks
+        # stay serial (nothing to pipeline; max_chunk is always set
+        # when codec is, and the `and` short-circuits otherwise).
+        from ..utils import env as env_cfg
+
+        overlap = (codec is not None and 0 < seg < max_chunk
+                   and env_cfg.ring_codec_overlap())
+        enc_stage = dec_stage = None
+        enc_secs: List[float] = []
+        dec_secs: List[float] = []
+        ch = current_channel()
+        if overlap:
+            from ..common.compression import PipelineStage
+
+            enc_stage = PipelineStage(f"ring-enc-c{ch}")
+            dec_stage = PipelineStage(f"ring-dec-c{ch}")
+        try:
+            for s in range(n - 1):
+                send_c = chunk(pos - s + 1)
+                tgt = chunk(pos - s)
+                sb = self._segment_bounds(send_c.size, seg)
+                send_futs = tickets = None
                 if codec is None:
-                    with tr.span("ring.recv", cat="xfer",
-                                 args={"bytes": (b - a) * flat.itemsize}):
-                        self.recv_into_from(left, tgt[a:b])
-                    continue
-                half = scratch[(k % 2) * seg_cap * wis:][: (b - a) * wis]
-                with tr.span("ring.recv", cat="xfer",
-                             args={"bytes": int(half.nbytes)}):
-                    self.recv_into_from(left, half)
-                if b > a:
-                    t0 = time.perf_counter()
-                    tgt[a:b] = codec.decode(half, b - a)
-                    dec_s += time.perf_counter() - t0
-            if stats is not None and dec_s:
-                stats.observe("decode", dec_s)
-            with tr.span("ring.send_wait", cat="xfer",
-                         args={"segments": len(tickets)}):
-                for t in tickets:
-                    t.wait()
+                    tickets = [self.send_async(right, send_c[a:b])
+                               for a, b in zip(sb, sb[1:])]
+                else:
+                    # own_enc covers exactly the step-0 send chunk.
+                    reuse = own_enc if s == 0 else None
+                    if overlap:
+                        send_futs = []
+                        tid = tracing.current_trace()
+                        for a, b in zip(sb, sb[1:]):
+                            def enc_job(a=a, b=b, send_c=send_c,
+                                        reuse=reuse, tid=tid):
+                                if reuse is not None:
+                                    ev = reuse[a * wis:b * wis]
+                                else:
+                                    t0 = time.perf_counter()
+                                    ev = codec.encode(send_c[a:b])
+                                    enc_secs.append(
+                                        time.perf_counter() - t0)
+                                if stats is not None:
+                                    stats.saved(
+                                        codec.name,
+                                        (b - a) * flat.itemsize
+                                        - ev.nbytes)
+                                with channel_scope(ch), \
+                                        tracing.trace_scope(tid):
+                                    return self.send_async(right, ev)
+
+                            send_futs.append(enc_stage.submit(enc_job))
+                    else:
+                        if reuse is not None:
+                            enc = reuse
+                            if stats is not None:
+                                stats.saved(codec.name,
+                                            send_c.nbytes - enc.nbytes)
+                        else:
+                            t0 = time.perf_counter()
+                            enc = codec.encode(send_c)
+                            if stats is not None:
+                                stats.observe(
+                                    "encode", time.perf_counter() - t0)
+                                stats.saved(codec.name,
+                                            send_c.nbytes - enc.nbytes)
+                        tickets = [
+                            self.send_async(right, enc[a * wis:b * wis])
+                            for a, b in zip(sb, sb[1:])]
+                self._count_segments(len(sb) - 1)
+                rb = self._segment_bounds(tgt.size, seg)
+                tgt_lo = bounds[(pos - s) % n]
+                if codec is None:
+                    for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                        with tr.span("ring.recv", cat="xfer",
+                                     args={"bytes":
+                                           (b - a) * flat.itemsize}):
+                            self.recv_into_from(left, tgt[a:b])
+                        if on_chunk is not None:
+                            on_chunk(tgt_lo + a, tgt_lo + b)
+                elif overlap:
+                    dec_futs: List = []
+                    for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                        if k >= 2 and dec_futs[k - 2] is not None:
+                            dec_futs[k - 2].result()
+                        half = scratch[(k % 2) * seg_cap * wis:][
+                            : (b - a) * wis]
+                        with tr.span("ring.recv", cat="xfer",
+                                     args={"bytes": int(half.nbytes)}):
+                            self.recv_into_from(left, half)
+                        if b > a:
+                            def dec_job(a=a, b=b, half=half, tgt=tgt):
+                                t0 = time.perf_counter()
+                                tgt[a:b] = codec.decode(half, b - a)
+                                dec_secs.append(time.perf_counter() - t0)
+
+                            dec_futs.append(dec_stage.submit(dec_job))
+                        else:
+                            dec_futs.append(None)
+                    # tgt is next step's send chunk: decoded fully
+                    # before the loop advances.
+                    for f in dec_futs:
+                        if f is not None:
+                            f.result()
+                    if on_chunk is not None:
+                        # Segments fired in order, post-drain (the
+                        # decode stage is FIFO, so they are final).
+                        for a, b in zip(rb, rb[1:]):
+                            on_chunk(tgt_lo + a, tgt_lo + b)
+                else:
+                    dec_s = 0.0
+                    for k, (a, b) in enumerate(zip(rb, rb[1:])):
+                        half = scratch[(k % 2) * seg_cap * wis:][
+                            : (b - a) * wis]
+                        with tr.span("ring.recv", cat="xfer",
+                                     args={"bytes": int(half.nbytes)}):
+                            self.recv_into_from(left, half)
+                        if b > a:
+                            t0 = time.perf_counter()
+                            tgt[a:b] = codec.decode(half, b - a)
+                            dec_s += time.perf_counter() - t0
+                        if on_chunk is not None:
+                            on_chunk(tgt_lo + a, tgt_lo + b)
+                    if stats is not None and dec_s:
+                        stats.observe("decode", dec_s)
+                with tr.span("ring.send_wait", cat="xfer",
+                             args={"segments": len(sb) - 1}):
+                    if send_futs is not None:
+                        for f in send_futs:
+                            f.result().wait()
+                    else:
+                        for t in tickets:
+                            t.wait()
+                if overlap and stats is not None:
+                    if enc_secs:
+                        stats.observe("encode", sum(enc_secs))
+                        del enc_secs[:]
+                    if dec_secs:
+                        stats.observe("decode", sum(dec_secs))
+                        del dec_secs[:]
+        finally:
+            if enc_stage is not None:
+                enc_stage.stop()
+            if dec_stage is not None:
+                dec_stage.stop()
 
     def _ring_allreduce_group(self, group: List[int], flat: np.ndarray,
-                              op: ReduceOp):
-        self._ring_reduce_scatter(group, flat, op)
-        self._ring_allgather_chunks(group, flat)
+                              op: ReduceOp, first_hop=None,
+                              on_chunk=None):
+        self._ring_reduce_scatter(group, flat, op, first_hop=first_hop)
+        self._ring_allgather_chunks(group, flat, on_chunk=on_chunk)
+
+    def _take_first_hop(self, flat: np.ndarray):
+        """Entry-point consume of the engine's first-hop encode (see
+        base.take_first_hop_encoded): taken ONCE per op, while ``flat``
+        still holds the engine's grid-projected values, and threaded
+        down explicitly — deeper phases operate on reduced values and
+        must never reach for the thread-local themselves."""
+        codec = _ring_codec(flat.dtype)
+        if codec is None:
+            return None
+        return take_first_hop_encoded(flat.size * codec.wire_itemsize)
 
     def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp,
                         owned: bool = False) -> np.ndarray:
@@ -695,7 +995,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
         flat = np.ascontiguousarray(arr).reshape(-1)
         if not owned and np.shares_memory(flat, arr):
             flat = flat.copy()
-        self._ring_allreduce_group(list(range(self.size)), flat, op)
+        self._ring_allreduce_group(list(range(self.size)), flat, op,
+                                   first_hop=self._take_first_hop(flat))
         if op == ReduceOp.AVERAGE:
             flat = (flat / self.size).astype(arr.dtype)
         return flat.reshape(arr.shape)
@@ -725,7 +1026,9 @@ class RingCollectivesMixin(StarCollectivesMixin):
         # shm throughput) and each reducer decodes peers' subslices on
         # the fly; the shared result stays full-width, so the copy-out
         # and the returned values are fp32 (docs/running.md "Wire
-        # compression"). Fixed-width codecs only, like the ring.
+        # compression"). Fixed-width codecs only, like the ring. The
+        # deposit is the op's FIRST hop, so the engine's first-hop
+        # encode is sliced straight into the slots — zero re-encode.
         codec = _ring_codec(flat.dtype)
         tr = self.tracer
         try:
@@ -735,7 +1038,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
                     flat, lambda dst, src: ufunc(dst, src, out=dst),
                     out=out, codec=codec,
                     stats=wire_codec_stats() if codec is not None
-                    else None)
+                    else None,
+                    first_hop=self._take_first_hop(flat))
         except (OSError, TimeoutError) as exc:
             from ..common.exceptions import TransportError
 
@@ -761,20 +1065,40 @@ class RingCollectivesMixin(StarCollectivesMixin):
         base = self.cross_rank * L
         local_group = list(range(base, base + L))
         flat = np.ascontiguousarray(arr).reshape(-1)
-        if not owned and np.shares_memory(flat, arr):
+        # The arena-legged leader schedule reads the input and writes a
+        # separate output (members deposit FROM the input and receive
+        # the bcast INTO the output), so — like the whole-world arena —
+        # it needs no defensive copy of a caller-owned tensor; the ring
+        # schedules reduce in place and still do.
+        aset = (self._host_arena(local_group)
+                if hierarchical_mode(self) == "leader" else None)
+        if aset is None and not owned and np.shares_memory(flat, arr):
             flat = flat.copy()
+        # Consume-once entry-point take: `flat` still holds the
+        # engine's grid-projected values here; whichever schedule runs,
+        # only its FIRST intra-host hop may ship these bytes.
+        first_hop = self._take_first_hop(flat)
 
-        if hierarchical_mode(self) == "leader":
-            self._hierarchical_leader(local_group, flat, op)
+        if aset is not None:
+            out = flat if (owned or not np.shares_memory(flat, arr)) \
+                else np.empty_like(flat)
+            self._hierarchical_leader_arena(aset, local_group, flat,
+                                            out, op)
+        elif hierarchical_mode(self) == "leader":
+            out = flat
+            self._hierarchical_leader(local_group, flat, op,
+                                      first_hop=first_hop)
         else:
-            self._hierarchical_slice(local_group, flat, op)
+            out = flat
+            self._hierarchical_slice(local_group, flat, op,
+                                     first_hop=first_hop)
 
         if op == ReduceOp.AVERAGE:
-            flat = (flat / self.size).astype(arr.dtype)
-        return flat.reshape(arr.shape)
+            out = (out / self.size).astype(arr.dtype)
+        return out.reshape(arr.shape)
 
     def _hierarchical_slice(self, local_group: List[int], flat: np.ndarray,
-                            op: ReduceOp):
+                            op: ReduceOp, first_hop=None):
         """Local reduce-scatter -> cross allreduce per slice -> local
         allgather (ref: NCCLHierarchicalAllreduce's ReduceScatter /
         cross-MPI_Allreduce / AllGather shape, nccl_operations.cc:190-405;
@@ -784,8 +1108,11 @@ class RingCollectivesMixin(StarCollectivesMixin):
         cross_group = [self.local_rank + h * L for h in range(self.cross_size)]
 
         # Phase A: local reduce-scatter; position local_rank ends owning
-        # local chunk (local_rank+1)%L, reduced across the host.
-        self._ring_reduce_scatter(local_group, flat, op)
+        # local chunk (local_rank+1)%L, reduced across the host. The
+        # only hop that ships unmutated engine values — first_hop goes
+        # here and nowhere else.
+        self._ring_reduce_scatter(local_group, flat, op,
+                                  first_hop=first_hop)
 
         # Phase B: cross-host ring allreduce on the owned slice only —
         # every local rank drives its own cross ring concurrently, so
@@ -800,15 +1127,41 @@ class RingCollectivesMixin(StarCollectivesMixin):
         # Phase C: local allgather of the fully reduced chunks.
         self._ring_allgather_chunks(local_group, flat)
 
+    def _host_arena(self, local_group: List[int]):
+        """The host-scoped arena covering exactly `local_group`, when
+        the collectively agreed capability bit (engine-set
+        arena_hier_ok — a host that can't map its arena degrades EVERY
+        host to per-pair rings consistently) allows it AND the per-call
+        knobs still route intra-host data to shared memory
+        (HOROVOD_HIER_ARENA / HOROVOD_TRANSPORT, read per call like the
+        route: the launcher propagates env to every rank, so the
+        per-call answer is collectively consistent and paired
+        benchmarks may flip the legs between barrier-separated
+        rounds)."""
+        if not getattr(self, "arena_hier_ok", False):
+            return None
+        aset = getattr(self, "arena_set", None)
+        if aset is None or list(getattr(aset, "group", ())) != local_group:
+            return None
+        from ..utils import env as env_cfg
+
+        if (env_cfg.hier_arena_setting() == "off"
+                or env_cfg.transport_mode() == "tcp"):
+            return None
+        return aset
+
     def _hierarchical_leader(self, local_group: List[int], flat: np.ndarray,
-                             op: ReduceOp):
+                             op: ReduceOp, first_hop=None):
         """Leader-based two-level schedule: intra-host ring
         reduce-scatter -> gather the reduced slices to the host leader
         -> ONE segmented inter-host ring between leaders -> intra-host
         bcast of the result. The right shape when intra-host bytes are
         ~free (shared memory) and inter-host links favor one stream per
         host pair; gather/bcast legs use send_async so the leader's
-        per-peer senders stream to all members concurrently."""
+        per-peer senders stream to all members concurrently. When the
+        host arena covers the local group, _hierarchical_allreduce
+        dispatches to _hierarchical_leader_arena instead — both
+        intra-host legs ride the arena there."""
         L = self.local_size
         base = local_group[0]
         leader = base
@@ -819,7 +1172,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
             return flat[bounds[own]: bounds[own + 1]]
 
         # Phase A: intra-host reduce-scatter (over shm when co-located).
-        self._ring_reduce_scatter(local_group, flat, op)
+        self._ring_reduce_scatter(local_group, flat, op,
+                                  first_hop=first_hop)
 
         tr = self.tracer
         if self.rank == leader:
@@ -848,3 +1202,92 @@ class RingCollectivesMixin(StarCollectivesMixin):
                 if seg.size:
                     self.send_to(leader, seg)
                 self.recv_into_from(leader, flat)
+
+    def _hierarchical_leader_arena(self, aset, local_group: List[int],
+                                   flat: np.ndarray, out: np.ndarray,
+                                   op: ReduceOp):
+        """Arena-legged leader schedule (docs/running.md "Transports"):
+        one FUSED arena reduce replaces the intra-host ring
+        reduce-scatter + gather-to-leader pair — every member deposits
+        its vector once into its slot, all members reduce equal
+        subslices from every slot in parallel, and the leader copies
+        the host-reduced vector out (2 data movements + 2 waited
+        barriers per chunk, vs 2(L-1) scheduled pairwise ring steps
+        plus a separate gather leg). The leaders then run the same
+        segmented inter-host ring, and one arena bcast replaces the
+        per-pair send_async fan-out. The arena is keyed by the calling
+        thread's executor channel like the whole-world plane, so
+        barrier generations advance in lockstep on every member.
+
+        Intra-host legs are full-width by design: those bytes never
+        meet a wire, and PR 11 measured codec passes on shm memcpy as
+        pure cost. The engine's first-hop encode is therefore NOT
+        consumed here — and must not leak into the inter-host ring,
+        which carries host-REDUCED values; the entry point's
+        consume-once take already retired it. Bitwise agreement holds:
+        leaders finish the inter-host ring bitwise identical (the
+        allgather grid projection), and the bcast is a memcpy of the
+        leader's bytes.
+
+        ``flat`` is only READ (member deposits, the root's own
+        contribution); the result lands in ``out`` — which is why the
+        caller can skip the ring path's defensive input copy."""
+        L = len(local_group)
+        leader = local_group[0]
+        red = op if op != ReduceOp.AVERAGE else ReduceOp.SUM
+        ufunc = _INPLACE_UFUNC[red]
+        arena = aset.get(current_channel())
+        tr = self.tracer
+        try:
+            with tr.span("hier.arena_reduce", cat="xfer",
+                         args={"bytes": int(flat.nbytes)}):
+                arena.reduce_to_member(
+                    flat, lambda dst, src: ufunc(dst, src, out=dst),
+                    root=0, out=out)
+            # Overlapped bcast: the leader deposits each element range
+            # into the arena THE MOMENT the inter-host allgather
+            # finishes it (on_chunk fires per ring SEGMENT), so the
+            # intra-host fan-out hides behind inter-host wire time
+            # instead of following it. Members replay the identical
+            # range sequence from the schedule alone — chunks in ring
+            # order (pos+1), (pos), (pos-1), ... of the cross bounds,
+            # segments in _segment_bounds order within each — so no
+            # range metadata travels and the session's sub-chunk
+            # streams agree range by range.
+            session = arena.bcast_session(out, root=0)
+            if self.rank == leader:
+                leaders = [h * L for h in range(self.cross_size)]
+                with tr.span("hier.arena_inter_bcast", cat="xfer",
+                             args={"bytes": int(flat.nbytes)}):
+                    self._ring_allreduce_group(
+                        leaders, out, op, on_chunk=session.deposit)
+                    session.close()
+            else:
+                n_c = self.cross_size
+                p = self.cross_rank
+                cb = self._bounds(out.size, n_c)
+                seg = self._segment_elems(out.itemsize)
+                with tr.span("hier.arena_bcast", cat="xfer",
+                             args={"bytes": int(flat.nbytes)}):
+                    order = [(p + 1) % n_c] + [
+                        (p - s) % n_c for s in range(n_c - 1)]
+                    for i in order:
+                        lo = cb[i]
+                        sbo = self._segment_bounds(cb[i + 1] - lo, seg)
+                        for a, b in zip(sbo, sbo[1:]):
+                            session.copy(lo + a, lo + b)
+                    session.close()
+        except (OSError, TimeoutError) as exc:
+            from ..common.exceptions import TransportError
+
+            reason = None
+            get_dead = getattr(self, "_arena_dead_reason", None)
+            if get_dead is not None:
+                reason = get_dead()
+            raise TransportError(
+                reason or (f"rank {self.rank}: shm arena hierarchical "
+                           f"allreduce failed: {exc}"),
+                reporter=self.rank, root_cause=reason) from exc
+        m = getattr(self, "_m_hier_arena", None)
+        if m is not None:
+            m.inc()

@@ -573,6 +573,17 @@ register_transport("shm", _shm_factory)
 _ARENA_HDR_MIN = 4096  # u64 seq counter per rank at a 64-byte stride
 _ARENA_SEQ_STRIDE = 64
 
+# Streaming chunk bound for the hierarchical arena LEGS (reduce-to-
+# member / bcast-from-member): unlike the whole-world allreduce (whose
+# slot-sized chunks were measured fastest — every rank both writes and
+# reads each chunk, so there is little serial chain to pipeline), the
+# legs have producer->consumer structure (deposit -> reduce -> root
+# copy-out; root deposit -> member copy-out). Capping chunks below the
+# slot lets chunk k+1's deposit overlap chunk k's reduce/copy across
+# cores — seq-counter barriers cost ~µs, so the extra barriers are
+# noise next to the overlap. 2MB matches DEFAULT_RING_SEGMENT_BYTES.
+_ARENA_LEG_CHUNK_BYTES = 2 << 20
+
 
 def _arena_header_bytes(size: int) -> int:
     """Seq-counter region, page-rounded and sized from the GROUP so a
@@ -645,6 +656,24 @@ class ShmArena:
                         f"shm arena {what} aborted: {reason}")
             waiter.pause(f"arena {what} (waiting on rank {laggard})")
 
+    def _wait_rank(self, r: int, value: int, what: str) -> None:
+        """Wait for ONE member's seq counter (the bcast leg's members
+        wait on the root only). Bounded exactly like _wait_all: the
+        sever flag and the TCP liveness verdict (dead_cb) both unblock
+        a parked wait with the attributed reason."""
+        waiter = _Waiter(self._timeout, "arena group")
+        while self._seq(r) < value:
+            if self._severed is not None:
+                raise ConnectionError(
+                    f"shm arena severed during {what}: {self._severed}")
+            cb = self.dead_cb
+            if cb is not None:
+                reason = cb()
+                if reason is not None:
+                    raise ConnectionError(
+                        f"shm arena {what} aborted: {reason}")
+            waiter.pause(f"arena {what} (waiting on member {r})")
+
     # -- regions -------------------------------------------------------
     def _slot(self, r: int):
         off = self._hdr + r * self.slot_bytes
@@ -656,7 +685,7 @@ class ShmArena:
 
     # -- collectives ---------------------------------------------------
     def allreduce_into(self, flat, reduce_fn, out=None, codec=None,
-                       stats=None) -> None:
+                       stats=None, first_hop=None) -> None:
         """Allreduce of a contiguous 1-D numpy array: reads ``flat``,
         writes ``out`` (defaults to ``flat`` — in place). Separate
         src/dst is what lets the caller skip the ring path's defensive
@@ -680,7 +709,14 @@ class ShmArena:
         The per-transport byte counters stay wire truth: ``sent``
         counts deposited (encoded) bytes, ``recv`` counts the
         full-width copy-out — under compression the two legitimately
-        differ (docs/metrics.md)."""
+        differ (docs/metrics.md).
+
+        ``first_hop`` (zero-redundancy first hop, docs/running.md) is
+        the engine's already-encoded wire bytes for ``flat``: when
+        given, deposits slice it instead of re-encoding — the arena IS
+        the op's first hop, so the encode the grid projection already
+        paid is the only one. Byte savings still count; no encode
+        latency is observed because no encode runs."""
         import numpy as np
 
         if out is None:
@@ -695,11 +731,18 @@ class ShmArena:
         for start in range(0, max(total, 1), chunk_elems):
             n = min(chunk_elems, total - start)
             nbytes = n * itemsize
-            # Phase 1: deposit my chunk (encoded when a codec rides).
+            # Phase 1: deposit my chunk (encoded when a codec rides;
+            # sliced from the engine's first-hop encode when provided).
             if codec is None:
                 dep_bytes = nbytes
                 self._slot(self.index)[:nbytes] = \
                     src_u8[start * itemsize:start * itemsize + nbytes]
+            elif first_hop is not None:
+                enc = first_hop[start * wis:(start + n) * wis]
+                dep_bytes = enc.nbytes
+                self._slot(self.index)[:dep_bytes] = enc
+                if stats is not None:
+                    stats.saved(codec.name, nbytes - dep_bytes)
             else:
                 t0 = time.perf_counter()
                 enc = codec.encode(flat[start:start + n])
@@ -763,6 +806,105 @@ class ShmArena:
                 self.m_recv.inc(nbytes)
         self._gen = g
 
+    def _leg_chunk_elems(self, itemsize: int) -> int:
+        """Chunk size for the double-buffered leg streams: two chunks
+        must fit one slot (buffer parity alternates per chunk), capped
+        by the pipelining bound."""
+        return max(min(self.slot_bytes // 2,
+                       _ARENA_LEG_CHUNK_BYTES) // itemsize, 1)
+
+    def reduce_to_member(self, flat, reduce_fn, root: int = 0,
+                         out=None) -> None:
+        """Fused intra-host gather-reduce to one member: every OTHER
+        member deposits its vector chunk-by-chunk into its slot, and
+        the member at group position ``root`` accumulates each chunk
+        straight into its PRIVATE ``out`` (default ``flat`` in place;
+        ``reduce_fn(dst, src)`` in member order, so the result is
+        deterministic). This replaces the leader schedule's ring
+        reduce-scatter + gather-to-leader pair with the minimum data
+        movement the host allows — (L-1) deposits + (L-1) reads per
+        chunk, no shared-result hop, no root deposit, no copy-out —
+        which is what wins on an aggregate-memcpy-bound box. Chunks
+        double-buffer inside each slot (parity offsets), so member k+1
+        deposits while the root reduces chunk k; the root's publish
+        after reducing chunk k is the members' reuse fence for that
+        parity (lag-2 wait), and a closing wait keeps a next
+        collective's deposits off buffers the root still reads.
+
+        Full-width by design: intra-host bytes never leave the host, so
+        the wire codec does not ride these legs (PR 11 measured codec
+        passes on shm memcpy as pure cost; docs/running.md). Byte
+        accounting: member deposits count ``sent``, the root's reads of
+        member slots count ``recv`` — the leg's two private<->shared
+        moves, conserved per host."""
+        import numpy as np
+
+        if out is None:
+            out = flat
+        itemsize = flat.itemsize
+        chunk_elems = self._leg_chunk_elems(itemsize)
+        total = flat.size
+        src_u8 = flat.view(np.uint8).reshape(-1)
+        g = self._gen
+        k = 0
+        starts = list(range(0, max(total, 1), chunk_elems))
+        for start in starts:
+            n = min(chunk_elems, total - start)
+            nbytes = n * itemsize
+            off = (k % 2) * (chunk_elems * itemsize)
+            v = g + k + 1
+            if self.index == root:
+                self._wait_all(v, "reduce deposit wait")
+                ochunk = out[start:start + n]
+                if out is not flat and n:
+                    ochunk[:] = flat[start:start + n]
+                for r in range(self.size):
+                    if r == root or n == 0:
+                        continue
+                    reduce_fn(ochunk, np.frombuffer(
+                        self._slot(r)[off:off + nbytes],
+                        dtype=flat.dtype))
+                self._publish(v)
+                if self.m_recv is not None:
+                    self.m_recv.inc((self.size - 1) * nbytes)
+            else:
+                if k >= 2:
+                    # Buffer parity k%2 was last read by the root at
+                    # chunk k-2; its publish frees it.
+                    self._wait_rank(root, v - 2, "reduce reuse wait")
+                self._slot(self.index)[off:off + nbytes] = \
+                    src_u8[start * itemsize:start * itemsize + nbytes]
+                self._publish(v)
+                if self.m_sent is not None:
+                    self.m_sent.inc(nbytes)
+            k += 1
+        if self.index != root:
+            # Closing fence: the root may still be reducing the last
+            # chunks; a next collective's deposit must not overwrite
+            # them (the root's own per-chunk wait covers its side).
+            self._wait_rank(root, g + len(starts), "reduce close wait")
+        self._gen = g + len(starts)
+
+    def bcast_session(self, flat, root: int = 0) -> "_BcastSession":
+        """Incremental range-ordered broadcast from the member at group
+        position ``root`` (see _BcastSession): the production path for
+        the leader schedule's overlapped bcast — the leader deposits
+        each element range the moment the inter-host allgather finishes
+        it, so the intra-host fan-out hides behind inter-host wire
+        time."""
+        return _BcastSession(self, flat, root)
+
+    def bcast_from_member(self, flat, root: int = 0) -> None:
+        """Whole-vector broadcast from the member at group position
+        ``root``: one bcast_session spanning [0, size). Full-width and
+        bitwise (a memcpy both ways)."""
+        s = self.bcast_session(flat, root)
+        if self.index == root:
+            s.deposit(0, flat.size)
+        else:
+            s.copy(0, flat.size)
+        s.close()
+
     def sever(self, reason: str = "severed") -> None:
         self._severed = reason
 
@@ -787,19 +929,104 @@ class ShmArena:
             pass
 
 
-class ShmArenaSet:
-    """Per-channel lazy arena factory for one backend (see the
-    concurrency contract above). All ranks materialize channel c's
-    arena from the same deterministic path on first use, so creation
-    needs no extra coordination beyond the establishment-time nonce."""
+class _BcastSession:
+    """One incremental intra-host broadcast through an arena's result
+    slot. The root calls ``deposit(lo, hi)`` for each element range as
+    it becomes final (e.g. per completed inter-host allgather chunk);
+    members call ``copy(lo, hi)`` for the SAME ranges in the SAME order
+    (both sides derive the order from the deterministic ring schedule,
+    so no range metadata travels). Each range streams in double-
+    buffered sub-chunks (parity offsets inside the result slot): the
+    root deposits sub-chunk k+1 while members copy sub-chunk k; the
+    members' publish after copying k is the root's reuse fence for
+    that parity (lag-2 wait). ``close()`` fences the tail (root waits
+    until every member copied everything) and commits the generation —
+    the sub-chunk count depends only on the ranges, so every member
+    commits the same value and the arena's barrier lockstep holds for
+    the next collective.
 
-    def __init__(self, base_dir: str, scope: str, nonce: str, index: int,
-                 size: int, slot_bytes: int, timeout: float = 0.0):
+    Byte accounting: root deposits count ``sent``, member copy-outs
+    count ``recv`` — same contract as every arena move."""
+
+    __slots__ = ("arena", "flat", "root", "u8", "itemsize",
+                 "chunk_elems", "k", "g")
+
+    def __init__(self, arena: "ShmArena", flat, root: int):
+        import numpy as np
+
+        self.arena = arena
+        self.flat = flat
+        self.root = root
+        self.itemsize = flat.itemsize
+        self.u8 = flat.view(np.uint8).reshape(-1)
+        self.chunk_elems = arena._leg_chunk_elems(self.itemsize)
+        self.k = 0
+        self.g = arena._gen
+
+    def _subchunks(self, lo: int, hi: int):
+        for start in range(lo, hi, self.chunk_elems):
+            yield start, min(self.chunk_elems, hi - start)
+
+    def deposit(self, lo: int, hi: int) -> None:
+        a = self.arena
+        for start, n in self._subchunks(lo, hi):
+            nbytes = n * self.itemsize
+            off = (self.k % 2) * (self.chunk_elems * self.itemsize)
+            v = self.g + self.k + 1
+            if self.k >= 2:
+                a._wait_all(v - 2, "bcast reuse wait")
+            a._result[off:off + nbytes] = \
+                self.u8[start * self.itemsize:
+                        start * self.itemsize + nbytes]
+            a._publish(v)
+            if a.m_sent is not None:
+                a.m_sent.inc(nbytes)
+            self.k += 1
+
+    def copy(self, lo: int, hi: int) -> None:
+        a = self.arena
+        for start, n in self._subchunks(lo, hi):
+            nbytes = n * self.itemsize
+            off = (self.k % 2) * (self.chunk_elems * self.itemsize)
+            v = self.g + self.k + 1
+            a._wait_rank(self.root, v, "bcast deposit wait")
+            self.u8[start * self.itemsize:
+                    start * self.itemsize + nbytes] = \
+                a._result[off:off + nbytes]
+            a._publish(v)
+            if a.m_recv is not None:
+                a.m_recv.inc(nbytes)
+            self.k += 1
+
+    def close(self) -> None:
+        if self.arena.index == self.root:
+            # Closing fence: every member copied the tail sub-chunks.
+            self.arena._wait_all(self.g + self.k, "bcast close wait")
+        self.arena._gen = self.g + self.k
+
+
+class ShmArenaSet:
+    """Per-channel lazy arena factory for one CO-LOCATED GROUP of one
+    backend (see the concurrency contract above). ``group`` is the
+    sorted list of global ranks sharing the host (agreed via the
+    rendezvous locality rows): the whole world on a fully co-located
+    mesh (the SHM_ARENA_ALLREDUCE plane) or one host's local group on a
+    multi-host mesh (the leader schedule's arena legs). Arena files
+    carry the group's lowest rank, so two simulated "hosts" sharing one
+    box (distinct HOROVOD_HOSTNAME) can never map each other's arenas.
+    All group members materialize channel c's arena from the same
+    deterministic path on first use, so creation needs no extra
+    coordination beyond the establishment-time nonce."""
+
+    def __init__(self, base_dir: str, scope: str, nonce: str,
+                 group: List[int], rank: int, slot_bytes: int,
+                 timeout: float = 0.0):
         self._dir = base_dir
         self._scope = scope
         self._nonce = nonce
-        self.index = index
-        self.size = size
+        self.group = sorted(group)
+        self.index = self.group.index(rank)
+        self.size = len(self.group)
         self._slot_bytes = slot_bytes
         self._timeout = timeout
         self._lock = threading.Lock()
@@ -814,7 +1041,8 @@ class ShmArenaSet:
             if a is None:
                 path = os.path.join(
                     self._dir,
-                    f"hvd_shm_{self._scope}_{self._nonce}_arena_c{channel}")
+                    f"hvd_shm_{self._scope}_{self._nonce}_arena"
+                    f"_g{self.group[0]}_c{channel}")
                 a = ShmArena(path, self.index, self.size,
                              self._slot_bytes, timeout=self._timeout)
                 a.dead_cb = self.dead_cb
@@ -831,8 +1059,9 @@ class ShmArenaSet:
 
     def status(self) -> dict:
         with self._lock:
-            return {str(ch): a.status()
-                    for ch, a in sorted(self._arenas.items())}
+            channels = {str(ch): a.status()
+                        for ch, a in sorted(self._arenas.items())}
+        return {"group": list(self.group), "channels": channels}
 
     def close(self) -> None:
         with self._lock:

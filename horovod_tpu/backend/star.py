@@ -159,18 +159,29 @@ class StarCollectivesMixin(Backend):
         The root's own return value is the DECODED result (not its
         full-width reduction): every rank must finish holding the
         bitwise-identical value its peers decoded off the wire, the
-        same determinism contract the uncompressed path has."""
+        same determinism contract the uncompressed path has.
+
+        Zero-redundancy first hop (docs/running.md "Wire compression"):
+        the gather frame IS the op's first hop, so when the engine's
+        error-feedback grid projection already encoded this
+        contribution, those bytes ship directly (bitwise what a
+        re-encode would produce — encode is value-deterministic) and
+        the only encode pass observed for the hop is the engine's."""
+        from .base import take_first_hop_encoded
+
         tr = self.tracer
         stats = wire_codec_stats()
         flat = np.ascontiguousarray(arr).reshape(-1)
-        t0 = time.perf_counter()
-        enc = codec.encode(flat)
-        if stats is not None:
-            stats.observe("encode", time.perf_counter() - t0)
-            if self.rank != 0:
-                # Only frames that actually hit a transport count as
-                # wire savings; rank 0's gather contribution is local.
-                stats.saved(codec.name, flat.nbytes - enc.nbytes)
+        enc = take_first_hop_encoded(codec.wire_bytes(flat.size))
+        if enc is None:
+            t0 = time.perf_counter()
+            enc = codec.encode(flat)
+            if stats is not None:
+                stats.observe("encode", time.perf_counter() - t0)
+        if stats is not None and self.rank != 0:
+            # Only frames that actually hit a transport count as
+            # wire savings; rank 0's gather contribution is local.
+            stats.saved(codec.name, flat.nbytes - enc.nbytes)
         with tr.span("star.gather", cat="xfer",
                      args={"bytes": int(enc.nbytes), "codec": codec.name}):
             gathered = self.gather_bytes(pack_wire(flat, codec, enc))

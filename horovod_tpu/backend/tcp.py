@@ -397,6 +397,10 @@ class TcpBackend(RingCollectivesMixin):
         self._m_ring_segments = registry.counter(
             "horovod_ring_segments_total",
             "Pipeline segments moved by ring collectives (send side)")
+        self._m_hier_arena = registry.counter(
+            "horovod_hier_arena_ops_total",
+            "Hierarchical allreduces whose intra-host legs rode the "
+            "per-host shared-memory arena (leader schedule)")
         self._m_sender_depth = registry.gauge(
             "horovod_sender_queue_depth",
             "Frames queued on persistent peer senders, summed over peers")
@@ -704,17 +708,20 @@ class TcpBackend(RingCollectivesMixin):
                         "(backpressure episodes)")
                 t.m_ring_full = self._m_shm_ring_full
                 overlays[peer] = t
-            # The intra-host ARENA (backend/shm.py ShmArena): when the
-            # WHOLE world is co-located, big allreduces skip the
-            # per-pair rings entirely — every rank deposits once into
-            # a shared slot and reduces its subslice straight from
-            # every peer's bytes. Group membership comes from the same
-            # KV locality rows on every rank, so arena existence is
-            # collectively consistent (given the ok bits below).
-            if len(colocated) == self.size - 1 and self.size > 1:
+            # The co-located-group ARENA (backend/shm.py ShmArena),
+            # HOST-scoped: whenever this rank shares its host with
+            # anyone, the group gets one arena — the whole world on a
+            # fully co-located mesh (big allreduces skip the per-pair
+            # rings entirely: SHM_ARENA_ALLREDUCE), or one host's local
+            # group on a multi-host mesh (the leader schedule's
+            # intra-host arena legs). Group membership comes from the
+            # same KV locality rows on every rank, so arena existence
+            # is collectively consistent (given the ok bits below).
+            if colocated:
                 arena = shm_mod.ShmArenaSet(
-                    base_dir, scope, nonce, index=self.rank,
-                    size=self.size, slot_bytes=env_cfg.shm_slot_bytes(),
+                    base_dir, scope, nonce,
+                    group=[self.rank] + colocated, rank=self.rank,
+                    slot_bytes=env_cfg.shm_slot_bytes(),
                     timeout=self._timeout)
                 arena.dead_cb = self._arena_dead_reason
                 arena.m_sent = self._transport_counter("shm", "sent")
@@ -747,10 +754,14 @@ class TcpBackend(RingCollectivesMixin):
             if not peer_ok[peer]:
                 overlays.pop(peer).close()
         self._overlays.update(overlays)
-        # The arena's group is the whole world: any rank voting not-ok
-        # disables it everywhere (every rank sees the same bits).
-        if arena is not None and (not ok or not all(peer_ok.values())
-                                  or len(overlays) != self.size - 1):
+        # The arena is disabled for the whole GROUP when any member's
+        # establishment failed (every member sees the same bits, so the
+        # group decides identically) — a host that can't map shm votes
+        # its whole host down, never half of it.
+        if arena is not None and (
+                not ok
+                or any(not peer_ok.get(p, False)
+                       for p in arena.group if p != self.rank)):
             arena.close()
             arena = None
         self.arena_set = arena
@@ -763,11 +774,12 @@ class TcpBackend(RingCollectivesMixin):
 
     def _arena_dead_reason(self) -> Optional[str]:
         """Bound for arena barrier waits: the first liveness verdict —
-        or any severed peer — in the co-located group (== the world,
-        by construction). Heartbeats ride TCP, so a wedged or killed
-        rank surfaces here within the detection window and every rank
-        parked on an arena barrier unblocks with the attributed
-        root cause."""
+        or any severed peer — anywhere in the mesh (a superset of the
+        arena's co-located group: a dead remote leader must abort a
+        member parked on a bcast barrier too). Heartbeats ride TCP, so
+        a wedged or killed rank surfaces here within the detection
+        window and every rank parked on an arena barrier unblocks with
+        the attributed root cause."""
         with self._death_lock:
             if self._death_reasons:
                 return next(iter(self._death_reasons.values()))
@@ -837,6 +849,22 @@ class TcpBackend(RingCollectivesMixin):
             or (base + i in self._overlays and self._overlays[base + i].alive)
             for i in range(L)
         )
+
+    def prefers_arena_hierarchy(self) -> bool:
+        """Local vote for the leader schedule's host-arena intra-host
+        legs: a live host arena covers EXACTLY this rank's local group
+        from the negotiated topology (the locality rows and the
+        hostfile agree on who shares the host). Folded into the
+        engine's validity agreement like the leader vote — never
+        consulted per call, so no rank can pick a different leg."""
+        if env_cfg.transport_mode() == "tcp":
+            return False
+        aset = self.arena_set
+        if aset is None:
+            return False
+        L = self.local_size
+        base = self.cross_rank * L
+        return aset.group == list(range(base, base + L))
 
     # ------------------------------------------------------------------
     # bounded, chaos-aware peer I/O. Every byte to or from a peer flows

@@ -41,6 +41,7 @@ the same determinism the uncompressed planes guarantee.
 from __future__ import annotations
 
 import collections
+import queue as _queue
 import struct
 import threading
 from typing import Dict, Optional
@@ -364,6 +365,92 @@ class ErrorFeedback:
     def nbytes(self) -> int:
         with self._lock:
             return sum(int(r.nbytes) for r in self._store.values())
+
+
+# ---------------------------------------------------------------------------
+# codec/wire overlap pipeline (docs/running.md "Wire compression")
+
+
+class StageFuture:
+    """Completion handle for one PipelineStage job: ``result()`` blocks
+    until the job ran and returns its value, re-raising the job's
+    exception on the caller's thread."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _done(self, value=None, error: Optional[BaseException] = None):
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("pipeline stage job did not complete")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+_STAGE_STOP = object()
+
+
+class PipelineStage:
+    """Bounded SINGLE-worker pipeline stage for codec/wire overlap.
+
+    Jobs run strictly FIFO on one worker thread — the property that
+    lets the segmented ring hand encoded segments to the transport
+    from a worker without breaking the per-channel FIFO contract —
+    while the bounded queue keeps at most ``depth`` jobs (one encoded
+    segment each) in flight, so a fast producer can never balloon
+    memory. One stage per direction: the ring's encode stage encodes
+    segment k+1 and ships it while segment k is on the wire; its
+    decode stage decodes-and-reduces segment k-1 while the caller
+    receives segment k. A job's exception parks in its future and
+    re-raises at ``result()``; later jobs still run (the caller owns
+    error propagation at its wait points, exactly like send tickets).
+
+    Lifetime is one collective: created at phase start, ``stop()``-ed
+    (sentinel + join) in the caller's finally — no backend shutdown
+    plumbing, nothing to leak across elastic engine rebuilds.
+    """
+
+    def __init__(self, name: str, depth: int = 4):
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(int(depth), 1))
+        self._thread = threading.Thread(
+            target=self._loop, name=f"hvd-{name}", daemon=True)
+        self._thread.start()
+
+    def submit(self, fn) -> StageFuture:
+        fut = StageFuture()
+        self._q.put((fn, fut))
+        return fut
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is _STAGE_STOP:
+                return
+            fn, fut = item
+            try:
+                fut._done(fn())
+            except BaseException as e:  # noqa: BLE001 - parked in future
+                fut._done(error=e)
+
+    def stop(self):
+        self._q.put(_STAGE_STOP)
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
 
 
 # ---------------------------------------------------------------------------

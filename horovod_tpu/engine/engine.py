@@ -669,15 +669,24 @@ class Engine:
                 # AND-agreed in one word so every rank lands on the
                 # same schedule — HOROVOD_HIERARCHICAL_MODE=auto
                 # resolves through leader_hier_ok.
+                # Bit 2: this rank's local group is covered by a live
+                # per-HOST shm arena (the leader schedule's arena
+                # legs) — AND-agreed like the rest, so a host that
+                # cannot map its arena degrades every host to the
+                # per-pair rings consistently.
                 word = 0
                 if hierarchical_capable(self.backend):
                     word |= 1
                 if self.backend.prefers_leader_hierarchy():
                     word |= 2
+                if self.backend.prefers_arena_hierarchy():
+                    word |= 4
                 agreed = self.backend.allreduce_words([word], "and")[0]
                 self._hier_valid = bool(agreed & 1)
                 self.backend.leader_hier_ok = bool(agreed & 1) and bool(
                     agreed & 2)
+                self.backend.arena_hier_ok = bool(agreed & 1) and bool(
+                    agreed & 4)
             # Static toggle (ref: HOROVOD_HIERARCHICAL_ALLREDUCE,
             # operations.cc:468-478; =auto engages exactly when the
             # agreed topology is hierarchical — co-located ranks on
@@ -1120,8 +1129,10 @@ class Engine:
         # ring segments / star frames / arena deposits ship encoded
         # bytes (docs/running.md "Wire compression").
         codec = self._wire_codec_for(resp, buf.dtype)
+        first_hop = None
         if codec is not None:
-            buf = self._apply_error_feedback(codec, resp, buf, owned)
+            buf, first_hop = self._apply_error_feedback(
+                codec, resp, buf, owned)
             owned = True
         # First Enabled() implementation wins; the winning op's name is
         # the timeline activity, like the reference's NCCL_ALLREDUCE /
@@ -1134,7 +1145,8 @@ class Engine:
 
         t0 = clock.monotonic()
         with self.timeline.activity(name0, op.name), \
-                wire_codec_scope(codec, self._comp_stats):
+                wire_codec_scope(codec, self._comp_stats,
+                                 first_hop=first_hop):
             red = op.execute(buf, rop, owned=owned)
         self._observe_op(op.name, clock.monotonic() - t0)
         if post != 1.0:
@@ -1166,20 +1178,26 @@ class Engine:
         return codec
 
     def _apply_error_feedback(self, codec, resp: Response,
-                              buf: np.ndarray, owned: bool) -> np.ndarray:
+                              buf: np.ndarray, owned: bool):
         """Error feedback (Seide et al. 2014; Karimireddy et al. 2019):
         add the residual left over from this tensor's previous
         compressed round, project the sum onto the codec grid
         (decode∘encode — what the wire will actually carry), and stash
         the new residual = pre-encode value minus decoded wire value.
-        Returns the grid-projected buffer, which is always engine-owned.
+        Returns ``(wire, enc)``: the grid-projected buffer (always
+        engine-owned) AND the encoded bytes the projection ran through.
 
         Running the projection HERE, once per tensor, buys two things:
-        the residual definition from the issue holds exactly (the data
-        plane's first-hop re-encode of a grid value is lossless for the
-        fixed-width codecs), and every rank's contribution entering the
-        collective is bitwise the value its peers will decode — the
-        rank-consistency the uncompressed planes get for free."""
+        the residual definition from the issue holds exactly, and every
+        rank's contribution entering the collective is bitwise the
+        value its peers will decode — the rank-consistency the
+        uncompressed planes get for free. The encoded bytes ride the
+        codec scope as the op's FIRST-HOP payload (zero-redundancy
+        first hop): the first ring/star/arena hop ships them directly
+        instead of re-encoding the identical values, so this encode —
+        observed as phase="encode", the wire-truth ledger — is the only
+        cast pass the first hop ever pays (the residual bookkeeping
+        alone stays under phase="feedback")."""
         flat = np.ascontiguousarray(buf).reshape(-1)
         key = "|".join(resp.tensor_names)
         t0 = clock.monotonic()
@@ -1193,10 +1211,15 @@ class Engine:
                 pre = flat + residual
         else:
             pre = flat
-        wire = codec.decode(codec.encode(pre), pre.size)
+        t_enc = clock.monotonic()
+        enc = codec.encode(pre)
+        enc_s = clock.monotonic() - t_enc
+        wire = codec.decode(enc, pre.size)
         self._error_feedback.update(key, pre, wire)
-        self._comp_stats.observe("feedback", clock.monotonic() - t0)
-        return wire.reshape(buf.shape)
+        self._comp_stats.observe("encode", enc_s)
+        self._comp_stats.observe("feedback",
+                                 clock.monotonic() - t0 - enc_s)
+        return wire.reshape(buf.shape), enc
 
     def _pack_fusion(
         self, entries: List[TensorTableEntry], channel: int = 0

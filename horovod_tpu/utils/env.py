@@ -103,6 +103,14 @@ SHM_DIR = "HOROVOD_SHM_DIR"
 # (local_size + 1) x slot_bytes of tmpfs, materialized lazily per
 # executor channel.
 SHM_SLOT_BYTES = "HOROVOD_SHM_SLOT_BYTES"
+# Intra-host legs of the leader-mode hierarchical allreduce: "auto"
+# (default) routes them through the per-HOST shared-memory arena when
+# every host's local group is covered by one (a collectively AND-agreed
+# capability bit — a host that cannot map its arena degrades the whole
+# schedule to the per-pair shm rings consistently); "off" pins the
+# per-pair rings. Read per call like HOROVOD_TRANSPORT, so paired
+# benchmarks can flip the legs between barrier-separated rounds.
+HIER_ARENA = "HOROVOD_HIER_ARENA"
 
 DEFAULT_SHM_RING_BYTES = 4 << 20
 DEFAULT_SHM_SLOT_BYTES = 16 << 20
@@ -196,6 +204,14 @@ WIRE_COMPRESSION_MIN_BYTES = "HOROVOD_WIRE_COMPRESSION_MIN_BYTES"
 # is coarse; error feedback recovers the mean but per-step noise is
 # real.
 WIRE_COMPRESSION_INT8 = "HOROVOD_WIRE_COMPRESSION_INT8"
+# Codec/wire overlap in the segmented ring (docs/running.md "Wire
+# compression"): 1 (default) encodes segment k+1 and decodes-reduces
+# segment k-1 on bounded single-worker stages while segment k is on
+# the wire, hiding the cast passes behind wire time. 0 restores the
+# serial schedule (encode whole chunk, then recv+decode inline) — the
+# wire bytes and results are bitwise identical either way, so the knob
+# is a purely local A/B switch.
+RING_CODEC_OVERLAP = "HOROVOD_RING_CODEC_OVERLAP"
 
 DEFAULT_WIRE_COMPRESSION_MIN_BYTES = 65536
 
@@ -544,6 +560,12 @@ def wire_compression_int8() -> bool:
     return get_bool(WIRE_COMPRESSION_INT8, False)
 
 
+def ring_codec_overlap() -> bool:
+    """Pipelined codec/wire overlap in the segmented ring (default on).
+    Purely local: flipping it never changes wire bytes or results."""
+    return get_bool(RING_CODEC_OVERLAP, True)
+
+
 def trace_buffer_events() -> int:
     """Flight-recorder ring capacity; 0 disables the tracing plane."""
     return max(get_int(TRACE_BUFFER, DEFAULT_TRACE_BUFFER_EVENTS), 0)
@@ -607,6 +629,16 @@ def hierarchical_mode() -> str:
     HIERARCHICAL_MODE above). Read per call like the ring knobs."""
     v = get_str(HIERARCHICAL_MODE, "auto").lower()
     return v if v in ("slice", "leader", "auto") else "auto"
+
+
+def hier_arena_setting() -> str:
+    """HOROVOD_HIER_ARENA as auto|off (see HIER_ARENA above). Falsey
+    values (0/false/no/off) pin the per-pair rings; anything else —
+    including typos — is auto, because auto still requires the
+    collectively agreed capability bit, so an unknown value can never
+    desync the schedule."""
+    v = get_str(HIER_ARENA, "auto").lower()
+    return "off" if v in ("0", "false", "no", "off") else "auto"
 
 
 def serving_port() -> int:

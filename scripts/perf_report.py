@@ -186,7 +186,10 @@ def _engine_worker():
 
 
 def _hier_worker():
-    """np=4 simulated 2-host x 2-slot hierarchical allreduce."""
+    """np=4 simulated 2-host x 2-slot hierarchical allreduce: the 1MB
+    auto-mode stage (tracking whatever the defaults resolve to) plus
+    `hier_arena_16mb` — the tentpole shape, 16MB fp32 leader mode with
+    the per-host arena intra-host legs pinned on."""
     import numpy as np
 
     import horovod_tpu as hvd
@@ -206,9 +209,32 @@ def _hier_worker():
             hvd.allreduce(x, name="pr.hier", op=hvd.Sum)
         vals.append((time.perf_counter() - t0) / iters)
         hvd.barrier()
+
+    os.environ["HOROVOD_HIERARCHICAL_MODE"] = "leader"
+    os.environ["HOROVOD_HIER_ARENA"] = "auto"
+    iters16 = int(os.environ["PERF_TR_ITERS"])
+    x16 = np.ones(4194304, np.float32)  # 16MB
+    vals16 = []
+    for _ in range(2):
+        hvd.allreduce(x16, name="pr.hier16", op=hvd.Sum)
+    # Fail loudly if the arena legs silently fell back to the per-pair
+    # rings (capability bit not agreed): a rings measurement must never
+    # be archived under the hier_arena label.
+    assert hvd.metrics()["metrics"].get(
+        "horovod_hier_arena_ops_total", 0) > 0, (
+        "hier_arena stage measured the ring fallback — is shm "
+        "writable and are the simulated hosts' slots co-located?")
+    for r in range(rounds):
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters16):
+            hvd.allreduce(x16, name="pr.hier16", op=hvd.Sum)
+        vals16.append((time.perf_counter() - t0) / iters16)
+        hvd.barrier()
     rank = hvd.rank()
     hvd.shutdown()
-    return {"rank": rank, "hier_1mb_s": vals}
+    return {"rank": rank, "hier_1mb_s": vals,
+            "hier_arena_16mb_s": vals16}
 
 
 def _serving_worker():
@@ -315,12 +341,15 @@ def measure(rounds: int, quick: bool) -> dict:
               extra_env=dict(env, HVDRUN_FORCE_LOCAL="1",
                              HOROVOD_TRANSPORT="auto",
                              HOROVOD_HIERARCHICAL_ALLREDUCE="auto"))
-    vals = next(r for r in res if r.get("rank") == 0)["hier_1mb_s"]
-    stages["hier_1mb_ms"] = {
-        "unit": "ms",
-        "rounds": [round(v * 1e3, 4) for v in vals],
-        "value": round(_median(vals) * 1e3, 4),
-    }
+    hier0 = next(r for r in res if r.get("rank") == 0)
+    for key, name in (("hier_1mb_s", "hier_1mb_ms"),
+                      ("hier_arena_16mb_s", "hier_arena_16mb_ms")):
+        vals = hier0[key]
+        stages[name] = {
+            "unit": "ms",
+            "rounds": [round(v * 1e3, 4) for v in vals],
+            "value": round(_median(vals) * 1e3, 4),
+        }
 
     res = run(_serving_worker, np=2, extra_env=env)
     vals = next(r for r in res if r.get("rank") == 0)["serving_rtt_p50_s"]

@@ -265,45 +265,128 @@ def worker_compression():
 def worker_hier():
     """Two-level hierarchical allreduce over a SIMULATED 2-host x
     2-slot topology (distinct HOROVOD_HOSTNAME per host): intra-host
-    legs ride shm, inter-host legs ride tcp, in BOTH cross-schedule
-    modes (slice-parallel and leader). Byte accounting stays exact."""
+    legs ride shm, inter-host legs ride tcp, across every cross
+    schedule — slice-parallel, leader over per-pair rings, leader over
+    the per-HOST arena, and compressed leader-arena. Each leg gets its
+    own per-transport accounting contract:
+
+    * slice / leader_rings: global shm conservation — every ring byte
+      one rank wrote (headers included), its co-located peer consumed;
+    * leader_arena (and its bf16 twin — arena legs ship full-width BY
+      DESIGN, so the closed form is codec-independent): EXACT per-rank
+      shm deltas per op — a member deposits its vector once (C bytes
+      sent) and copies the bcast out (C recv); the leader reads every
+      member's slot while reducing in place ((L-1)·C recv) and makes
+      the bcast deposit (C sent). No shared-result hop, no leader
+      deposit, no copy-out — the leg's whole point;
+    * leader_arena_bf16: `wire_bytes_saved_total{codec="bf16"}` equals
+      the closed-form INTER-HOST savings — the leaders' segmented
+      cross ring sends 2(n_cross-1) chunks of COUNT/n_cross elems per
+      op at 2 bytes saved per elem; members save nothing (their bytes
+      never meet a wire).
+    """
     import numpy as np
 
     import horovod_tpu as hvd
 
     hvd.init()
     n = hvd.size()
+    L = 2                      # launched as 2 hosts x 2 slots
+    is_leader = hvd.rank() % L == 0
     expect_bytes = 0
     os.environ["HOROVOD_RING_THRESHOLD"] = "0"
-    for mode in ("slice", "leader"):
-        os.environ["HOROVOD_HIERARCHICAL_MODE"] = mode
+    c_bytes = COUNT * 4
+
+    def snap():
+        return hvd.metrics()["metrics"]
+
+    def shm(s, d):
+        return s.get('horovod_transport_bytes_total'
+                     f'{{direction="{d}",transport="shm"}}', 0)
+
+    legs = [
+        ("slice", {"HOROVOD_HIERARCHICAL_MODE": "slice",
+                   "HOROVOD_HIER_ARENA": "off"}),
+        ("leader_rings", {"HOROVOD_HIERARCHICAL_MODE": "leader",
+                          "HOROVOD_HIER_ARENA": "off"}),
+        ("leader_arena", {"HOROVOD_HIERARCHICAL_MODE": "leader",
+                          "HOROVOD_HIER_ARENA": "auto"}),
+        ("leader_arena_bf16", {"HOROVOD_HIERARCHICAL_MODE": "leader",
+                               "HOROVOD_HIER_ARENA": "auto",
+                               "HOROVOD_WIRE_COMPRESSION": "bf16",
+                               "HOROVOD_WIRE_COMPRESSION_MIN_BYTES":
+                                   "0"}),
+    ]
+    deltas = {}
+    for name, env in legs:
+        os.environ.update(env)
+        hvd.barrier()
+        before = snap()
         for i in range(ITERS):
+            # rank+1 is exactly representable in bf16, so the
+            # compressed leg's correctness assert needs no tolerance.
             x = np.full(COUNT, float(hvd.rank() + 1), np.float32)
             out = np.asarray(hvd.allreduce(
-                x, name=f"ph.{mode}.{i}", op=hvd.Sum))
-            assert float(out[0]) == sum(range(1, n + 1)), (mode, out[0])
+                x, name=f"ph.{name}.{i}", op=hvd.Sum))
+            assert float(out[0]) == sum(range(1, n + 1)), (name, out[0])
             expect_bytes += x.nbytes
-    hvd.barrier()
-    snap = hvd.metrics()["metrics"]
-    got = snap["horovod_allreduce_bytes_total"]
+        hvd.barrier()
+        after = snap()
+        deltas[name] = {
+            "sent": shm(after, "sent") - shm(before, "sent"),
+            "recv": shm(after, "recv") - shm(before, "recv"),
+            "saved": (after.get(
+                'horovod_wire_bytes_saved_total{codec="bf16"}', 0)
+                - before.get(
+                    'horovod_wire_bytes_saved_total{codec="bf16"}', 0)),
+            "arena_ops": (after.get("horovod_hier_arena_ops_total", 0)
+                          - before.get("horovod_hier_arena_ops_total",
+                                       0)),
+        }
+        os.environ["HOROVOD_WIRE_COMPRESSION"] = "none"
+
+    # Per-pair-ring legs move nothing through the arena — but their
+    # intra-host bytes MUST ride shm (a silent tcp fallback would make
+    # the conservation assert below pass vacuously at 0 == 0).
+    assert deltas["slice"]["arena_ops"] == 0, deltas["slice"]
+    assert deltas["leader_rings"]["arena_ops"] == 0, deltas["leader_rings"]
+    assert deltas["slice"]["sent"] > 0, deltas["slice"]
+    assert deltas["leader_rings"]["sent"] > 0, deltas["leader_rings"]
+    # Arena-legged leader: exact per-rank shm byte accounting (arena
+    # counters carry no frame headers — deposits count as sent,
+    # copy-outs as recv).
+    for name in ("leader_arena", "leader_arena_bf16"):
+        d = deltas[name]
+        assert d["arena_ops"] == ITERS, (name, d)
+        want_sent = ITERS * c_bytes
+        want_recv = ITERS * ((L - 1) * c_bytes if is_leader else c_bytes)
+        assert d["sent"] == want_sent, (name, d, want_sent)
+        assert d["recv"] == want_recv, (name, d, want_recv)
+    # Compressed leg: closed-form INTER-HOST savings only.
+    n_cross = n // L
+    want_saved = (ITERS * 2 * (n_cross - 1) * (COUNT // n_cross) * 2
+                  if is_leader else 0)
+    assert deltas["leader_arena_bf16"]["saved"] == want_saved, (
+        deltas["leader_arena_bf16"], want_saved)
+    assert deltas["leader_arena"]["saved"] == 0, deltas["leader_arena"]
+
+    snap_end = snap()
+    got = snap_end["horovod_allreduce_bytes_total"]
     assert got == expect_bytes, (
         f"allreduce_bytes_total drifted (hier): got {got}, "
         f"expected exactly {expect_bytes}")
-    shm_sent = snap.get(
-        'horovod_transport_bytes_total{direction="sent",transport="shm"}',
-        0)
-    tcp_sent = snap.get(
+    tcp_sent = snap_end.get(
         'horovod_transport_bytes_total{direction="sent",transport="tcp"}',
         0)
-    # Both planes must have carried data: intra-host over shm,
-    # inter-host over tcp.
-    assert shm_sent > 0, "intra-host legs never rode shm"
     assert tcp_sent > 0, "inter-host legs never rode tcp"
     checks = {"rank": hvd.rank(), "bytes": got,
-              "shm_sent": shm_sent,
-              "shm_recv": snap.get(
-                  'horovod_transport_bytes_total'
-                  '{direction="recv",transport="shm"}', 0)}
+              "ring_sent": deltas["slice"]["sent"]
+              + deltas["leader_rings"]["sent"],
+              "ring_recv": deltas["slice"]["recv"]
+              + deltas["leader_rings"]["recv"],
+              "arena_sent": deltas["leader_arena"]["sent"]
+              + deltas["leader_arena_bf16"]["sent"],
+              "saved": deltas["leader_arena_bf16"]["saved"]}
     hvd.shutdown()
     return checks
 
@@ -376,8 +459,11 @@ def main():
     assert len(hier_results) == 4, hier_results
     assert all(r["bytes"] == hier_results[0]["bytes"]
                for r in hier_results), hier_results
-    assert (sum(r["shm_sent"] for r in hier_results)
-            == sum(r["shm_recv"] for r in hier_results)), hier_results
+    # Ring-legged legs conserve shm bytes globally; the arena legs'
+    # exact (deliberately non-conserving) closed form was asserted
+    # per rank inside the worker.
+    assert (sum(r["ring_sent"] for r in hier_results)
+            == sum(r["ring_recv"] for r in hier_results)), hier_results
     print("perf smoke OK (hier):", hier_results)
     print(json.dumps({
         "metric": "perf_smoke",
@@ -385,6 +471,7 @@ def main():
         "shm_bytes": shm_results[0]["bytes"],
         "shm_conserved": total_sent,
         "hier_bytes": hier_results[0]["bytes"],
+        "hier_wire_saved": sum(r["saved"] for r in hier_results),
     }))
 
 

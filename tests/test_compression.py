@@ -457,6 +457,245 @@ def test_arena_compressed_deposits(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# zero-redundancy first hop + pipelined codec/wire overlap
+
+
+def test_first_hop_reuse_bitwise_identical(monkeypatch):
+    """A star allreduce fed the engine's pre-encoded first-hop bytes
+    finishes bitwise identical to one that re-encodes — encode is
+    value-deterministic, so shipping the stash IS shipping the
+    re-encode."""
+    monkeypatch.setenv("HOROVOD_CPU_OPERATIONS", "star")
+    rng = np.random.RandomState(7)
+    xs = [rng.rand(777).astype(np.float32) for _ in range(2)]
+
+    def run(reuse):
+        def fn(b, r):
+            stash = BF16.encode(xs[r]) if reuse else None
+            with wire_codec_scope(BF16, first_hop=stash):
+                out = b.allreduce(xs[r].copy(), ReduceOp.SUM)
+                if reuse:
+                    # consume-once: the data plane took it.
+                    from horovod_tpu.backend.base import (
+                        take_first_hop_encoded,
+                    )
+
+                    assert take_first_hop_encoded(stash.nbytes) is None
+                return out
+        (a, bb), errors = _run_pair(fn)
+        assert not any(errors), errors
+        assert np.array_equal(a, bb)
+        return a
+
+    np.testing.assert_array_equal(run(True), run(False))
+
+
+def test_first_hop_stash_size_mismatch_is_ignored():
+    """Defense in depth: a stash whose byte size does not match the
+    buffer being shipped is dropped, never sliced wrong."""
+    from horovod_tpu.backend.base import take_first_hop_encoded
+
+    x = np.ones(64, np.float32)
+    with wire_codec_scope(BF16, first_hop=BF16.encode(x)):
+        assert take_first_hop_encoded(999) is None
+        # consumed by the failed take: a second take sees nothing.
+        assert take_first_hop_encoded(128) is None
+
+
+def test_engine_first_hop_single_encode_count_star():
+    """Acceptance proof (ISSUE 14): exactly ONE encode pass per
+    compressed op on the first hop. Every encode site observes into
+    horovod_compression_seconds{phase="encode"}, so the observation
+    COUNT is the pass count: on the star path a worker pays only the
+    engine's error-feedback encode (1/op — the gather ships the stash),
+    and the root pays the engine's plus its result-broadcast re-encode
+    (2/op). A re-encoding first hop would read 2/op on the worker."""
+    iters = 4
+    regs = [telemetry.MetricsRegistry() for _ in range(2)]
+
+    def fn(eng, r):
+        outs = []
+        for i in range(iters):
+            h = eng.enqueue_allreduce(
+                np.full(300, float(r + 1), np.float32), name="t")
+            outs.append(eng.synchronize(h, timeout=30))
+        return outs
+
+    results, engines, regs = _run_engines(
+        2, fn, dict(_CMP_ENV, HOROVOD_CPU_OPERATIONS="star"),
+        registries=regs)
+    key = 'horovod_compression_seconds{phase="encode"}_count'
+    assert regs[0].scalars().get(key, 0) == 2 * iters  # root
+    assert regs[1].scalars().get(key, 0) == 1 * iters  # worker
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+
+
+def test_engine_first_hop_single_encode_count_ring(monkeypatch):
+    """Ring closed form at np=2: the engine's EF encode (1) + the
+    allgather owner projection (1, whose bytes step 0 ships — the old
+    separate step-0 re-encode is gone) = exactly 2/op per rank; the
+    reduce-scatter's only step ships the engine's stash."""
+    iters = 3
+    regs = [telemetry.MetricsRegistry() for _ in range(2)]
+
+    def fn(eng, r):
+        outs = []
+        for i in range(iters):
+            h = eng.enqueue_allreduce(
+                np.full(5000, float(r + 1), np.float32), name="t")
+            outs.append(eng.synchronize(h, timeout=30))
+        return outs
+
+    results, engines, regs = _run_engines(
+        2, fn, dict(_CMP_ENV, HOROVOD_RING_THRESHOLD="0",
+                    HOROVOD_RING_SEGMENT_BYTES="0"),
+        registries=regs)
+    key = 'horovod_compression_seconds{phase="encode"}_count'
+    for r in (0, 1):
+        assert regs[r].scalars().get(key, 0) == 2 * iters, (
+            r, regs[r].scalars().get(key, 0))
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+
+
+def test_arena_first_hop_deposit(tmp_path):
+    """The whole-world arena's encoded deposits slice the engine's
+    first-hop bytes: zero encode observations, exact byte-savings
+    accounting, full-width results bitwise identical to the
+    recomputed-encode run, and deposit/copy-out conservation (sent =
+    encoded bytes, recv = full-width bytes)."""
+    from horovod_tpu.backend.shm import ShmArena
+
+    inputs = [np.full(5000, (i + 1) / 3.0, np.float32)
+              for i in range(2)]
+    expect = BF16.roundtrip(inputs[0]) + BF16.roundtrip(inputs[1])
+
+    def run(reuse, tag):
+        arenas = [ShmArena(str(tmp_path / tag), i, 2, 1 << 16)
+                  for i in range(2)]
+        reg = telemetry.MetricsRegistry()
+        sent = reg.counter("sent", "")
+        recv = reg.counter("recv", "")
+        for a in arenas:
+            a.m_sent, a.m_recv = sent, recv
+        stats = C.CompressionStats(telemetry.MetricsRegistry())
+        outs = [np.empty_like(inputs[i]) for i in range(2)]
+        errors = [None, None]
+
+        def worker(i):
+            try:
+                fh = BF16.encode(inputs[i]) if reuse else None
+                arenas[i].allreduce_into(
+                    inputs[i], lambda d, s: np.add(d, s, out=d),
+                    out=outs[i], codec=BF16, stats=stats, first_hop=fh)
+            except BaseException as ex:  # noqa: BLE001
+                errors[i] = ex
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(errors), errors
+        for a in arenas:
+            a.close()
+        return outs, stats, sent.value, recv.value
+
+    outs, stats, sent, recv = run(True, "a1")
+    assert np.array_equal(outs[0], outs[1])
+    np.testing.assert_allclose(outs[0], expect, rtol=0, atol=0)
+    # no encode pass ran in the arena; savings still counted exactly
+    snap = stats._seconds
+    assert "encode" not in snap
+    assert stats.saved_snapshot()["bf16"] == 2 * inputs[0].nbytes // 2
+    # conservation: sent counts encoded deposits, recv full-width outs
+    assert sent == 2 * inputs[0].nbytes // 2
+    assert recv == 2 * inputs[0].nbytes
+    outs2, stats2, _, _ = run(False, "a2")
+    np.testing.assert_array_equal(outs[0], outs2[0])
+    assert "encode" in stats2._seconds  # the recompute arm DID encode
+
+
+@pytest.mark.parametrize("nranks", [2, 3, 4])
+def test_pipelined_ring_bitwise_vs_serial(nranks, monkeypatch):
+    """HOROVOD_RING_CODEC_OVERLAP moves codec passes onto bounded
+    worker stages without changing a single wire byte: results are
+    bitwise identical to the serial schedule and bitwise identical
+    across ranks, segments and remainders included."""
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "0")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "256")
+
+    def run(nr, overlap):
+        monkeypatch.setenv("HOROVOD_RING_CODEC_OVERLAP",
+                           "1" if overlap else "0")
+        from horovod_tpu.backend.transport import make_inproc_backends
+
+        backends = make_inproc_backends(nr)
+        results = [None] * nr
+        errors = [None] * nr
+
+        def worker(r):
+            try:
+                rng = np.random.RandomState(r)
+                x = rng.rand(5003).astype(np.float32)
+                with channel_scope(1), wire_codec_scope(BF16):
+                    results[r] = backends[r].allreduce(x, ReduceOp.SUM)
+            except BaseException as e:  # noqa: BLE001
+                errors[r] = e
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(nr)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for b in backends:
+            b.shutdown()
+        assert not any(errors), errors
+        return results
+
+    serial = run(nranks, False)
+    over = run(nranks, True)
+    for r in range(nranks):
+        assert np.array_equal(serial[0], serial[r])
+        assert np.array_equal(over[0], over[r])
+    assert np.array_equal(serial[0], over[0])
+
+
+def test_pipeline_stage_fifo_and_error_propagation():
+    """The bounded single-worker stage runs jobs strictly FIFO and
+    parks a job's exception in its future (later jobs still run)."""
+    from horovod_tpu.common.compression import PipelineStage
+
+    seen = []
+    with PipelineStage("t", depth=2) as stage:
+        futs = [stage.submit(lambda i=i: seen.append(i) or i)
+                for i in range(8)]
+        assert [f.result() for f in futs] == list(range(8))
+        assert seen == list(range(8))
+
+        def boom():
+            raise ValueError("job failed")
+
+        bad = stage.submit(boom)
+        good = stage.submit(lambda: "after")
+        with pytest.raises(ValueError, match="job failed"):
+            bad.result()
+        assert good.result() == "after"
+
+
+def test_ring_codec_overlap_parse(monkeypatch):
+    from horovod_tpu.utils import env as env_cfg
+
+    monkeypatch.delenv("HOROVOD_RING_CODEC_OVERLAP", raising=False)
+    assert env_cfg.ring_codec_overlap() is True
+    monkeypatch.setenv("HOROVOD_RING_CODEC_OVERLAP", "0")
+    assert env_cfg.ring_codec_overlap() is False
+    monkeypatch.setenv("HOROVOD_RING_CODEC_OVERLAP", "1")
+    assert env_cfg.ring_codec_overlap() is True
+
+
+# ---------------------------------------------------------------------------
 # engine integration: negotiated codec, cache replay, residuals
 
 def _run_engines(size, fn, env, registries=None):

@@ -6,6 +6,7 @@ parameter_manager.h:163-228 — hierarchical/cache categorical tuning.)
 """
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -420,6 +421,317 @@ def test_leader_hierarchical_tiny_and_average(n, monkeypatch):
     results = _run_backend_ranks(4, _topo_2x2, fn)
     for r in range(4):
         np.testing.assert_allclose(results[r], 2.5)
+
+
+# ---------------------------------------------------------------------------
+# host-scoped arena legs for the leader schedule (HOROVOD_HIER_ARENA):
+# fused gather-reduce to the leader + overlapped bcast through the
+# per-host shm arena instead of the per-pair rings.
+
+def _arena_backends(size, L, tmp_path, slot_bytes=4096):
+    """ThreadedGroup backends with per-host ShmArenaSets attached and
+    the (normally engine-agreed) arena capability bit set — the same
+    hand-wiring the other backend-level tests use for toggles."""
+    from horovod_tpu.backend.shm import ShmArenaSet
+
+    group = ThreadedGroup(size)
+    backends = []
+    for r in range(size):
+        b = group.backend(r)
+        b.set_topology(r % L, L, r // L, size // L)
+        b.hierarchical = True
+        b.arena_hier_ok = True
+        host = r // L
+        local_group = list(range(host * L, host * L + L))
+        b.arena_set = ShmArenaSet(
+            str(tmp_path), "t", "n0", group=local_group, rank=r,
+            slot_bytes=slot_bytes)
+        backends.append(b)
+    return backends
+
+
+def _run_ranks(backends, fn, timeout=60):
+    size = len(backends)
+    results = [None] * size
+    errors = [None] * size
+
+    def worker(r):
+        try:
+            results[r] = fn(backends[r], r)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    return results, errors
+
+
+@pytest.mark.parametrize("size,L", [(4, 2), (6, 3), (8, 2), (8, 4)])
+@pytest.mark.parametrize("n", [4099, 5])
+def test_leader_arena_matches_sum(size, L, n, monkeypatch, tmp_path):
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    monkeypatch.delenv("HOROVOD_HIER_ARENA", raising=False)
+    monkeypatch.delenv("HOROVOD_TRANSPORT", raising=False)
+    backends = _arena_backends(size, L, tmp_path)
+
+    def fn(b, r):
+        arr = np.arange(n, dtype=np.float64) + r * 10.0
+        return b._hierarchical_allreduce(arr, ReduceOp.SUM)
+
+    results, errors = _run_ranks(backends, fn)
+    for e in errors:
+        if e is not None:
+            raise e
+    want = (np.arange(n, dtype=np.float64) * size
+            + 10.0 * sum(range(size)))
+    for r in range(size):
+        np.testing.assert_allclose(results[r], want)
+    # The legs really rode the arena (not a silent ring fallback).
+    arenas = backends[0].arena_set._arenas
+    assert arenas and all(a._gen > 0 for a in arenas.values())
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 5])
+def test_leader_arena_tiny_and_average(n, monkeypatch, tmp_path):
+    """Element counts below the group size exercise empty chunks and
+    empty segment ranges on both the deposit and replay sides — the
+    range sequences must agree or the session deadlocks."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    backends = _arena_backends(4, 2, tmp_path)
+
+    def fn(b, r):
+        return b._hierarchical_allreduce(
+            np.full(n, float(r + 1)), ReduceOp.AVERAGE)
+
+    results, errors = _run_ranks(backends, fn)
+    for e in errors:
+        if e is not None:
+            raise e
+    for r in range(4):
+        np.testing.assert_allclose(results[r], np.full(n, 2.5))
+
+
+def test_leader_arena_input_never_mutated(monkeypatch, tmp_path):
+    """The arena legs read the input and write a separate output, so a
+    caller-owned tensor survives unmutated — the defensive copy the
+    ring schedules must take disappears here (like the whole-world
+    arena plane)."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "256")
+    backends = _arena_backends(4, 2, tmp_path)
+    inputs = [np.arange(1000, dtype=np.float32) + r for r in range(4)]
+    keep = [a.copy() for a in inputs]
+
+    def fn(b, r):
+        return b._hierarchical_allreduce(inputs[r], ReduceOp.SUM,
+                                         owned=False)
+
+    results, errors = _run_ranks(backends, fn)
+    for e in errors:
+        if e is not None:
+            raise e
+    want = sum(inputs)
+    for r in range(4):
+        np.testing.assert_allclose(results[r], want)
+        np.testing.assert_array_equal(inputs[r], keep[r])
+
+
+def test_leader_arena_bitwise_under_compression(monkeypatch, tmp_path):
+    """Compressed leader-arena schedule: the inter-host ring narrows to
+    bf16 (with the allgather grid projection), the arena legs stay
+    full-width memcpys — every rank must finish BITWISE identical."""
+    from horovod_tpu.backend.base import wire_codec_scope
+    from horovod_tpu.common import compression as C
+
+    bf16 = C.codec_by_name("bf16")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    monkeypatch.setenv("HOROVOD_RING_SEGMENT_BYTES", "256")
+    backends = _arena_backends(4, 2, tmp_path)
+
+    def fn(b, r):
+        rng = np.random.RandomState(r)
+        x = rng.rand(3001).astype(np.float32)
+        with wire_codec_scope(bf16):
+            return b._hierarchical_allreduce(x, ReduceOp.SUM)
+
+    results, errors = _run_ranks(backends, fn)
+    for e in errors:
+        if e is not None:
+            raise e
+    for r in range(1, 4):
+        assert np.array_equal(results[0], results[r]), (
+            f"rank {r} diverged under compression")
+
+
+def test_leader_arena_wedged_leader_raises_verdict(monkeypatch, tmp_path):
+    """Chaos contract (docs/fault_tolerance.md): a host leader wedged
+    mid-arena-leg parks its members on arena barriers and its peer
+    leader in the inter-host ring; when the liveness verdict lands
+    (dead_cb / declare_dead — heartbeats ride TCP), EVERY survivor
+    raises the attributed TransportError promptly — no parked arena
+    barrier outlives the verdict."""
+    from horovod_tpu.backend.transport import make_inproc_backends
+    from horovod_tpu.backend.shm import ShmArenaSet
+    from horovod_tpu.common.exceptions import TransportError
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    verdict = {"reason": None}
+    size, L = 4, 2
+    backends = make_inproc_backends(size)
+    for r in range(size):
+        b = backends[r]
+        b.set_topology(r % L, L, r // L, size // L)
+        b.hierarchical = True
+        b.arena_hier_ok = True
+        host = r // L
+        local_group = list(range(host * L, host * L + L))
+        b.arena_set = ShmArenaSet(
+            str(tmp_path), "t", "n0", group=local_group, rank=r,
+            slot_bytes=4096)
+        b.arena_set.dead_cb = lambda: verdict["reason"]
+        b._arena_dead_reason = lambda: verdict["reason"]
+
+    errors = [None] * size
+
+    def worker(r):
+        if r == 0:
+            return  # the wedged leader: never enters the collective
+        try:
+            backends[r]._hierarchical_allreduce(
+                np.ones(100000, np.float32), ReduceOp.SUM)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(1, size)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    reason = ("rank 0 (host hostA) declared dead by rank 1: "
+              "no heartbeat for 2.0s")
+    verdict["reason"] = reason
+    for r in range(1, size):
+        backends[r].declare_dead(0, reason)
+    for t in threads:
+        t.join(timeout=15)
+    assert not any(t.is_alive() for t in threads), (
+        "a survivor's arena barrier outlived the verdict")
+    for r in range(1, size):
+        assert errors[r] is not None, f"rank {r} did not raise"
+        assert isinstance(errors[r], TransportError), errors[r]
+        assert reason in str(errors[r]), (r, errors[r])
+    for b in backends:
+        b.shutdown()
+
+
+def test_host_arena_gating(monkeypatch, tmp_path):
+    """_host_arena engages only with the agreed capability bit, an
+    exactly-matching group, and per-call knobs still routing intra-host
+    data to shared memory."""
+    from horovod_tpu.backend.shm import ShmArenaSet
+
+    monkeypatch.delenv("HOROVOD_HIER_ARENA", raising=False)
+    monkeypatch.delenv("HOROVOD_TRANSPORT", raising=False)
+    backends = _arena_backends(4, 2, tmp_path)
+    b = backends[0]
+    assert b._host_arena([0, 1]) is b.arena_set
+    assert b._host_arena([0, 1, 2]) is None       # group mismatch
+    b.arena_hier_ok = False
+    assert b._host_arena([0, 1]) is None          # no agreed bit
+    b.arena_hier_ok = True
+    monkeypatch.setenv("HOROVOD_HIER_ARENA", "off")
+    assert b._host_arena([0, 1]) is None          # legs pinned off
+    monkeypatch.setenv("HOROVOD_HIER_ARENA", "auto")
+    monkeypatch.setenv("HOROVOD_TRANSPORT", "tcp")
+    assert b._host_arena([0, 1]) is None          # shm routed off
+    monkeypatch.setenv("HOROVOD_TRANSPORT", "auto")
+    assert b._host_arena([0, 1]) is b.arena_set
+
+
+def test_engine_leader_arena_end_to_end(monkeypatch, tmp_path):
+    """4 engines, 2x2 topology, injected host arenas + a local arena
+    vote: the engine's AND-agreed capability word sets arena_hier_ok on
+    every rank, and the negotiated leader-mode path produces correct
+    sums over the arena legs."""
+    from horovod_tpu.backend.shm import ShmArenaSet
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_MODE", "leader")
+    monkeypatch.setenv("HOROVOD_RING_THRESHOLD", "64")
+    monkeypatch.delenv("HOROVOD_HIER_ARENA", raising=False)
+    group = ThreadedGroup(4)
+    engines = []
+    for r in range(4):
+        b = group.backend(r)
+        host = r // 2
+        b.arena_set = ShmArenaSet(
+            str(tmp_path), "t", "n0",
+            group=[host * 2, host * 2 + 1], rank=r, slot_bytes=4096)
+        b.prefers_arena_hierarchy = lambda: True
+        e = Engine(rank=r, size=4, backend=b,
+                   local_rank=r % 2, local_size=2,
+                   cross_rank=r // 2, cross_size=2)
+        e.cycle_time_s = 0.001
+        engines.append(e)
+    for e in engines:
+        e.start()
+    results = [None] * 4
+    errors = [None] * 4
+
+    def worker(r):
+        try:
+            eng = engines[r]
+            outs = []
+            for i in range(3):
+                h = eng.enqueue_allreduce(
+                    np.full(300, float(r + 1), np.float32), name=f"a{i}")
+                outs.append(eng.synchronize(h, timeout=30))
+            results[r] = outs
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for e in engines:
+        assert e.backend.arena_hier_ok, "capability bit not agreed"
+    arenas = engines[0].backend.arena_set._arenas
+    assert arenas and all(a._gen > 0 for a in arenas.values()), (
+        "arena legs never ran through the engine")
+    stop = [threading.Thread(target=e.shutdown) for e in engines]
+    for t in stop:
+        t.start()
+    for t in stop:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    for r in range(4):
+        for o in results[r]:
+            np.testing.assert_allclose(o, np.full(300, 10.0))
+
+
+def test_hier_arena_setting_parse(monkeypatch):
+    from horovod_tpu.utils import env as env_cfg
+
+    monkeypatch.delenv("HOROVOD_HIER_ARENA", raising=False)
+    monkeypatch.delenv("HVD_TPU_HIER_ARENA", raising=False)
+    assert env_cfg.hier_arena_setting() == "auto"
+    for v, want in [("off", "off"), ("0", "off"), ("false", "off"),
+                    ("no", "off"), ("auto", "auto"), ("1", "auto"),
+                    ("bogus", "auto")]:
+        monkeypatch.setenv("HOROVOD_HIER_ARENA", v)
+        assert env_cfg.hier_arena_setting() == want, v
+    monkeypatch.delenv("HOROVOD_HIER_ARENA", raising=False)
+    monkeypatch.setenv("HVD_TPU_HIER_ARENA", "off")
+    assert env_cfg.hier_arena_setting() == "off"
 
 
 def test_hierarchical_mode_resolution(monkeypatch):
