@@ -91,11 +91,9 @@ def _force_cpu(n_devices: int):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import jax.extend.backend as _jeb
+    from horovod_tpu.utils.compat import force_host_device_count
 
-    _jeb.clear_backends()
-    jax.config.update("jax_num_cpu_devices", n_devices)
-    _jeb.clear_backends()
+    force_host_device_count(n_devices)
 
 
 def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
@@ -115,7 +113,16 @@ def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
     if mesh is None:
         mesh = create_mesh({"dp": n_chips})
     spec = get_model(model_name)
-    model = spec.make_model(**(model_kw or {}))
+    model_kw = dict(model_kw or {})
+    if spec.kind in ("lm", "encoder"):
+        # The bench path opts INTO bf16 logits (the measured config:
+        # 6.0 ms of a 98 ms GPT-2 step on v5e, docs/benchmarks.md r5).
+        # The library default stays f32 — external logits consumers
+        # keep full precision unless they ask otherwise (ADVICE r14).
+        import jax.numpy as jnp
+
+        model_kw.setdefault("logits_dtype", jnp.bfloat16)
+    model = spec.make_model(**model_kw)
     rng = np.random.RandomState(42)
     global_batch = batch_per_chip * n_chips
     if spec.kind == "image":

@@ -422,6 +422,139 @@ def _bench_hier(hvd, np, args):
     }
 
 
+# The traced-vs-eager benchmark pytree: ~2.36M params (>= the 1M-param
+# acceptance shape), transformer-ish layer blocks with biases. ONE
+# definition — scripts/perf_report.py imports it so its traced stages
+# measure the same shape this microbench and docs/running.md describe.
+GRAD_TREE_SHAPES = [(256, 1024), (1024,), (1024, 1024), (1024,),
+                    (1024, 512), (512,), (512, 1024), (1024,)]
+
+
+def _make_grad_tree(np, scale=1.0):
+    rng = np.random.RandomState(0)
+    return {f"layer{i}": (rng.randn(*s) * scale).astype(np.float32)
+            for i, s in enumerate(GRAD_TREE_SHAPES)}
+
+
+def build_traced_exchange(np, leaves):
+    """The traced arm, shared by `--mode traced` and
+    scripts/perf_report.py so both published numbers measure the SAME
+    harness: a jitted shard_map grouped-psum AVERAGE over a local
+    2-device mesh, per-device distinct grads via a stacked leading
+    dim. Returns a zero-arg callable running one compiled exchange
+    (compile + warmup happen here, outside any timed loop)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel.mesh import create_mesh
+    from horovod_tpu.utils.compat import shard_map
+
+    assert len(jax.devices()) >= 2, (
+        "the traced arm needs >= 2 local devices — force them with "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=2 before "
+        "jax's backend is created")
+    mesh = create_mesh({"hvd": 2}, devices=jax.devices()[:2])
+    stacked = [jnp.asarray(np.stack([v * (d + 1) for d in range(2)]))
+               for v in leaves]
+
+    def step(*xs):
+        local = [jnp.squeeze(x, 0) for x in xs]
+        return tuple(hvd.grouped_allreduce(local, op=hvd.Average))
+
+    compiled = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=tuple(P("hvd") for _ in leaves),
+        out_specs=tuple(P() for _ in leaves)))
+    jax.block_until_ready(compiled(*stacked))  # compile outside timing
+    return lambda: jax.block_until_ready(compiled(*stacked))
+
+
+def _bench_traced(hvd, np, args):
+    """Traced-vs-eager gradient-exchange acceptance measurement
+    (docs/running.md "Traced collectives"): order-alternated paired
+    rounds of the SAME pytree exchange, once through the eager engine
+    (grouped allreduce, steady names, all ranks driving), once through
+    the traced/XLA plane (a jitted shard_map grouped psum over rank 0's
+    local 2-device mesh — single-controller, so only rank 0 drives it
+    while the peers hold at the barrier). Both arms land in ONE JSON.
+
+    Honest caveat (the PR 4/11 precedent): on this loopback container
+    the traced arm's "wire" is an XLA all-reduce over two host buffers
+    — it measures the DISPATCH cost floor, not the ICI win; and the two
+    arms load the box differently (engine: both ranks + negotiation
+    threads; traced: rank 0's XLA threads). The dispatch correctness
+    (zero engine data-plane bytes — asserted in perf_smoke) is the
+    acceptance gate, not this ratio."""
+    assert hvd.size() == 2, (
+        "traced mode is a PAIRED np=2 comparison (the traced arm is a "
+        "2-device local mesh); launch with hvdrun -np 2 — at other "
+        "sizes the two arms would do different amounts of work and the "
+        "ratio would be meaningless")
+    r = hvd.rank()
+    tree = _make_grad_tree(np)
+    leaves = list(tree.values())
+    param_count = sum(int(v.size) for v in leaves)
+
+    def eager_once(i):
+        hvd.grouped_allreduce(leaves, name="tr.eager", op=hvd.Average)
+
+    def timed_eager():
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for i in range(args.traced_iters):
+            eager_once(i)
+        dt = (time.perf_counter() - t0) / args.traced_iters
+        hvd.barrier()
+        return dt
+
+    # Traced arm: rank 0's local 2-device mesh (devices forced in
+    # main() before jax loaded), the shared harness — same world size
+    # as the eager arm.
+    run_traced = build_traced_exchange(np, leaves) if r == 0 else None
+
+    def timed_traced():
+        hvd.barrier()
+        dt = 0.0
+        if r == 0:
+            t0 = time.perf_counter()
+            for _ in range(args.traced_iters):
+                run_traced()
+            dt = (time.perf_counter() - t0) / args.traced_iters
+        hvd.barrier()
+        return dt
+
+    timed_eager()  # warmup: negotiate the steady name
+    timed_traced()
+    pairs = []
+    for rd in range(args.traced_rounds):
+        if rd % 2 == 0:
+            a = timed_eager()
+            b = timed_traced()
+        else:
+            b = timed_traced()
+            a = timed_eager()
+        pairs.append((a, b))
+    if r != 0:
+        return None
+    ratios = sorted(a / b for a, b in pairs)
+    return {
+        "param_count": param_count,
+        "tensors": len(leaves),
+        "bytes": int(sum(v.nbytes for v in leaves)),
+        "iters": args.traced_iters,
+        "pairs_ms": [[round(a * 1e3, 2), round(b * 1e3, 2)]
+                     for a, b in pairs],
+        "eager_ms_median": round(_percentile(
+            sorted(a for a, _ in pairs), 0.5) * 1e3, 2),
+        "traced_ms_median": round(_percentile(
+            sorted(b for _, b in pairs), 0.5) * 1e3, 2),
+        "ratios": [round(v, 3) for v in ratios],
+        "median_speedup": round(_percentile(ratios, 0.5), 3),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="16384,262144,4194304",
@@ -440,7 +573,7 @@ def main():
                         "ring (default: the library default)")
     p.add_argument("--mode",
                    choices=["bw", "latency", "pipeline", "transport",
-                            "compression", "hier"],
+                            "compression", "hier", "traced"],
                    default="bw",
                    help="bw: the throughput sweep (default); latency: "
                         "small-op p50/p99 enqueue-to-complete, 1-vs-N "
@@ -454,7 +587,11 @@ def main():
                         "hierarchical allreduce with the intra-host "
                         "legs flipped per-pair-rings vs per-host-arena "
                         "(needs a multi-host launch, e.g. simulated "
-                        "-H hostA:2,hostB:2 with HVDRUN_FORCE_LOCAL=1)")
+                        "-H hostA:2,hostB:2 with HVDRUN_FORCE_LOCAL=1); "
+                        "traced: eager-engine vs traced-jit gradient "
+                        "exchange on the same >=1M-param pytree, "
+                        "order-alternated paired rounds (launch with "
+                        "hvdrun -np 2)")
     p.add_argument("--channels", type=int, default=2,
                    help="the N in the 1-vs-N channel comparisons")
     p.add_argument("--lat-count", type=int, default=16384,
@@ -482,7 +619,27 @@ def main():
                    help="allreduces per timed arm in hier mode")
     p.add_argument("--hier-rounds", type=int, default=5,
                    help="rings/arena paired rounds in hier mode")
+    p.add_argument("--traced-iters", type=int, default=5,
+                   help="exchanges per timed arm in traced mode")
+    p.add_argument("--traced-rounds", type=int, default=5,
+                   help="eager/traced paired rounds in traced mode")
     args = p.parse_args()
+
+    if args.mode == "traced":
+        # The traced arm needs a >= 2-device local mesh on rank 0; the
+        # flag must be set before jax's backend is created (lazy, so
+        # before the horovod_tpu import below touches jax). An existing
+        # count is OVERRIDDEN — a stale =1 exported by an earlier run
+        # would silently starve the mesh (the same override semantics
+        # as compat.force_host_device_count, inlined because nothing
+        # of jax may load before the env is set here).
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", os.environ.get("XLA_FLAGS", "")).strip()
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
 
     if args.mode == "hier":
         # Overlay + arena establishment and the capability agreement
@@ -575,6 +732,22 @@ def main():
                       f"arena {s['arena_ms_median']}ms)")
             print(json.dumps(dict(
                 {"metric": "eager_allreduce_hier", "np": n}, **summary)))
+        return
+
+    if args.mode == "traced":
+        summary = _bench_traced(hvd, np, args)
+        if r == 0:
+            print(f"traced paired rounds (ms, eager-engine vs "
+                  f"traced-jit): {summary['pairs_ms']}")
+            print(f"median speedup traced vs eager: "
+                  f"{summary['median_speedup']}x  "
+                  f"(eager {summary['eager_ms_median']}ms -> "
+                  f"traced {summary['traced_ms_median']}ms, "
+                  f"{summary['param_count']} params / "
+                  f"{summary['tensors']} tensors)")
+            print(json.dumps(dict(
+                {"metric": "allreduce_traced_vs_eager", "np": n},
+                **summary)))
         return
 
     if args.mode == "pipeline":

@@ -71,6 +71,7 @@ from .ops import (
     join,
     barrier,
     poll,
+    resolve_axis,
     synchronize,
 )
 from .common.goodput import step

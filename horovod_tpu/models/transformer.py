@@ -64,15 +64,18 @@ class TransformerConfig:
     remat: bool = False
     scan_layers: bool = False
     logits_via_embedding: bool = False
-    # Output logits dtype. bf16 (the compute dtype) is the TPU-first
-    # default: the (B, S, V) logits tensor is the largest activation in
-    # the model (1.65 GB in f32 at the GPT-2 bench shape) and every
-    # loss in this repo upcasts to f32 *inside* its softmax reduction
-    # (parallel/train.py softmax_xent), so emitting f32 here only
-    # doubles the HBM traffic of the lm-head region — measured 6.0 ms
-    # of a 98 ms step on v5e (docs/benchmarks.md, r5). Set
-    # jnp.float32 to hand downstream consumers full-precision logits.
-    logits_dtype: Dtype = jnp.bfloat16
+    # Output logits dtype. f32 is the DEFAULT: model.apply logits are a
+    # public surface (sampling, logprob extraction, custom losses), and
+    # silently narrowing them costs external consumers precision
+    # (ADVICE r14). The measured bench/train paths OPT INTO bf16
+    # explicitly (bench.py, examples/jax_gpt2_train.py): the (B, S, V)
+    # logits tensor is the largest activation in the model (1.65 GB in
+    # f32 at the GPT-2 bench shape) and every loss in this repo upcasts
+    # to f32 *inside* its softmax reduction (parallel/train.py
+    # softmax_xent), so emitting bf16 there saves the lm-head region's
+    # HBM traffic — measured 6.0 ms of a 98 ms step on v5e
+    # (docs/benchmarks.md, r5) — without changing the loss numerics.
+    logits_dtype: Dtype = jnp.float32
     # Learned (gpt2/bert/vit) vs fixed sinusoidal positions.
     learned_pos: bool = True
     # Attention implementation: "dense", or the sequence-parallel kernels

@@ -1,30 +1,60 @@
 """Public collective-op API: hvd.allreduce / allgather / broadcast /
 alltoall / join / barrier (+ async variants).
 
-Dispatch (TPU-first design):
+Dispatch (TPU-first design — ONE API across both data planes, ROADMAP
+item 2 / docs/running.md "Traced collectives"):
 
-* **Traced inputs** (jax tracers inside jit/shard_map): lower directly to
-  XLA collectives over the bound mesh axis (ops/traced.py) — the hot
-  path; zero host involvement.
+* **Traced inputs with a resolvable mesh axis** (jax tracers inside
+  jit/pjit/shard_map where `resolve_axis` finds a bound named axis):
+  lower directly to XLA collectives over that axis (ops/traced.py) —
+  the hot path; gradients never leave the device, XLA fuses and
+  overlaps the collectives with the backward pass, and zero bytes ride
+  the host engine.
+* **Traced inputs, no bound axis, mesh mode** (plain jit/pjit over a
+  GSPMD mesh): arrays are global, so collectives take their closed
+  forms (sum = x·size, gather = tile, bcast = identity) and XLA derives
+  the real wire collectives from the array shardings instead.
 * **Concrete inputs, process mode**: the asynchronous name-negotiated
   engine (ref: horovod/torch/mpi_ops.py:83-219 handle API).
-* **Concrete inputs, mesh mode** (single-controller SPMD): in a single-
-  controller program every "rank" holds the same logical value, so
-  collectives have closed forms (sum = x·size, gather = tile, bcast =
-  identity). This keeps unmodified single-process scripts correct before
-  they are scaled out — the same property `horovodrun -np 1` has in the
-  reference.
+* **Concrete inputs, mesh mode** (single-controller SPMD): the same
+  closed forms — every "rank" of a single-controller program holds the
+  same logical value. This keeps unmodified single-process scripts
+  correct before they are scaled out — the same property
+  `horovodrun -np 1` has in the reference.
+
+The axis-resolution rule (`resolve_axis`) is collectively consistent by
+construction: it reads only trace state and process-wide configuration
+that the launcher propagates identically to every rank, never per-rank
+state — so the same script takes the same dispatch branch on every rank
+whether it runs in mesh mode or under `hvdrun`.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common import basics
+from ..common import basics, telemetry
 from ..common.exceptions import HorovodInternalError
 from ..common.types import ReduceOp
 from . import traced as _traced
+
+# Canonical data-parallel axis names tried (in order) when no explicit
+# axis_name= is given and the init axis is not bound: the parallel/
+# mesh convention's dp axis, then the default 1-D mesh axis.
+_DATA_AXIS_CANDIDATES = ("dp", "hvd")
+
+# Traced dispatches, counted HOST-SIDE AT TRACE TIME (once per traced
+# call site per compilation, not once per executed step — XLA owns the
+# compiled program's wire, so there is no per-step host hook; see
+# docs/metrics.md). Nonzero means the XLA plane was engaged.
+_TRACED_OPS_HELP = ("Collective dispatches lowered to XLA collectives "
+                    "(counted at trace time, labeled by op)")
+
+
+def _count_traced(op: str):
+    telemetry.counter("horovod_traced_ops_total", _TRACED_OPS_HELP,
+                      labels={"op": op}).inc()
 
 
 def _is_tracer(x) -> bool:
@@ -36,36 +66,76 @@ def _is_tracer(x) -> bool:
         return False
 
 
-def _axis_bound(name: str) -> bool:
-    """True when `name` is a live named axis in the current trace
-    (inside shard_map/pmap). Under plain jit/pjit no axis is bound —
-    there, arrays are global and collectives take their closed forms."""
+def _bound_axes() -> Tuple[str, ...]:
+    """Named axes live in the current trace (inside shard_map/pmap).
+    Under plain jit/pjit no axis is bound — there, arrays are global
+    and collectives take their closed forms.
+
+    Private-API drift FAILS LOUDLY: silently returning () here would
+    make every hvd.allreduce inside a shard_map body fall to the
+    mesh-mode closed forms on PER-SHARD values — corrupted gradients,
+    no error. A trace-time exception is the correct failure mode."""
     try:
         from jax._src.core import get_axis_env
 
-        return name in get_axis_env().axis_sizes
-    except Exception:  # pragma: no cover — private-API drift
-        return True
+        return tuple(get_axis_env().axis_sizes)
+    except Exception as exc:  # pragma: no cover — private-API drift
+        raise HorovodInternalError(
+            "jax private-API drift: jax._src.core.get_axis_env is "
+            "unavailable, so traced-dispatch axis resolution cannot "
+            "see bound mesh axes — update "
+            "horovod_tpu/ops/__init__.py:_bound_axes for this jax "
+            f"version ({exc!r})"
+        ) from exc
+
+
+def resolve_axis(axis_name=None):
+    """The collectively-consistent axis-resolution rule: which named
+    mesh axis a traced collective reduces over (docs/running.md
+    "Traced collectives").
+
+    1. An explicit ``axis_name=`` argument wins (string, or a tuple of
+       axis names for data sharded over several mesh axes).
+    2. The init axis (``hvd.init`` mesh axis, default "hvd") when it is
+       bound in the current trace.
+    3. The canonical DATA axes — "dp", then "hvd" — when bound. On a
+       2-D data×model mesh (dp×tp / dp×sp / pp×dp...) this picks the
+       data axis ONLY: model-parallel axes (tp/sp/pp/ep) are never
+       gradient-reduction axes, so `DistributedOptimizer` composes with
+       the parallel/ kernels without configuration.
+
+    Returns None when nothing resolves (plain jit, or eager). Only
+    trace state and launcher-propagated config are consulted — never
+    per-rank state — so every rank takes the same branch."""
+    if axis_name is not None:
+        return axis_name
+    bound = _bound_axes()
+    if not bound:
+        return None
+    an = basics.axis_name() if basics.is_initialized() else None
+    if an is not None and an in bound:
+        return an
+    for cand in _DATA_AXIS_CANDIDATES:
+        if cand in bound:
+            return cand
+    return None
 
 
 def _use_traced(x, axis_name: Optional[str]) -> bool:
-    if not _is_tracer(x):
-        return False
-    if axis_name is not None:
-        return True
-    an = basics.axis_name() if basics.is_initialized() else None
-    return an is not None and _axis_bound(an)
+    return _is_tracer(x) and resolve_axis(axis_name) is not None
 
 
-def _axis(axis_name: Optional[str]) -> str:
-    if axis_name is not None:
-        return axis_name
-    an = basics.axis_name()
-    if an is None:
+def _axis(axis_name: Optional[str]):
+    ax = resolve_axis(axis_name)
+    if ax is None:
+        # Callers dispatch here only after _use_traced confirmed a
+        # resolvable axis; failing loudly beats falling back to an
+        # axis that is not bound in the current trace.
         raise ValueError(
-            "no mesh axis bound; pass axis_name= or init() in mesh mode"
+            "no mesh axis bound; pass axis_name= or call inside "
+            "shard_map over the data axis"
         )
-    return an
+    return ax
 
 
 def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
@@ -92,13 +162,15 @@ def allreduce(
     horovod/torch/mpi_ops.py allreduce)."""
     rop = _resolve_op(op, average)
     if _use_traced(tensor, axis_name):
+        _count_traced("allreduce")
         return _traced.allreduce(
             tensor, _axis(axis_name), rop, prescale_factor, postscale_factor
         )
     if _is_tracer(tensor) and basics.mode() == "process":
         raise ValueError(
             "collectives inside jit require a bound mesh axis in process "
-            "mode; wrap the step in shard_map or use the eager API"
+            "mode; wrap the step in shard_map (hvd.wrap_step binds the "
+            "data axis) or use the eager API"
         )
     if basics.mode() == "process":
         h = allreduce_async(tensor, name=name, op=rop,
@@ -157,6 +229,7 @@ def grouped_allreduce(
 ):
     rop = _resolve_op(op, average)
     if tensors and _use_traced(tensors[0], axis_name):
+        _count_traced("grouped_allreduce")
         return _traced.grouped_allreduce(
             tensors, _axis(axis_name), rop, prescale_factor, postscale_factor
         )
@@ -182,6 +255,7 @@ def allgather(tensor, name: Optional[str] = None, axis_name: Optional[str] = Non
     """Concatenate ranks' tensors along dim 0; first dims may differ in
     eager mode (ref: collective_operations.h:148-185)."""
     if _use_traced(tensor, axis_name):
+        _count_traced("allgather")
         return _traced.allgather(tensor, _axis(axis_name))
     if basics.mode() == "process":
         return synchronize(allgather_async(tensor, name=name))
@@ -207,6 +281,7 @@ def broadcast(
 ):
     """(ref: horovod/torch/mpi_ops.py broadcast)"""
     if _use_traced(tensor, axis_name):
+        _count_traced("broadcast")
         return _traced.broadcast(tensor, root_rank, _axis(axis_name))
     if basics.mode() == "process":
         return synchronize(broadcast_async(tensor, root_rank, name=name))
@@ -232,6 +307,7 @@ def alltoall(
     if _use_traced(tensor, axis_name):
         if splits is not None:
             raise ValueError("uneven alltoall splits are eager-only on TPU")
+        _count_traced("alltoall")
         return _traced.alltoall(tensor, _axis(axis_name))
     if basics.mode() == "process":
         return synchronize(alltoall_async(tensor, splits, name=name))
@@ -257,6 +333,7 @@ def reducescatter(tensor, op: Optional[ReduceOp] = None,
                   axis_name: Optional[str] = None):
     rop = op or ReduceOp.SUM
     if _use_traced(tensor, axis_name):
+        _count_traced("reducescatter")
         return _traced.reducescatter(tensor, _axis(axis_name), rop)
     if basics.mode() == "process":
         # Allreduce then take this rank's slice.

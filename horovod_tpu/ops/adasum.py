@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import axis_index as _axis_index, axis_size as _axis_size
 
 
 def _combine(a, b):
@@ -56,7 +56,7 @@ def adasum_allreduce(tensor, axis_name: str):
         )
     x = tensor
     rounds = int(math.log2(n))
-    idx = lax.axis_index(axis_name)
+    idx = _axis_index(axis_name)
     for k in range(rounds):
         stride = 1 << k
         # XOR-partner exchange as a ppermute permutation.

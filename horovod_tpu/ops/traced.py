@@ -24,7 +24,46 @@ from jax import lax
 
 from ..common.types import ReduceOp
 
-from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import axis_index as _axis_index, axis_size as _axis_size
+
+
+def _traced_wire_dtype(x, op: ReduceOp):
+    """Traced-path analogue of the eager wire codec (docs/running.md
+    "Wire compression" / "Traced collectives"): the dtype gradients are
+    cast to before the psum, or None for full-width.
+
+    Mirrors the eager coordinator policy — fp32 SUM/AVERAGE allreduces
+    only, ``HOROVOD_WIRE_COMPRESSION=bf16|fp16|auto`` (auto picks bf16;
+    int8 is eager-only — there is no per-tensor scale state under jit),
+    with the ``HOROVOD_WIRE_COMPRESSION_MIN_BYTES`` floor on the
+    pre-cast payload. Semantics differ from the eager codec in two
+    deliberate ways, both documented: the cast is STATELESS (no error
+    feedback — the residual store needs per-step host state that a
+    compiled program cannot carry), and the psum itself runs in the
+    narrow dtype (the eager engine reduces in fp32 at full width and
+    only ships narrow). Knobs are read at TRACE time and baked into the
+    compiled step — collectively consistent because the launcher
+    propagates the env to every rank, but a mid-run flip needs a
+    retrace, unlike the per-call eager knobs."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return None
+    from ..utils import env as env_cfg
+
+    mode = env_cfg.wire_compression_mode()
+    if mode == "none" or x.dtype != jnp.float32:
+        return None
+    if x.size * x.dtype.itemsize < env_cfg.wire_compression_min_bytes():
+        return None
+    dt = jnp.float16 if mode == "fp16" else jnp.bfloat16
+    from ..common import telemetry
+
+    telemetry.counter(
+        "horovod_traced_compressed_ops_total",
+        "Traced allreduces compiled with a pre-psum wire cast "
+        "(counted at trace time, labeled by codec)",
+        labels={"codec": "fp16" if mode == "fp16" else "bf16"},
+    ).inc()
+    return dt
 
 
 def _scale(x, factor):
@@ -53,7 +92,11 @@ def allreduce(
     """
     x = _scale(tensor, prescale_factor)
     if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
-        out = lax.psum(x, axis_name)
+        wire_dt = _traced_wire_dtype(x, op)
+        if wire_dt is not None:
+            out = lax.psum(x.astype(wire_dt), axis_name).astype(x.dtype)
+        else:
+            out = lax.psum(x, axis_name)
         if op == ReduceOp.AVERAGE:
             n = _axis_size(axis_name)
             out = _scale(out, 1.0 / n)
@@ -136,7 +179,7 @@ def broadcast(tensor, root_rank: int, axis_name: str):
     mpi_operations.cc:357-390). Implemented as a masked psum — a single
     ICI all-reduce, which XLA lowers efficiently; avoids materializing an
     all_gather."""
-    idx = lax.axis_index(axis_name)
+    idx = _axis_index(axis_name)
     mask = (idx == root_rank).astype(tensor.dtype)
     return lax.psum(tensor * mask, axis_name).astype(tensor.dtype)
 
@@ -175,7 +218,7 @@ def barrier(axis_name: str):
 
 
 def axis_rank(axis_name: str):
-    return lax.axis_index(axis_name)
+    return _axis_index(axis_name)
 
 
 def hierarchical_allreduce(

@@ -46,11 +46,17 @@ def _allreduce_grads(grads, op, axis_name, prescale, postscale, compression, fus
 
     leaves, treedef = jax.tree.flatten(grads)
     if fuse and leaves and _is_tracer(leaves[0]):
+        from ..ops import resolve_axis
         from ..ops.traced import grouped_allreduce
 
+        # The shared axis-resolution rule (docs/running.md "Traced
+        # collectives"): on a 2-D data×model mesh this picks the DATA
+        # axis only, so the fused gradient psum composes with tp/sp/pp
+        # kernels without configuration.
+        ax = resolve_axis(axis_name) or basics.axis_name()
         cs_ctx = [comp.compress(g) for g in leaves]
         red = grouped_allreduce(
-            [c for c, _ in cs_ctx], axis_name or basics.axis_name(), op,
+            [c for c, _ in cs_ctx], ax, op,
             prescale, postscale,
         )
         out = [comp.decompress(r, ctx) for r, (_, ctx) in zip(red, cs_ctx)]
@@ -63,6 +69,44 @@ def _is_tracer(x) -> bool:
         return isinstance(x, jax.core.Tracer)
     except Exception:  # pragma: no cover
         return False
+
+
+def _goodput_mark(idx):
+    """Host side of the traced step marker: runs once per EXECUTED
+    step. The ledger is re-read here so a plane toggled after
+    compilation is honored at run time."""
+    from ..common import goodput
+
+    led = goodput.active()
+    if led is not None and led.enabled and int(idx) == 0:
+        led.auto_step("optim")
+
+
+def _stage_traced_step_marker():
+    """Goodput demarcation for TRACED optimizer updates, at the host
+    call boundary (docs/goodput.md). The update body runs once at trace
+    time, so calling auto_step here directly would count one step per
+    COMPILATION; instead a jax.debug.callback is staged into the
+    compiled program and fires on the host each time the jitted step
+    executes. Under shard_map every shard runs the body, so the marker
+    is gated on the all-axes-origin shard (summed axis_index == 0 over
+    every bound axis); under plain jit/pjit the program is logical and
+    the callback fires once per call.
+
+    Known limitation (multi-controller pods): debug callbacks fire
+    only for a process's LOCAL shards, and the origin shard lives on
+    process 0 — so on a one-process-per-host mesh only rank 0's
+    ledger is auto-demarcated by this marker. Multi-controller loops
+    should use the explicit `hvd.step()` scope (or elastic commits),
+    which demarcate every process; the single-controller regime this
+    marker serves is where neither exists inside a jitted loop."""
+    from ..ops import _bound_axes
+    from ..utils.compat import axis_index as _axis_index
+
+    idx = jnp.int32(0)
+    for ax in _bound_axes():
+        idx = idx + _axis_index(ax).astype(jnp.int32)
+    jax.debug.callback(_goodput_mark, idx)
 
 
 def DistributedOptimizer(
@@ -85,17 +129,22 @@ def DistributedOptimizer(
     def update_fn(grads, state, params=None, **extra):
         # Goodput step demarcation (docs/goodput.md): every eager
         # optimizer update is one training step. Under jit this body
-        # runs once at trace time, not per step, so traced updates are
-        # skipped — jit loops demarcate with an explicit `hvd.step()`
-        # scope (or via `state.commit()` in elastic loops). The ledger
-        # check comes first: with the plane off (or before init) the
-        # update path must not pay even the tree flatten.
+        # runs once at trace time, so traced updates stage a
+        # jax.debug.callback that fires per EXECUTED step at the host
+        # call boundary instead (jitted loops get goodput_ratio too).
+        # The ledger check comes first: with the plane off (or before
+        # init) at trace time the update path must not pay even the
+        # tree flatten — and stages no callback (an explicit
+        # `hvd.step()` scope still works for programs that enable the
+        # plane after compiling).
         from ..common import goodput
 
         led = goodput.active()
         if led is not None and led.enabled:
             leaves = jax.tree.leaves(grads)
-            if not (leaves and _is_tracer(leaves[0])):
+            if leaves and _is_tracer(leaves[0]):
+                _stage_traced_step_marker()
+            else:
                 led.auto_step("optim")
         red = _allreduce_grads(
             grads, op, axis_name, prescale_factor, postscale_factor,

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..utils.compat import shard_map
+from ..utils.compat import axis_index as _axis_index, shard_map
 
 
 from ..utils.compat import pvary as _pvary
@@ -62,7 +62,7 @@ def gpipe(
     def worker(params_local, xs):
         # params_local leading stage dim is 1 locally.
         p = jax.tree.map(lambda a: jnp.squeeze(a, 0), params_local)
-        stage = jax.lax.axis_index(axis)
+        stage = _axis_index(axis)
         # Mark pp-varying up front: carries become varying inside the
         # loop (ppermute / per-stage masks) and the explicit pvary pins
         # the backward psum of xs at f32.

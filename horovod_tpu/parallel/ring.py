@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import axis_index as _axis_index, axis_size as _axis_size
 
 
 def _flash_block_update(o, m, l, q, k, v, qpos, kpos, scale, causal,
@@ -76,7 +76,7 @@ def ring_attention(
     query rows produce zeros (their normalizer is clamped), the BERT
     convention — the loss must mask them anyway."""
     n = _axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = _axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = 1.0 / np.sqrt(D)

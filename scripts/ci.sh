@@ -5,8 +5,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== unit + integration tests (fast tier) ==="
-python -m pytest tests/ -x -q -m 'not slow'
+echo "=== unit + integration tests (fast tier — FULLY GREEN tier-1) ==="
+# The 7 known jax<0.5 failures (gpipe x2 + pipelined-lm, flash-GSPMD x2,
+# bert-ring-mask, elastic-gspmd-traced) were fixed by the
+# partial-manual shard_map compat shims (utils/compat.py); tier-1 is
+# asserted fully green — ANY failed test fails CI, no known-failure
+# allowance remains.
+if ! python -m pytest tests/ -q -m 'not slow'; then
+  echo "tier-1 is no longer fully green"
+  exit 1
+fi
 
 echo "=== slow tier (full adapter / chaos coverage) ==="
 python -m pytest tests/ -x -q -m slow

@@ -237,6 +237,74 @@ def _hier_worker():
             "hier_arena_16mb_s": vals16}
 
 
+def _traced_worker():
+    """np=2 traced-vs-eager gradient exchange (docs/running.md "Traced
+    collectives"): order-alternated arms per round on the SAME ~2.4M
+    param pytree — the eager engine's grouped allreduce (both ranks
+    driving, steady names) vs the traced/XLA plane (a jitted shard_map
+    grouped psum over rank 0's local 2-device mesh; peers hold at the
+    barrier). Two stages land in the report: `traced_step_ms` (the
+    tracked XLA-plane arm) and `traced_eager_step_ms` (the engine arm,
+    riding along per the compression_none precedent so the report shows
+    both planes' cost on THIS box)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rounds = int(os.environ["PERF_ROUNDS"])
+    iters = int(os.environ["PERF_TR_ITERS"])
+    r = hvd.rank()
+
+    # The canonical benchmark pytree AND the traced-arm harness —
+    # imported, not copied, so this stage always measures exactly what
+    # the microbench and docs/running.md document.
+    from examples.microbench_allreduce import (
+        _make_grad_tree,
+        build_traced_exchange,
+    )
+
+    leaves = list(_make_grad_tree(np).values())
+
+    def timed_eager():
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hvd.grouped_allreduce(leaves, name="pr.tra.eager",
+                                  op=hvd.Average)
+        dt = (time.perf_counter() - t0) / iters
+        hvd.barrier()
+        return dt
+
+    run_traced = build_traced_exchange(np, leaves) if r == 0 else None
+
+    def timed_traced():
+        hvd.barrier()
+        dt = 0.0
+        if r == 0:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run_traced()
+            dt = (time.perf_counter() - t0) / iters
+        hvd.barrier()
+        return dt
+
+    timed_eager()  # warmup: negotiate the steady names
+    timed_traced()
+    eager_vals, traced_vals = [], []
+    for rd in range(rounds):
+        if rd % 2 == 0:
+            eager_vals.append(timed_eager())
+            traced_vals.append(timed_traced())
+        else:
+            traced_vals.append(timed_traced())
+            eager_vals.append(timed_eager())
+    rank = hvd.rank()
+    hvd.shutdown()
+    return {"rank": rank, "traced_step_s": traced_vals,
+            "traced_eager_step_s": eager_vals}
+
+
 def _serving_worker():
     """np=2 serving round-trip: echo model over the SPMD round
     protocol, p50 of programmatic submit -> reply."""
@@ -345,6 +413,21 @@ def measure(rounds: int, quick: bool) -> dict:
     for key, name in (("hier_1mb_s", "hier_1mb_ms"),
                       ("hier_arena_16mb_s", "hier_arena_16mb_ms")):
         vals = hier0[key]
+        stages[name] = {
+            "unit": "ms",
+            "rounds": [round(v * 1e3, 4) for v in vals],
+            "value": round(_median(vals) * 1e3, 4),
+        }
+
+    res = run(_traced_worker, np=2,
+              extra_env=dict(
+                  env,
+                  XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                  HOROVOD_TRANSPORT="auto"))
+    tr0 = next(r for r in res if r.get("rank") == 0)
+    for key, name in (("traced_step_s", "traced_step_ms"),
+                      ("traced_eager_step_s", "traced_eager_step_ms")):
+        vals = tr0[key]
         stages[name] = {
             "unit": "ms",
             "rounds": [round(v * 1e3, 4) for v in vals],

@@ -262,6 +262,96 @@ def worker_compression():
     return checks
 
 
+def worker_traced():
+    """Traced-collectives smoke (docs/running.md "Traced collectives"):
+    with a REAL process-mode engine alive, a jitted shard_map gradient
+    exchange over the worker's local 2-device mesh must dispatch to the
+    XLA plane and leave the engine data plane UNTOUCHED — XLA owns the
+    wire, so `horovod_allreduce_bytes_total` and the transport byte
+    counters must not move while `horovod_traced_ops_total` does. An
+    eager control op first proves the engine counters DO move when the
+    engine is used (a zero-delta assert against dead counters would
+    pass vacuously)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel.mesh import create_mesh
+    from horovod_tpu.utils.compat import shard_map
+
+    hvd.init()
+    n = hvd.size()
+
+    def engine_bytes(snap):
+        return snap.get("horovod_allreduce_bytes_total", 0)
+
+    def data_frames(snap):
+        # Frames on NUMERIC (data) channels only: ctrl/health frames
+        # keep flowing regardless (heartbeats, telemetry piggyback) and
+        # must not fail the zero-data-plane assert.
+        total = 0
+        for k, v in snap.items():
+            if k.startswith("horovod_tcp_channel_frames_total"):
+                label = k.split('channel="')[1].split('"')[0]
+                if label.isdigit():
+                    total += v
+        return total
+
+    def traced_ops(snap):
+        return sum(v for k, v in snap.items()
+                   if k.startswith("horovod_traced_ops_total"))
+
+    # Control: the eager plane moves engine bytes.
+    x = np.full(COUNT, float(hvd.rank() + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, name="ptr.ctrl", op=hvd.Sum))
+    assert float(out[0]) == sum(range(1, n + 1)), out[0]
+    snap = hvd.metrics()["metrics"]
+    assert engine_bytes(snap) == x.nbytes, snap.get(
+        "horovod_allreduce_bytes_total")
+
+    # Traced leg: local 2-device mesh, jitted psum exchange. The
+    # barrier settles the control op's in-flight frames before the
+    # before-snapshot.
+    assert len(jax.devices()) >= 2, "worker needs 2 forced CPU devices"
+    mesh = create_mesh({"hvd": 2}, devices=jax.devices()[:2])
+    hvd.barrier()
+    snap = hvd.metrics()["metrics"]
+    before_engine = engine_bytes(snap)
+    before_frames = data_frames(snap)
+    before_traced = traced_ops(snap)
+
+    step = jax.jit(shard_map(
+        lambda v: hvd.allreduce(v, op=hvd.Sum),
+        mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd")))
+    g = jnp.arange(2 * COUNT, dtype=jnp.float32)
+    for _ in range(ITERS):
+        out_t = jax.block_until_ready(step(g))
+    halves = np.asarray(g).reshape(2, -1)
+    np.testing.assert_allclose(np.asarray(out_t),
+                               np.tile(halves[0] + halves[1], 2))
+
+    snap = hvd.metrics()["metrics"]
+    traced_delta = traced_ops(snap) - before_traced
+    engine_delta = engine_bytes(snap) - before_engine
+    frames_delta = data_frames(snap) - before_frames
+    assert traced_delta > 0, "traced dispatch never engaged"
+    assert engine_delta == 0, (
+        f"traced collectives leaked {engine_delta} bytes into the "
+        "engine data plane — XLA owns the traced wire")
+    assert frames_delta == 0, (
+        f"traced collectives moved {frames_delta} frames on the "
+        "engine's data channels")
+    checks = {"rank": hvd.rank(), "bytes": int(x.nbytes),
+              "traced_ops": int(traced_delta),
+              "engine_delta": int(engine_delta),
+              "data_frames_delta": int(frames_delta)}
+    hvd.barrier()
+    hvd.shutdown()
+    return checks
+
+
 def worker_hier():
     """Two-level hierarchical allreduce over a SIMULATED 2-host x
     2-slot topology (distinct HOROVOD_HOSTNAME per host): intra-host
@@ -422,6 +512,22 @@ def main():
                for r in cmp_results), cmp_results
     print("perf smoke OK (compression):", cmp_results)
 
+    # Traced stage: pinned tcp (the data-channel frame counters assert
+    # the socket plane), 2 forced CPU devices per worker for the local
+    # mesh. Proves the metrics.md claim: traced collectives do NOT ride
+    # horovod_allreduce_bytes_total — XLA owns that wire.
+    traced_results = run(worker_traced, np=2, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_TCP_TIMEOUT_SECONDS": "60",
+        "HOROVOD_TRANSPORT": "tcp",
+    })
+    assert len(traced_results) == 2, traced_results
+    assert all(r["engine_delta"] == 0 and r["traced_ops"] > 0
+               for r in traced_results), traced_results
+    print("perf smoke OK (traced):", traced_results)
+
     # Deliberately NO HOROVOD_TRANSPORT here: this stage doubles as the
     # default-route assertion — on a co-located mesh the `auto` default
     # must select shm on its own (worker_shm fails if no data byte ever
@@ -472,6 +578,9 @@ def main():
         "shm_conserved": total_sent,
         "hier_bytes": hier_results[0]["bytes"],
         "hier_wire_saved": sum(r["saved"] for r in hier_results),
+        "traced_ops": sum(r["traced_ops"] for r in traced_results),
+        "traced_engine_bytes_delta": sum(
+            r["engine_delta"] for r in traced_results),
     }))
 
 

@@ -71,8 +71,23 @@ _GSPMD_WORKER = textwrap.dedent(
     import os, pickle, time
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
     import jax
-    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        # jax >= 0.5 spells the device-count override as a config
+        # option; on older versions the XLA_FLAGS above (read at lazy
+        # backend creation, after clear_backends below) does the same
+        # job — the worker only needs >= 2 devices and slices
+        # jax.devices()[:2]. The same dance as tests/conftest.py.
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        pass
+    import jax.extend.backend as _jeb
+    _jeb.clear_backends()
     import numpy as np
     import horovod_tpu as hvd
     from horovod_tpu.backend.elastic_env import spawn_identity

@@ -1,0 +1,486 @@
+"""One allreduce, two planes (ROADMAP item 2 / docs/running.md "Traced
+collectives"): the same `hvd.allreduce` / `hvd.DistributedOptimizer`
+call must run eagerly on the engine, under plain jit (closed forms over
+GSPMD arrays), and under shard_map (XLA collectives over the resolved
+mesh axis) — with cross-path numerical agreement, a collectively
+consistent axis-resolution rule, 2-D data×model mesh composition, the
+traced-path wire cast, and host-boundary goodput demarcation for jitted
+optimizer loops."""
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.backend.threaded import ThreadedGroup
+from horovod_tpu.common import telemetry
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.engine.engine import Engine
+from horovod_tpu.ops import resolve_axis
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.utils.compat import shard_map
+
+
+def _run_engine_ranks(size, fn):
+    """fn(engine, rank) on `size` in-process engines (the eager TCP/
+    inproc data plane — real negotiation, real wire framing)."""
+    group = ThreadedGroup(size)
+    engines = [
+        Engine(rank=r, size=size, backend=group.backend(r))
+        for r in range(size)
+    ]
+    for e in engines:
+        e.cycle_time_s = 0.001
+        e.start()
+    results, errors = [None] * size, [None] * size
+
+    def worker(r):
+        try:
+            results[r] = fn(engines[r], r)
+        except BaseException as ex:  # noqa: BLE001
+            errors[r] = ex
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    stop = [threading.Thread(target=e.shutdown) for e in engines]
+    for t in stop:
+        t.start()
+    for t in stop:
+        t.join(timeout=60)
+    for err in errors:
+        if err is not None:
+            raise err
+    return results
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {k: os.environ.get(k) for k in
+             ("HOROVOD_WIRE_COMPRESSION", "HOROVOD_WIRE_COMPRESSION_MIN_BYTES")}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# Axis resolution rule
+
+def test_resolve_axis_explicit_wins():
+    assert resolve_axis("sp") == "sp"
+    assert resolve_axis(("dp", "sp")) == ("dp", "sp")
+
+
+def test_resolve_axis_none_outside_trace():
+    # Eager / plain jit: nothing bound -> None (closed forms / engine).
+    assert resolve_axis() is None
+
+
+def test_resolve_axis_picks_data_axis_on_2d_mesh():
+    """On a data×model mesh the rule resolves the DATA axis only —
+    model axes (tp) are never gradient-reduction axes."""
+    hvd.shutdown()
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    seen = {}
+
+    def worker(x):
+        seen["axis"] = resolve_axis()
+        return x
+
+    shard_map(worker, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(
+        jnp.ones(2))
+    assert seen["axis"] == "dp"
+
+
+def test_resolve_axis_prefers_init_axis(hvd_mesh):
+    seen = {}
+
+    def worker(x):
+        seen["axis"] = resolve_axis()
+        return x
+
+    shard_map(worker, mesh=hvd_mesh.mesh(), in_specs=P("hvd"),
+              out_specs=P("hvd"))(jnp.ones(8))
+    assert seen["axis"] == "hvd"
+
+
+# ---------------------------------------------------------------------------
+# One API: cross-path agreement (eager engine vs traced psum)
+
+@pytest.mark.parametrize("op,prescale", [
+    (ReduceOp.AVERAGE, 1.0),
+    (ReduceOp.AVERAGE, 2.5),
+    (ReduceOp.SUM, 1.0),
+    (ReduceOp.SUM, 0.5),
+])
+def test_allreduce_engine_vs_traced_agreement(op, prescale):
+    """The acceptance matrix: the SAME call, engine plane vs XLA plane,
+    per-rank data identical across the arms — results allclose at fp32
+    tolerances for AVERAGE and SUM with prescale."""
+    hvd.shutdown()
+    n = 2
+    rng = np.random.RandomState(7)
+    data = rng.randn(n, 1024).astype(np.float32)
+
+    def fn(eng, rank):
+        h = eng.enqueue_allreduce(data[rank].copy(), name="xp", op=op,
+                                  prescale=prescale)
+        return eng.synchronize(h, timeout=60)
+
+    engine_out = _run_engine_ranks(n, fn)
+
+    mesh = create_mesh({"hvd": n}, devices=jax.devices()[:n])
+
+    def step(x):
+        return hvd.allreduce(x, op=op, prescale_factor=prescale)
+
+    traced = shard_map(step, mesh=mesh, in_specs=P("hvd"),
+                       out_specs=P("hvd"))(
+        jnp.asarray(data.reshape(n * 1024)))
+    traced = np.asarray(traced).reshape(n, 1024)
+
+    for r in range(n):
+        np.testing.assert_allclose(engine_out[r], traced[r],
+                                   rtol=1e-6, atol=1e-6)
+    # ...and the shards agree with each other (it was a real allreduce).
+    np.testing.assert_allclose(traced[0], traced[1], rtol=0, atol=0)
+
+
+def test_one_call_eager_jit_shardmap_consistent(hvd_mesh):
+    """The same script line runs in all three regimes and agrees:
+    mesh-mode eager (closed form), plain jit (closed form over the
+    global array), shard_map (real psum)."""
+    n = hvd_mesh.size()
+    x = jnp.full((n * 4,), 3.0, jnp.float32)
+
+    eager = hvd.allreduce(x, op=hvd.Sum)
+
+    jitted = jax.jit(lambda v: hvd.allreduce(v, op=hvd.Sum))(x)
+
+    sharded = shard_map(lambda v: hvd.allreduce(v, op=hvd.Sum),
+                        mesh=hvd_mesh.mesh(), in_specs=P(),
+                        out_specs=P())(x)
+
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted))
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(sharded))
+
+
+def test_distributed_optimizer_engine_vs_traced(hvd_mesh):
+    """DistributedOptimizer: the traced (shard_map) update equals the
+    engine-plane update on the same per-rank gradients."""
+    n = 2
+    rng = np.random.RandomState(3)
+    grads = rng.randn(n, 64).astype(np.float32)
+    params = rng.randn(64).astype(np.float32)
+
+    # Engine arm: eager update per rank (allreduce rides the engine).
+    def fn(eng, rank):
+        h = eng.enqueue_allreduce(grads[rank].copy(), name="g",
+                                  op=ReduceOp.AVERAGE)
+        red = eng.synchronize(h, timeout=60)
+        return params - 0.1 * red
+
+    engine_params = _run_engine_ranks(n, fn)
+
+    # Traced arm: the SAME DistributedOptimizer API under shard_map.
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    mesh = create_mesh({"hvd": n}, devices=jax.devices()[:n])
+    state = tx.init(jnp.asarray(params))
+
+    def step(p, g, s):
+        upd, s2 = tx.update(g, s, p)
+        return optax.apply_updates(p, upd)
+
+    out = shard_map(step, mesh=mesh,
+                    in_specs=(P(), P("hvd"), P()), out_specs=P())(
+        jnp.asarray(params), jnp.asarray(grads.reshape(-1)), state)
+
+    for r in range(n):
+        np.testing.assert_allclose(engine_params[r], np.asarray(out),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 2-D data×model mesh composition
+
+def test_distributed_optimizer_2d_mesh_psums_data_axis_only():
+    """Acceptance: on a dp×tp mesh, DistributedOptimizer psums over the
+    data axis ONLY — gradients come out bitwise-identical across
+    data-parallel replicas while tensor-parallel shards keep their own
+    (different) values."""
+    hvd.shutdown()
+    DP, TP, K = 2, 4, 8
+    mesh = create_mesh({"dp": DP, "tp": TP})
+    rng = np.random.RandomState(0)
+    # Params sharded over tp; batch sharded over dp.
+    w = rng.randn(TP * K).astype(np.float32)
+    x = rng.randn(DP * 4, TP * K).astype(np.float32)
+
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), op=ReduceOp.AVERAGE)
+    state = tx.init(jnp.asarray(w))
+
+    def worker(w_shard, x_shard, s):
+        # Per-replica gradient of a toy loss on this dp shard's batch
+        # and this tp shard's parameter slice.
+        g = jax.grad(lambda wv: jnp.sum((x_shard[:, :K] * wv) ** 2))(
+            w_shard)
+        upd, _ = tx.update(g, s, w_shard)
+        # Expose every device's reduced update: leading (1, 1) dims map
+        # onto (dp, tp) in the out spec.
+        return upd[None, None, :]
+
+    out = shard_map(
+        worker, mesh=mesh,
+        in_specs=(P("tp"), P("dp"), P()),
+        out_specs=P("dp", "tp"),
+    )(jnp.asarray(w), jnp.asarray(x), state)
+    out = np.asarray(out)  # (DP, TP, K)
+
+    # Bitwise identical across data-parallel replicas...
+    assert np.array_equal(out[0], out[1])
+    # ...and genuinely different across tensor-parallel shards (it did
+    # NOT reduce over tp).
+    assert not np.array_equal(out[0, 0], out[0, 1])
+
+    # And the value is the dp-average of the per-replica gradients.
+    for t in range(TP):
+        g_reps = []
+        for d in range(DP):
+            xs = x[d * 4:(d + 1) * 4]
+            ws = w[t * K:(t + 1) * K]
+            g_reps.append(2 * np.sum(xs[:, :K] * (xs[:, :K] * ws), axis=0))
+        want = -np.mean(g_reps, axis=0)  # sgd(1.0) update = -avg grad
+        np.testing.assert_allclose(out[0, t], want, rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_composes_with_model_axis_collective():
+    """hvd.allreduce (data axis, auto-resolved) composes with an
+    explicit model-axis psum in the same program."""
+    hvd.shutdown()
+    mesh = create_mesh({"dp": 2, "tp": 4})
+    x = jnp.arange(8.0, dtype=jnp.float32)
+
+    def worker(v):
+        tp_sum = jax.lax.psum(v, "tp")          # model-parallel combine
+        return hvd.allreduce(tp_sum, op=hvd.Sum)  # data-axis reduce
+
+    out = shard_map(worker, mesh=mesh, in_specs=P(("dp", "tp")),
+                    out_specs=P(("dp", "tp")))(x)
+    # Each shard: psum over its dp-group's 4 tp shards, then summed
+    # across the 2 dp groups -> the full sum of all 8 shard values.
+    total = float(np.asarray(x).sum())
+    np.testing.assert_allclose(np.asarray(out), np.full(8, total))
+
+
+# ---------------------------------------------------------------------------
+# Traced wire cast (the eager codec's stateless analogue)
+
+def _psum2(x, **env):
+    hvd.shutdown()
+    mesh = create_mesh({"hvd": 2}, devices=jax.devices()[:2])
+    for k, v in env.items():
+        os.environ[k] = v
+    try:
+        return np.asarray(shard_map(
+            lambda v: hvd.allreduce(v, op=hvd.Sum),
+            mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"))(x))
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+
+
+def test_traced_wire_cast_bf16_rounds_and_upcasts():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2 * 4096).astype(np.float32))
+    full = _psum2(x)
+    cast = _psum2(x, HOROVOD_WIRE_COMPRESSION="bf16",
+                  HOROVOD_WIRE_COMPRESSION_MIN_BYTES="0")
+    assert cast.dtype == np.float32
+    # bf16 rounding happened (values differ from the f32 path)...
+    assert not np.array_equal(full, cast)
+    # ...but stays within bf16 error bounds.
+    np.testing.assert_allclose(full, cast, rtol=2e-2, atol=2e-2)
+    # And matches the explicit cast-then-sum reference.
+    import ml_dtypes
+
+    halves = np.asarray(x).reshape(2, -1).astype(ml_dtypes.bfloat16)
+    want = np.tile((halves[0] + halves[1]).astype(np.float32), 2)
+    np.testing.assert_allclose(cast, want, rtol=1e-6, atol=1e-6)
+
+
+def test_traced_wire_cast_respects_min_bytes_floor():
+    x = jnp.asarray(np.random.RandomState(2).randn(64).astype(np.float32))
+    full = _psum2(x)
+    floored = _psum2(x, HOROVOD_WIRE_COMPRESSION="bf16",
+                     HOROVOD_WIRE_COMPRESSION_MIN_BYTES="65536")
+    # Payload under the floor: full-width, bitwise unchanged.
+    np.testing.assert_array_equal(full, floored)
+
+
+def test_traced_wire_cast_fp16_and_auto():
+    x = jnp.asarray(np.random.RandomState(3).randn(2048).astype(np.float32))
+    fp16 = _psum2(x, HOROVOD_WIRE_COMPRESSION="fp16",
+                  HOROVOD_WIRE_COMPRESSION_MIN_BYTES="0")
+    halves = np.asarray(x).reshape(2, -1).astype(np.float16)
+    want = np.tile((halves[0] + halves[1]).astype(np.float32), 2)
+    np.testing.assert_allclose(fp16, want, rtol=1e-6, atol=1e-6)
+    # auto resolves to bf16 on the traced path.
+    auto = _psum2(x, HOROVOD_WIRE_COMPRESSION="auto",
+                  HOROVOD_WIRE_COMPRESSION_MIN_BYTES="0")
+    bf16 = _psum2(x, HOROVOD_WIRE_COMPRESSION="bf16",
+                  HOROVOD_WIRE_COMPRESSION_MIN_BYTES="0")
+    np.testing.assert_array_equal(auto, bf16)
+
+
+def test_traced_wire_cast_f32_only_and_sum_avg_only():
+    # Integer tensors never cast.
+    xi = jnp.arange(2 * 512, dtype=jnp.int32)
+    full = _psum2(xi)
+    cast = _psum2(xi, HOROVOD_WIRE_COMPRESSION="bf16",
+                  HOROVOD_WIRE_COMPRESSION_MIN_BYTES="0")
+    np.testing.assert_array_equal(full, cast)
+
+    # MIN/MAX never cast.
+    hvd.shutdown()
+    mesh = create_mesh({"hvd": 2}, devices=jax.devices()[:2])
+    xf = jnp.asarray(np.random.RandomState(4).randn(1024).astype(np.float32))
+    os.environ["HOROVOD_WIRE_COMPRESSION"] = "bf16"
+    os.environ["HOROVOD_WIRE_COMPRESSION_MIN_BYTES"] = "0"
+    try:
+        mn = shard_map(lambda v: hvd.allreduce(v, op=hvd.Min),
+                       mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"))(xf)
+    finally:
+        os.environ.pop("HOROVOD_WIRE_COMPRESSION")
+        os.environ.pop("HOROVOD_WIRE_COMPRESSION_MIN_BYTES")
+    halves = np.asarray(xf).reshape(2, -1)
+    np.testing.assert_array_equal(np.asarray(mn),
+                                  np.tile(np.minimum(halves[0], halves[1]), 2))
+
+
+def test_traced_compressed_counter_counts_at_trace_time():
+    before = telemetry.default_registry().snapshot().get(
+        'horovod_traced_compressed_ops_total{codec="bf16"}', 0)
+    x = jnp.ones(4096, jnp.float32)
+    _psum2(x, HOROVOD_WIRE_COMPRESSION="bf16",
+           HOROVOD_WIRE_COMPRESSION_MIN_BYTES="0")
+    after = telemetry.default_registry().snapshot().get(
+        'horovod_traced_compressed_ops_total{codec="bf16"}', 0)
+    assert after == before + 1
+
+
+def test_traced_dispatch_counter(hvd_mesh):
+    before = telemetry.default_registry().snapshot().get(
+        'horovod_traced_ops_total{op="allreduce"}', 0)
+    shard_map(lambda v: hvd.allreduce(v, op=hvd.Sum),
+              mesh=hvd_mesh.mesh(), in_specs=P("hvd"),
+              out_specs=P("hvd"))(jnp.ones(8))
+    after = telemetry.default_registry().snapshot().get(
+        'horovod_traced_ops_total{op="allreduce"}', 0)
+    assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Goodput: traced optimizer updates demarcate at the host call boundary
+
+def test_traced_optimizer_updates_demarcate_goodput(hvd_mesh):
+    from horovod_tpu.common import goodput
+    from horovod_tpu.common.telemetry import MetricsRegistry
+
+    led = goodput.GoodputLedger(registry=MetricsRegistry(), rank=0,
+                                enabled=True, stamp_path=None)
+    prev = goodput.active()
+    goodput.set_current(led)
+    try:
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        w = jnp.zeros(16, jnp.float32)
+        state = tx.init(w)
+
+        @jax.jit
+        def step(w, s, g):
+            upd, s2 = tx.update(g, s, w)
+            return optax.apply_updates(w, upd), s2
+
+        N = 5
+        g = jnp.ones(16, jnp.float32)
+        for _ in range(N):
+            w, state = step(w, state, g)
+        jax.block_until_ready(w)
+        jax.effects_barrier()
+        # One auto_step per EXECUTED step (the update body traced only
+        # once) — the jitted loop is demarcated.
+        assert led.steps == N, led.steps
+        assert led.ratio() is not None and not np.isnan(led.ratio())
+    finally:
+        goodput.set_current(prev)
+
+
+def test_traced_optimizer_demarcates_under_shard_map(hvd_mesh):
+    """Under wrap_step/shard_map the marker fires once per host step,
+    not once per device shard."""
+    from horovod_tpu.common import goodput
+    from horovod_tpu.common.telemetry import MetricsRegistry
+
+    led = goodput.GoodputLedger(registry=MetricsRegistry(), rank=0,
+                                enabled=True, stamp_path=None)
+    prev = goodput.active()
+    goodput.set_current(led)
+    try:
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        w = jnp.zeros(8, jnp.float32)
+        state = tx.init(w)
+        mesh = hvd_mesh.mesh()
+
+        def step(w, s, x):
+            g = jax.grad(lambda wv: jnp.sum(wv * x))(w)
+            upd, s2 = tx.update(g, s, w)
+            return optax.apply_updates(w, upd), s2
+
+        sm = jax.jit(shard_map(step, mesh=mesh,
+                               in_specs=(P(), P(), P("hvd")),
+                               out_specs=(P(), P())))
+        N = 4
+        x = jnp.arange(8.0, dtype=jnp.float32)
+        for _ in range(N):
+            w, state = sm(w, state, x)
+        jax.block_until_ready(w)
+        jax.effects_barrier()
+        assert led.steps == N, led.steps
+    finally:
+        goodput.set_current(prev)
+
+
+def test_disabled_ledger_stages_no_marker(hvd_mesh):
+    from horovod_tpu.common import goodput
+    from horovod_tpu.common.telemetry import MetricsRegistry
+
+    led = goodput.GoodputLedger(registry=MetricsRegistry(), rank=0,
+                                enabled=False, stamp_path=None)
+    prev = goodput.active()
+    goodput.set_current(led)
+    try:
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        w = jnp.zeros(4, jnp.float32)
+        state = tx.init(w)
+
+        @jax.jit
+        def step(w, s, g):
+            upd, s2 = tx.update(g, s, w)
+            return optax.apply_updates(w, upd), s2
+
+        for _ in range(3):
+            w, state = step(w, state, jnp.ones(4))
+        jax.block_until_ready(w)
+        jax.effects_barrier()
+        assert led.steps == 0
+    finally:
+        goodput.set_current(prev)
