@@ -39,7 +39,8 @@ def _request_counter():
 
 class RendezvousClient:
     def __init__(self, addr: str, port: int, timeout: float = 60.0,
-                 secret_key: Optional[bytes] = None):
+                 secret_key: Optional[bytes] = None,
+                 namespace: Optional[str] = None):
         self.addr = addr
         self.port = port
         self.timeout = timeout
@@ -48,6 +49,23 @@ class RendezvousClient:
 
             secret_key = secret_util.key_from_env()
         self.secret_key = secret_key
+        # Per-job KV namespace (docs/elastic.md "Sharing one rendezvous
+        # server"): with HOROVOD_JOB_NAME set, every key this client
+        # touches lives under jobs/<name>/ — two jobs sharing one
+        # server cannot collide. The driver prefixes identically, so
+        # the whole protocol (rank rows, epochs, readiness, health
+        # verdicts, drain notices, goodput/alert mirrors) is scoped
+        # without any key-by-key opt-in. None = read the env; "" =
+        # explicitly unnamespaced.
+        if namespace is None:
+            from ..utils import env as env_cfg
+
+            namespace = env_cfg.job_kv_prefix()
+        self.namespace = namespace
+
+    def _path(self, suffix: str) -> str:
+        return f"/{self.namespace}{suffix}" if self.namespace \
+            else f"/{suffix}"
 
     def _conn(self) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.addr, self.port, timeout=10.0)
@@ -82,7 +100,7 @@ class RendezvousClient:
     def put(self, scope: str, key: str, value: bytes):
         def _put():
             c = self._conn()
-            path = f"/{scope}/{key}"
+            path = self._path(f"{scope}/{key}")
             try:
                 c.request("PUT", path, body=value,
                           headers=self._headers("PUT", path, value))
@@ -98,7 +116,7 @@ class RendezvousClient:
     def get(self, scope: str, key: str) -> Optional[bytes]:
         def _get():
             c = self._conn()
-            path = f"/{scope}/{key}"
+            path = self._path(f"{scope}/{key}")
             try:
                 c.request("GET", path, headers=self._headers("GET", path))
                 r = c.getresponse()
@@ -145,7 +163,7 @@ class RendezvousClient:
         # through the public API instead of being absorbed.
         def _delete():
             c = self._conn()
-            path = f"/{scope}"
+            path = self._path(f"{scope}")
             try:
                 c.request("DELETE", path,
                           headers=self._headers("DELETE", path))

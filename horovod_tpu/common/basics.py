@@ -185,6 +185,18 @@ def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "
         from . import goodput
 
         goodput.current(rank=_state.rank)
+        # Drain plane (docs/fault_tolerance.md "Announced preemption"):
+        # spawned workers get the preemption-signal handler on init, so
+        # an intentional stop (the launcher's teardown SIGTERM, a spot
+        # preemption notice) exits 0 instead of dying on the signal and
+        # being attributed as a failure. The elastic run loop upgrades
+        # to managed mode (drain at a commit boundary); user processes
+        # without the launcher env are left untouched.
+        if os.environ.get(env_cfg.RANK) is not None \
+                or os.environ.get(env_cfg.ELASTIC) is not None:
+            from . import drain
+
+            drain.coordinator.install()
         logger.debug(
             "horovod_tpu initialized: mode=%s rank=%d size=%d local=%d/%d cross=%d/%d",
             _state.mode, _state.rank, _state.size, _state.local_rank,

@@ -472,6 +472,29 @@ class CheckpointManager:
                     f"{timeout:.0f}s")
         return True
 
+    def save_now(self, state, timeout: float = 60.0) -> bool:
+        """Drain path (docs/fault_tolerance.md "Announced preemption"):
+        make the CURRENT commit durable before the process exits. If
+        this commit's interval checkpoint just went out the writer is
+        merely drained (waiting for it IS the forced checkpoint);
+        otherwise any in-flight write is drained first — so ``save``
+        cannot hit its backpressure-skip path — and this commit is
+        written blocking. Called at the same commit on every rank (the
+        drain barrier guarantees that), so the coordinator's ack
+        barrier fills and the manifest commits."""
+        deadline = time.monotonic() + max(timeout, 1.0)
+
+        def left() -> float:
+            return max(0.5, deadline - time.monotonic())
+
+        if (self.interval_steps > 0 and self._commit_count > 0
+                and self._commit_count % self.interval_steps == 0):
+            return self.flush(timeout=left())
+        if not self.flush(timeout=left()):
+            return False
+        return self.save(state, step=self._commit_count, blocking=True,
+                         timeout=left())
+
     def resync_after_reset(self, flush_timeout: float = 30.0):
         """Re-anchor the interval counter after an elastic reset. The
         counter is per-rank private state: a worker that joined mid-run

@@ -1,0 +1,390 @@
+"""Graceful-drain plane: announced preemptions as a first-class event
+(docs/fault_tolerance.md "Announced preemption").
+
+On spot/multi-tenant fleets the dominant disruption is not the silent
+crash the liveness plane (common/health.py) exists to bound — it is the
+*announced* preemption: the platform delivers SIGTERM (or a
+provider-specific notice) and grants a grace window before the kill.
+Reacting to that notice only after the rank dies wastes the window
+twice: the failure-detection timeout burns wall-clock, and the steps
+since the last interval checkpoint are replayed. This module turns the
+notice into a coordinated drain instead:
+
+1. **Notice** — the signal handler (installed for
+   ``HOROVOD_PREEMPT_SIGNAL``, default SIGTERM) marks the drain
+   requested, counts it, publishes a best-effort early notice into the
+   rendezvous KV (``drain_e<epoch>/<identity>``) so the driver can
+   quarantine the host immediately, and arms a hard deadline at
+   ``HOROVOD_DRAIN_GRACE_SECONDS``.
+
+2. **Barrier** — at the next ``state.commit()`` every rank allreduces a
+   one-bit drain flag (``commit_barrier``), so the whole world learns of
+   the drain at the *same* commit: all ranks force that commit durable
+   together (``CheckpointManager.save_now`` — the coordinator's ack
+   barrier needs the full world), survivors mark the fleet as draining
+   (the coming re-mesh window is then attributed to the ``preemption``
+   badput bucket, not ``failure``), and the draining rank proceeds to 3.
+
+3. **Handoff** — the draining rank releases the goodput stamp
+   (``goodput.release_stamp`` — ownership transfers to the promoted
+   survivor via ``try_adopt_stamp``), publishes the final ``drained``
+   notice, and leaves via ``WorkerPreempted`` — a ``SystemExit(0)``
+   subclass, so the launcher/driver records an intentional stop. Its
+   TCP FINs fail the survivors' next collective *immediately*; no
+   heartbeat timeout is ever waited out.
+
+If no commit boundary arrives inside the grace window, the deadline
+timer exits the process cleanly anyway: at most one checkpoint interval
+of steps is lost — exactly the unannounced-failure bound — and the
+early notice already routed the attribution.
+
+Outside an elastic run loop (``managed=False``, the launcher's static
+teardown) the handler simply exits 0 promptly, so an intentional stop
+is never attributed as a worker failure.
+
+The coordinator is a process-wide singleton like
+``fault_injection.injector``; the chaos harness's ``preempt`` rules
+deliver the signal, so the whole path is drivable from tests and
+``scripts/preemption_smoke.py`` without a real spot fleet.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import threading
+import time
+from typing import Optional
+
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+from .exceptions import WorkerPreempted
+
+logger = get_logger()
+
+# KV layout: drain_e<epoch>/<host:spawn_local_rank> -> JSON notice doc,
+# plus drain_e<epoch>/any -> marker (survivors + the liveness plane ask
+# "is anyone draining this epoch?" without listing keys).
+DRAIN_PREFIX = "drain_e"
+
+
+def _m_preemptions():
+    from . import telemetry
+
+    return telemetry.counter(
+        "horovod_preemptions_total",
+        "Preemption notices (signal or chaos-injected) this worker "
+        "received")
+
+
+def _m_drain_seconds():
+    from . import telemetry
+
+    return telemetry.histogram(
+        "horovod_drain_seconds",
+        "Preemption notice to drained exit: final checkpoint durable, "
+        "stamp released, notice published", min_exp=-4, max_exp=8)
+
+
+class DrainCoordinator:
+    """Per-process drain state machine (see module docstring).
+
+    ``managed`` selects the two behaviours: an elastic run loop sets it
+    (drain completes at a commit boundary, with checkpoint + handoff);
+    unmanaged processes exit 0 straight from the handler. The flag must
+    be UNIFORM across ranks — ``commit_barrier`` is a collective and
+    every rank must agree whether to run it — which holds because only
+    ``elastic.run_fn`` sets it, on every rank alike.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requested = threading.Event()
+        self._reason = ""
+        self._t0: Optional[float] = None          # monotonic at notice
+        self._deadline: Optional[threading.Timer] = None
+        self._managed = False
+        self._installed_signum: Optional[int] = None
+        self._prev_handler = None
+        # Freshest local evidence that a PEER is draining (set by the
+        # commit barrier) — survivors consult it for badput attribution
+        # without a KV round-trip.
+        self._peer_mono: Optional[float] = None
+        # Test seam: the hard exits (unmanaged notice, expired grace)
+        # go through this so unit tests can observe instead of dying.
+        self._exit = os._exit
+
+    # -- lifecycle -----------------------------------------------------
+    def install(self, managed: Optional[bool] = None) -> bool:
+        """Register the preemption-signal handler (idempotent; main
+        thread only — elsewhere the registration is skipped, which is
+        fine for the in-process test harness where the chaos injector
+        calls ``request()`` directly). A non-default handler some user
+        code installed is never clobbered. Returns whether the handler
+        is in place."""
+        if managed is not None:
+            with self._lock:
+                self._managed = managed
+        signum = env_cfg.preempt_signal()
+        with self._lock:
+            if self._installed_signum == signum:
+                return True
+        try:
+            prev = _signal.getsignal(signum)
+            if (prev not in (_signal.SIG_DFL, None)
+                    and prev is not self._on_signal):
+                logger.info(
+                    "preemption signal %d already has a handler; leaving "
+                    "it in place (graceful drain disabled)", signum)
+                return False
+            _signal.signal(signum, self._on_signal)
+        except (ValueError, OSError):  # not the main thread / bad signum
+            return False
+        with self._lock:
+            self._installed_signum = signum
+            self._prev_handler = prev
+        return True
+
+    def set_managed(self, managed: bool):
+        with self._lock:
+            self._managed = managed
+
+    def active(self) -> bool:
+        """Whether the commit barrier should run (managed mode)."""
+        return self._managed
+
+    def pending(self) -> bool:
+        return self._requested.is_set()
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    # -- the notice ----------------------------------------------------
+    def _on_signal(self, signum, frame):  # pragma: no cover - exercised
+        try:                              # via request() in tests
+            name = _signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        self.request(f"signal {name}")
+
+    def request(self, reason: str = "preemption notice"):
+        """Mark the drain requested. Idempotent; callable from the
+        signal handler, the chaos injector, or the controller path."""
+        with self._lock:
+            if self._requested.is_set():
+                return
+            self._requested.set()
+            self._reason = reason
+            self._t0 = time.monotonic()
+            managed = self._managed
+        _m_preemptions().inc()
+        grace = env_cfg.drain_grace_seconds()
+        if not managed:
+            logger.warning(
+                "preemption notice (%s) outside an elastic run loop: "
+                "exiting cleanly now", reason)
+            self._publish_notice("drained")
+            self._exit(0)
+            return
+        logger.warning(
+            "preemption notice (%s): draining — final checkpoint at the "
+            "next commit, hard exit in %.0fs", reason, grace)
+        # Publish EARLY (and off the handler's thread): the driver can
+        # quarantine the host and survivors can attribute the coming
+        # window even if this process never reaches another commit.
+        threading.Thread(target=self._publish_notice, args=("requested",),
+                         daemon=True, name="hvd-drain-notice").start()
+        if grace > 0:
+            t = threading.Timer(grace, self._grace_expired)
+            t.daemon = True
+            t.name = "hvd-drain-deadline"
+            with self._lock:
+                self._deadline = t
+            t.start()
+
+    def _grace_expired(self):
+        logger.error(
+            "drain grace (%.0fs) expired before a commit boundary; "
+            "exiting without the final checkpoint — at most one "
+            "checkpoint interval of steps is lost",
+            env_cfg.drain_grace_seconds())
+        self._publish_notice("drained")
+        self._exit(0)
+
+    def checkpoint_budget(self) -> float:
+        """Wall budget left for the forced final checkpoint: the grace
+        window minus elapsed, minus a margin for stamp release + exit."""
+        grace = env_cfg.drain_grace_seconds()
+        with self._lock:
+            t0 = self._t0
+        elapsed = 0.0 if t0 is None else time.monotonic() - t0
+        return max(1.0, grace - elapsed - 2.0)
+
+    # -- completion (draining rank, at a commit boundary) --------------
+    def execute(self, state) -> None:
+        """Complete the drain: the final checkpoint is already durable
+        (``commit_barrier`` ran ``save_now`` on every rank first), so
+        release the goodput stamp, publish the ``drained`` notice, and
+        leave via ``WorkerPreempted``."""
+        with self._lock:
+            t, self._deadline = self._deadline, None
+        if t is not None:
+            t.cancel()
+        from . import goodput
+
+        goodput.release_stamp()
+        self._publish_notice("drained")
+        with self._lock:
+            t0 = self._t0
+        if t0 is not None:
+            _m_drain_seconds().observe(time.monotonic() - t0)
+        logger.warning("drained cleanly (%s); exiting", self._reason)
+        raise WorkerPreempted(self._reason or "preempted")
+
+    # -- survivor-side attribution -------------------------------------
+    def note_peer_draining(self):
+        self._peer_mono = time.monotonic()
+
+    def fleet_draining(self, window: float = 600.0) -> bool:
+        """Whether this disruption should be attributed to the
+        ``preemption`` bucket: this rank is draining, a peer announced
+        a drain at a recent commit barrier, or the current epoch has a
+        drain marker in the KV (covers a peer that died on its grace
+        deadline without ever reaching a barrier)."""
+        if self._requested.is_set():
+            return True
+        t = self._peer_mono
+        if t is not None and time.monotonic() - t < window:
+            return True
+        return self._kv_marker_present()
+
+    def _kv_marker_present(self) -> bool:
+        try:
+            kv = _kv_from_env()
+            if kv is None:
+                return False
+            from ..backend import elastic_env
+
+            epoch = elastic_env._current_epoch()
+            if epoch is None:
+                return False
+            return kv.get(f"{DRAIN_PREFIX}{epoch}", "any") is not None
+        except Exception:
+            return False
+
+    # -- KV notice -----------------------------------------------------
+    def _publish_notice(self, phase: str):
+        """Best-effort: a down rendezvous server must never stall (or
+        fail) the drain itself."""
+        try:
+            kv = _kv_from_env()
+            if kv is None:
+                return
+            from ..backend import elastic_env
+
+            epoch = elastic_env._current_epoch()
+            ident = elastic_env.spawn_identity()
+            if epoch is None:
+                return
+            doc = {"identity": ident, "phase": phase,
+                   "reason": self._reason, "wall": time.time()}
+            from . import basics
+
+            if basics.is_initialized():
+                doc["rank"] = basics.rank()
+            scope = f"{DRAIN_PREFIX}{epoch}"
+            kv.put(scope, ident, json.dumps(doc).encode())
+            kv.put(scope, "any",
+                   json.dumps({"wall": doc["wall"],
+                               "phase": phase}).encode())
+        except Exception as e:
+            logger.debug("drain notice publish failed: %s", e)
+
+    # -- test plumbing -------------------------------------------------
+    def reset(self):
+        """Unwind for tests: cancel the deadline, restore the previous
+        signal disposition, clear all state."""
+        with self._lock:
+            t, self._deadline = self._deadline, None
+            signum = self._installed_signum
+            prev = self._prev_handler
+            self._installed_signum = None
+            self._prev_handler = None
+            self._requested = threading.Event()
+            self._reason = ""
+            self._t0 = None
+            self._managed = False
+            self._peer_mono = None
+            self._exit = os._exit
+        if t is not None:
+            t.cancel()
+        if signum is not None:
+            try:
+                _signal.signal(
+                    signum, prev if prev is not None else _signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+
+
+def _kv_from_env():
+    addr = env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR)
+    port = env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0)
+    if addr and port:
+        from ..backend.rendezvous import RendezvousClient
+
+        return RendezvousClient(addr, port)
+    return None
+
+
+# The process-wide singleton (fault_injection.injector pattern).
+coordinator = DrainCoordinator()
+
+
+def fleet_draining() -> bool:
+    return coordinator.fleet_draining()
+
+
+def commit_barrier(state) -> None:
+    """Called once per ``state.commit()`` (after the snapshot + goodput
+    bookkeeping, before the host-update check). An allreduce of a
+    one-bit drain flag means EVERY rank learns of a pending drain at
+    the same commit: all ranks then force this commit durable together
+    and the draining rank departs via ``coordinator.execute``. No-op —
+    zero collectives, one attribute read — outside managed (elastic
+    run loop) mode."""
+    coord = coordinator
+    if not coord.active():
+        return
+    from . import basics
+
+    if (not basics.is_initialized() or basics.size() == 1
+            or basics.mode() == "mesh"):
+        if coord.pending():
+            _drain_commit(coord, state, draining=True)
+        return
+    import numpy as np
+
+    from .. import ops
+    from .types import ReduceOp
+
+    flag = np.array([1.0 if coord.pending() else 0.0], np.float32)
+    out = ops.allreduce(flag, op=ReduceOp.SUM, name="hvd.drain_pending")
+    if float(np.asarray(out)[0]) <= 0.0:
+        return
+    _drain_commit(coord, state, draining=coord.pending())
+
+
+def _drain_commit(coord: DrainCoordinator, state, draining: bool):
+    mgr = getattr(state, "_checkpoint_manager", None)
+    if mgr is not None:
+        try:
+            mgr.save_now(state, timeout=coord.checkpoint_budget())
+        except Exception as e:
+            # The drain must still complete: losing the final partial
+            # interval is the unannounced-failure bound, not a reason
+            # to die mid-protocol.
+            logger.error("drain checkpoint failed: %s", e)
+    if draining:
+        coord.execute(state)
+    coord.note_peer_draining()

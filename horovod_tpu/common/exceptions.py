@@ -49,6 +49,20 @@ class HostsUpdatedInterrupt(RuntimeError):
         self.skip_sync = skip_sync
 
 
+class WorkerPreempted(SystemExit):
+    """Raised on a draining worker once its graceful-drain work (final
+    forced checkpoint, goodput stamp release, drain notice) is done —
+    the announced-preemption exit (docs/fault_tolerance.md "Announced
+    preemption"). A ``SystemExit`` subclass with code 0: the elastic run
+    loop's cleanup (``finally``) still runs, user ``except Exception``
+    blocks never swallow it, and the process exits cleanly so the
+    launcher/driver records an intentional stop, not a failure."""
+
+    def __init__(self, reason: str = "preempted"):
+        super().__init__(0)
+        self.reason = reason
+
+
 class NotInitializedError(RuntimeError):
     def __init__(self, what: str = "Horovod-TPU"):
         super().__init__(

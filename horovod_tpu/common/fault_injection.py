@@ -41,6 +41,14 @@ Rule actions:
 ``hang``    the matching I/O (``peer=P``, ``after=K``, ``op=...``)
             parks forever — a single stuck network operation, with the
             rest of the process (heartbeats included) still running.
+``preempt`` deliver the preemption notice (``HOROVOD_PREEMPT_SIGNAL``,
+            default SIGTERM — via ``os.kill`` to self, so the real
+            signal handler runs) at ``step=N`` or after ``secs=T``
+            wall seconds. The process keeps running: the drain plane
+            (common/drain.py) owns what happens next — checkpoint-now
+            at the next commit, stamp handoff, clean exit. The
+            announced-preemption analogue of ``kill``, drivable from
+            tests and scripts/preemption_smoke.py without a spot fleet.
 ``diskfail``raise ``OSError`` on the Nth matching disk I/O (checkpoint
             shard writes, metrics dumps — everything routed through
             ``utils/atomic_file.py``). Optional ``path=SUBSTR`` confines
@@ -102,7 +110,8 @@ class InjectedDiskFault(OSError):
     exercise exactly their real-disk-error paths (retry, skip, count)."""
 
 
-_NET_ACTIONS = ("kill", "sever", "drop", "delay", "wedge", "hang")
+_NET_ACTIONS = ("kill", "sever", "drop", "delay", "wedge", "hang",
+                "preempt")
 _DISK_ACTIONS = ("diskfail", "diskslow")
 
 
@@ -171,6 +180,9 @@ def parse_spec(spec: str) -> List[Rule]:
             rule.secs = float(kw["secs"])
         if rule.action in ("kill", "wedge") and rule.step is None:
             raise ValueError(f"{rule.action} rule needs step=N: {part!r}")
+        if rule.action == "preempt" and rule.step is None and rule.secs <= 0:
+            raise ValueError(
+                f"preempt rule needs step=N or secs=T: {part!r}")
         if rule.action in ("delay", "diskslow") and rule.secs <= 0:
             raise ValueError(f"{rule.action} rule needs secs=S: {part!r}")
         rules.append(rule)
@@ -192,6 +204,8 @@ class FaultInjector:
         # park on the event, which is never set free again for the
         # process's lifetime — exactly a wedge.
         self._wedge_fired = threading.Event()
+        # Wall-clock preempt triggers (secs= rules) ride daemon timers.
+        self._timers: List[threading.Timer] = []
 
     @property
     def wedged(self) -> bool:
@@ -211,22 +225,27 @@ class FaultInjector:
             self._rules.extend(parse_spec(spec))
             self.active = True
             logger.warning("fault injection armed: %s", spec)
+            self._arm_preempt_timers()
 
     def install(self, rules: List[Rule]):
         with self._lock:
             self._env_loaded = True  # explicit install overrides env
+            self._cancel_timers()
             self._rules = list(rules)
             self._step = 0
             self.active = bool(self._rules)
+            self._arm_preempt_timers()
 
     def add_rule(self, rule: Rule):
         with self._lock:
             self._env_loaded = True
             self._rules.append(rule)
             self.active = True
+            self._arm_preempt_timers()
 
     def clear(self):
         with self._lock:
+            self._cancel_timers()
             self._rules = []
             self._step = 0
             self._env_loaded = True
@@ -239,11 +258,45 @@ class FaultInjector:
     def reload_env(self):
         """Re-read HOROVOD_FAULT_INJECT (tests mutate the env)."""
         with self._lock:
+            self._cancel_timers()
             self._rules = []
             self._step = 0
             self._env_loaded = False
             self._load_env()
             self.active = bool(self._rules)
+
+    # -- preempt (announced-preemption) triggers -----------------------
+    def _arm_preempt_timers(self):
+        """Arm wall-clock ``preempt:secs=T`` triggers (lock held).
+        Step-triggered preempt rules fire from advance_step instead.
+        ``hits`` doubles as the armed/fired-once marker."""
+        own_rank = env_cfg.get_int(env_cfg.RANK, -1)
+        for r in self._rules:
+            if r.action != "preempt" or r.step is not None or r.hits:
+                continue
+            if r.rank is not None and r.rank != own_rank:
+                continue
+            r.hits = 1
+            t = threading.Timer(r.secs, self._fire_preempt,
+                                args=(f"after {r.secs:.1f}s",))
+            t.daemon = True
+            t.name = "hvd-fault-preempt"
+            self._timers.append(t)
+            t.start()
+
+    def _cancel_timers(self):
+        for t in self._timers:
+            t.cancel()
+        self._timers = []
+
+    @staticmethod
+    def _fire_preempt(what: str):
+        """Deliver the notice through the REAL signal path (os.kill to
+        self), so the drain plane's installed handler — not a shortcut —
+        does the work, exactly as a platform-delivered notice would."""
+        logger.error("fault injection: preemption notice (%s)", what)
+        _fault_counter("preempt").inc()
+        os.kill(os.getpid(), env_cfg.preempt_signal())
 
     # -- triggers --------------------------------------------------------
     def advance_step(self) -> int:
@@ -253,6 +306,7 @@ class FaultInjector:
         if not self.active:
             return 0
         wedge = False
+        preempt = False
         with self._lock:
             self._load_env()
             self._step += 1
@@ -281,6 +335,14 @@ class FaultInjector:
                     _fault_counter("wedge").inc()
                     self._wedge_fired.set()
                     wedge = True
+                if r.action == "preempt" and step >= r.step and not r.hits:
+                    r.hits = 1
+                    preempt = True
+        if preempt:
+            # Deliver OUTSIDE the lock: the drain handler runs at the
+            # next bytecode boundary of this (main) thread and must
+            # never find the injector lock held.
+            self._fire_preempt(f"at step {step}")
         if wedge or self._wedge_fired.is_set():
             # Park OUTSIDE the lock (other threads must still reach
             # their own hooks to park themselves).
@@ -306,7 +368,8 @@ class FaultInjector:
             self._load_env()
             verdict = PASS
             for r in self._rules:
-                if r.action in ("kill", "wedge") or r.action in _DISK_ACTIONS:
+                if r.action in ("kill", "wedge", "preempt") \
+                        or r.action in _DISK_ACTIONS:
                     continue
                 if r.rank is not None and r.rank != rank:
                     continue

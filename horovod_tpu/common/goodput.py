@@ -180,6 +180,12 @@ class GoodputLedger:
         self.prior_step_exposed_seconds = 0.0
         self.downtime_seconds = 0.0     # restart + elastic-reset badput
         self.prior_downtime_seconds = 0.0
+        # Announced-preemption badput (docs/fault_tolerance.md): drain
+        # windows and their re-mesh, kept apart from `downtime_seconds`
+        # (the *failure* bucket) — a fleet losing time to spot reclaims
+        # needs different remediation than one losing time to crashes.
+        self.preempt_seconds = 0.0
+        self.prior_preempt_seconds = 0.0
         self.replayed_steps = 0
         self.prior_replayed_steps = 0
         self.replay_seconds = 0.0
@@ -199,6 +205,7 @@ class GoodputLedger:
         # Disruption bracket (elastic reset / restore in progress).
         self._disrupt_t0: Optional[float] = None
         self._disrupt_reason = ""
+        self._disrupt_bucket = "failure"
         self._last_stamp_mono = 0.0
         # Stamp ownership is fixed at construction: only the ORIGINAL
         # rank 0 (the one that loaded the stamp) may write it. A
@@ -233,6 +240,11 @@ class GoodputLedger:
             "horovod_restart_downtime_seconds_total",
             "Seconds of job downtime: kill-all restart gaps plus "
             "elastic reset/restore windows")
+        self._m_preempt = registry.counter(
+            "horovod_preemption_badput_seconds_total",
+            "Seconds of job downtime attributed to announced "
+            "preemptions (graceful drains + their re-mesh), kept apart "
+            "from the failure bucket")
         self._m_replayed = registry.counter(
             "horovod_replayed_steps_total",
             "Steps re-executed after a restore (work done twice)")
@@ -286,10 +298,17 @@ class GoodputLedger:
         self._m_generation.set(self.generation)
         # The gap since the previous lifetime's last stamp is restart
         # downtime: the job existed (its ledger says so) but made no
-        # progress. Granularity = the stamp cadence.
+        # progress. Granularity = the stamp cadence. A stamp released
+        # by a graceful drain (`draining`) means the previous lifetime
+        # ended by ANNOUNCED preemption, so its gap belongs in the
+        # preemption bucket, not the failure bucket.
         gap = max(now - float(doc.get("stamp_wall", now)), 0.0)
-        self.downtime_seconds += gap
-        self._m_downtime.inc(gap)
+        if doc.get("draining"):
+            self.preempt_seconds += gap
+            self._m_preempt.inc(gap)
+        else:
+            self.downtime_seconds += gap
+            self._m_downtime.inc(gap)
         self.prior_steps = int(doc.get("steps", 0))
         self.prior_step_seconds = float(doc.get("step_seconds", 0.0))
         self.prior_timed_steps = int(doc.get("timed_steps", 0))
@@ -300,6 +319,7 @@ class GoodputLedger:
         self.prior_step_stall_seconds = float(
             doc.get("step_stall_seconds", 0.0))
         self.prior_downtime_seconds = float(doc.get("downtime_seconds", 0.0))
+        self.prior_preempt_seconds = float(doc.get("preempt_seconds", 0.0))
         self.prior_replayed_steps = int(doc.get("replayed_steps", 0))
         self.prior_replay_seconds = float(doc.get("replay_seconds", 0.0))
         self.current_step = int(doc.get("current_step", 0))
@@ -333,6 +353,8 @@ class GoodputLedger:
                                    + self.step_stall_seconds),
             "downtime_seconds": (self.prior_downtime_seconds
                                  + self.downtime_seconds),
+            "preempt_seconds": (self.prior_preempt_seconds
+                                + self.preempt_seconds),
             "replayed_steps": self.prior_replayed_steps + self.replayed_steps,
             "replay_seconds": (self.prior_replay_seconds
                                + self.replay_seconds),
@@ -340,6 +362,114 @@ class GoodputLedger:
             "committed_step": self.committed_step,
             "source_rank": self._source_rank,
         }
+
+    def release_stamp(self) -> bool:
+        """Graceful-drain handoff, owner side (docs/goodput.md "Stamp
+        handoff"): force one final stamp marked ``draining`` — written
+        synchronously to both the file and the KV mirror, because this
+        process is about to exit and the lazy mirror worker may never
+        get another turn. The mark does two jobs: a follow-up lifetime
+        attributes its restart gap to the *preemption* bucket, and a
+        survivor promoted to rank 0 may adopt stamp ownership
+        (``try_adopt_stamp``) instead of durable accounting dying with
+        the drained process."""
+        if not self.enabled or self.rank != 0 or not self._stamp_owner:
+            return False
+        self._last_stamp_mono = time.monotonic()
+        doc = self._stamp_doc()
+        doc["draining"] = True
+        if self.stamp_path:
+            try:
+                os.makedirs(os.path.dirname(self.stamp_path) or ".",
+                            exist_ok=True)
+                atomic_file.atomic_write_text(
+                    self.stamp_path, json.dumps(doc), fsync=False)
+            except OSError as e:
+                logger.warning("goodput release stamp write failed: %s", e)
+        if self._kv is not None:
+            try:
+                self._kv.put(KV_SCOPE, KV_KEY,
+                             json.dumps(doc, separators=(",", ":")).encode())
+            except Exception:
+                pass
+        logger.info("goodput: stamp released at step %d (draining)",
+                    self.committed_step)
+        return True
+
+    def try_adopt_stamp(self) -> bool:
+        """Graceful-drain handoff, survivor side: a worker promoted to
+        rank 0 by elastic renumbering adopts the durable ledger IF the
+        previous owner released it (a ``draining`` stamp). The released
+        totals become this ledger's prior lifetimes and its OWN window
+        is dropped — the released stamp already accounts the same job
+        wall-clock from the rank-0 view, so keeping both would double-
+        count. Without a released stamp this is a no-op: an unreleased
+        stamp means the owner may still be alive, and overwriting the
+        job ledger with fresh-lifetime numbers is exactly what
+        construction-time ownership exists to prevent."""
+        if not self.enabled or self._stamp_owner:
+            return False
+        if self.stamp_path is None:
+            self.stamp_path = _default_stamp_path()
+        if self._kv is None:
+            self._kv = _kv_from_env()
+        doc = self._read_stamp_doc()
+        if (doc is None or doc.get("format") != STAMP_FORMAT
+                or not doc.get("draining")):
+            return False
+        with self._lock:
+            self.job_start_wall = float(doc.get("job_start_wall",
+                                                self.job_start_wall))
+            self.generation = int(doc.get("generation", 0)) + 1
+            # Fold the released stamp in as the prior lifetimes and
+            # zero this ledger's own window (see docstring).
+            self.prior_steps = int(doc.get("steps", 0))
+            self.steps = 0
+            self.prior_step_seconds = float(doc.get("step_seconds", 0.0))
+            self.step_seconds = 0.0
+            self.prior_timed_steps = int(doc.get("timed_steps", 0))
+            self.timed_steps = 0
+            self.prior_exposed_seconds = float(
+                doc.get("exposed_seconds", 0.0))
+            self.exposed_seconds = 0.0
+            self.prior_step_exposed_seconds = float(
+                doc.get("step_exposed_seconds", 0.0))
+            self.step_exposed_seconds = 0.0
+            self.prior_stall_seconds = float(doc.get("stall_seconds", 0.0))
+            self.stall_seconds = 0.0
+            self.prior_step_stall_seconds = float(
+                doc.get("step_stall_seconds", 0.0))
+            self.step_stall_seconds = 0.0
+            self.prior_downtime_seconds = float(
+                doc.get("downtime_seconds", 0.0))
+            self.downtime_seconds = 0.0
+            self.prior_preempt_seconds = float(
+                doc.get("preempt_seconds", 0.0))
+            self.preempt_seconds = 0.0
+            self.prior_replayed_steps = int(doc.get("replayed_steps", 0))
+            self.replayed_steps = 0
+            self.prior_replay_seconds = float(doc.get("replay_seconds", 0.0))
+            self.replay_seconds = 0.0
+            # Steps are collective, so the released cursor and this
+            # rank's own agree up to the commit racing the drain; the
+            # max is right either way.
+            self.current_step = max(self.current_step,
+                                    int(doc.get("current_step", 0)))
+            self.committed_step = max(self.committed_step,
+                                      int(doc.get("committed_step", 0)))
+            self._source_rank = max(self._source_rank,
+                                    int(doc.get("source_rank", 0)))
+            self.rank = 0
+            self._stamp_owner = True
+        self._m_generation.set(self.generation)
+        logger.info(
+            "goodput: adopted the released ledger stamp (generation %d, "
+            "step cursor %d); durable stamping continues in this process",
+            self.generation, self.current_step)
+        # Claim immediately: the next reader sees an un-released stamp
+        # owned by this lifetime.
+        self.stamp(force=True)
+        return True
 
     def stamp(self, force: bool = False):
         """Persist the ledger stamp (the ORIGINAL rank 0 only,
@@ -547,25 +677,37 @@ class GoodputLedger:
                 "goodput: restore to step %d loses %d executed steps "
                 "(~%.1fs of replay badput)", target, lost, replay_s)
 
-    def disruption_begin(self, reason: str = ""):
+    def disruption_begin(self, reason: str = "", bucket: str = "failure"):
         """A failure/reset window opened: wall time until
         ``disruption_end`` is restart-badput, and step boundaries are
-        suspended so the gap never reads as one giant step."""
+        suspended so the gap never reads as one giant step. `bucket`
+        picks the attribution: ``"failure"`` (the default — crashes,
+        liveness evictions, unannounced loss) or ``"preemption"``
+        (announced drains; docs/fault_tolerance.md). An already-open
+        window keeps its original reason but may be UPGRADED to the
+        preemption bucket: the drain notice often arrives after the
+        collective failure it caused was already bracketed."""
         if not self.enabled:
             return
+        if bucket not in ("failure", "preemption"):
+            bucket = "failure"
         with self._lock:
             if self._disrupt_t0 is None:
                 self._disrupt_t0 = time.monotonic()
                 self._disrupt_reason = reason
+                self._disrupt_bucket = bucket
+            elif bucket == "preemption":
+                self._disrupt_bucket = bucket
             self._boundary_ns = None
         tracer = self.tracer
         if tracer is not None and getattr(tracer, "enabled", False):
             tracer.instant("goodput.disruption", cat="goodput",
-                           args={"reason": reason})
+                           args={"reason": reason, "bucket": bucket})
 
     def disruption_end(self):
         """Training is live again; the window closes into the
-        restart-downtime bucket. No-op without an open window."""
+        restart-downtime bucket (or the preemption bucket for an
+        announced drain). No-op without an open window."""
         if not self.enabled:
             return
         with self._lock:
@@ -573,12 +715,20 @@ class GoodputLedger:
             self._disrupt_t0 = None
             reason = self._disrupt_reason
             self._disrupt_reason = ""
+            bucket = self._disrupt_bucket
+            self._disrupt_bucket = "failure"
             if t0 is None:
                 return
             dt = max(time.monotonic() - t0, 0.0)
-            self.downtime_seconds += dt
-        self._m_downtime.inc(dt)
-        logger.info("goodput: %.2fs of downtime (%s)", dt,
+            if bucket == "preemption":
+                self.preempt_seconds += dt
+            else:
+                self.downtime_seconds += dt
+        if bucket == "preemption":
+            self._m_preempt.inc(dt)
+        else:
+            self._m_downtime.inc(dt)
+        logger.info("goodput: %.2fs of %s downtime (%s)", dt, bucket,
                     reason or "disruption")
         self.stamp()
 
@@ -608,6 +758,8 @@ class GoodputLedger:
                 "stall_skips": self.stall_skips,
                 "downtime_seconds": (self.prior_downtime_seconds
                                      + self.downtime_seconds),
+                "preempt_seconds": (self.prior_preempt_seconds
+                                    + self.preempt_seconds),
                 "replayed_steps": (self.prior_replayed_steps
                                    + self.replayed_steps),
                 "replay_seconds": (self.prior_replay_seconds
@@ -657,12 +809,14 @@ class GoodputLedger:
                 t["step_stall_seconds"], 4),
             "ckpt_backpressure_skips": t["stall_skips"],
             "restart_downtime_seconds": round(t["downtime_seconds"], 4),
+            "preemption_seconds": round(t["preempt_seconds"], 4),
             "replayed_steps": t["replayed_steps"],
             "replay_seconds": round(t["replay_seconds"], 4),
             # Wall time outside steps and outside disruptions: init,
             # input pipeline, evaluation — unattributed overhead.
             "other_seconds": round(
-                max(wall - t["step_seconds"] - t["downtime_seconds"], 0.0),
+                max(wall - t["step_seconds"] - t["downtime_seconds"]
+                    - t["preempt_seconds"], 0.0),
                 4),
         }
         out = {
@@ -706,6 +860,7 @@ class GoodputLedger:
             "exposed_comm_seconds": v["badput"]["exposed_comm_seconds"],
             "restart_downtime_seconds":
                 v["badput"]["restart_downtime_seconds"],
+            "preemption_seconds": v["badput"]["preemption_seconds"],
             "replayed_steps": v["badput"]["replayed_steps"],
         }
 
@@ -776,13 +931,17 @@ def for_engine(registry, rank: int, tracer=None) -> GoodputLedger:
         led = current()
         if rank == 0 and led.rank != 0 and not led._stamp_owner:
             # A survivor promoted to coordinator by elastic
-            # renumbering: it never loaded the job-lifetime stamp, so
-            # it must not overwrite it with fresh-lifetime numbers —
-            # durable stamping stays with the original rank 0's
-            # lifetime (per-lifetime accounting continues locally).
-            logger.info(
-                "goodput: promoted to rank 0 mid-job; durable ledger "
-                "stamping remains disabled in this process")
+            # renumbering. If the previous owner RELEASED the stamp (a
+            # graceful drain), adopt it — ownership hands off and
+            # durable accounting continues here. Otherwise it never
+            # loaded the job-lifetime stamp, so it must not overwrite
+            # it with fresh-lifetime numbers — durable stamping stays
+            # with the original rank 0's lifetime (per-lifetime
+            # accounting continues locally).
+            if not led.try_adopt_stamp():
+                logger.info(
+                    "goodput: promoted to rank 0 mid-job; durable ledger "
+                    "stamping remains disabled in this process")
         led.rank = rank  # elastic renumbering: the live rank wins
     else:
         led = GoodputLedger(registry=registry, rank=rank)
@@ -828,10 +987,18 @@ def note_ckpt_skip():
         led.note_ckpt_skip()
 
 
-def disruption_begin(reason: str = ""):
+def disruption_begin(reason: str = "", bucket: str = "failure"):
     led = active()
     if led is not None:
-        led.disruption_begin(reason)
+        led.disruption_begin(reason, bucket=bucket)
+
+
+def release_stamp():
+    """Graceful-drain hook: the draining owner's final ``draining``
+    stamp (no-op without a live owning ledger)."""
+    led = active()
+    if led is not None:
+        led.release_stamp()
 
 
 def disruption_end():

@@ -350,6 +350,17 @@ class HeartbeatMonitor:
             f"{self.miss_limit} x HOROVOD_HEARTBEAT_INTERVAL_SECONDS="
             f"{self.interval:g})"
         )
+        # If the fleet has a drain in flight the silence is probably the
+        # PLAN (a preempted peer checkpointing, then exiting) — say so,
+        # so operators and the badput attribution don't read an
+        # announced preemption as a mystery failure.
+        try:
+            from . import drain as drain_mod
+
+            if drain_mod.fleet_draining():
+                reason += " [peer was draining: announced preemption]"
+        except Exception:  # pragma: no cover - attribution only
+            pass
         logger.error("liveness: %s", reason)
         self._m_dead.inc()
         self.verdicts[peer] = reason

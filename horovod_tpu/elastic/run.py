@@ -4,7 +4,7 @@ from __future__ import annotations
 import functools
 from typing import Callable
 
-from ..common import basics, goodput, telemetry
+from ..common import basics, drain, goodput, telemetry
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 from .state import State
@@ -70,6 +70,11 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
 
     notification_manager.init()
     notification_manager.register_listener(state)
+    # Drain plane (docs/fault_tolerance.md "Announced preemption"):
+    # managed mode on every rank alike — a preemption notice now drains
+    # at a commit boundary (state.py commit_barrier) instead of exiting
+    # from the handler.
+    drain.coordinator.install(managed=True)
     ckpt_mgr = checkpoint.manager_from_env()
     if ckpt_mgr is not None and not state.supports_durability():
         # A state without the hooks would commit (empty) checkpoints it
@@ -104,8 +109,17 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
-                logger.warning("collective failure; restoring last commit")
-                goodput.disruption_begin("collective failure")
+                # A peer that announced a drain exits on purpose; its
+                # FIN fails this collective immediately (no liveness
+                # timeout) and the window belongs to the `preemption`
+                # bucket, not `failure` (docs/goodput.md).
+                peer_drained = drain.fleet_draining()
+                logger.warning(
+                    "collective failure%s; restoring last commit",
+                    " (peer draining)" if peer_drained else "")
+                goodput.disruption_begin(
+                    "collective failure",
+                    bucket="preemption" if peer_drained else "failure")
                 _m_restores.inc()
                 state.restore()
                 # In-memory rollback to the last commit: steps past it
@@ -114,7 +128,10 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
                 logger.info("hosts updated; re-initializing")
-                goodput.disruption_begin("hosts updated")
+                goodput.disruption_begin(
+                    "hosts updated",
+                    bucket="preemption" if drain.fleet_draining()
+                    else "failure")
                 _m_host_updates.inc()
                 skip_sync = e.skip_sync
             _reset()
@@ -135,3 +152,6 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
             if checkpoint.current() is ckpt_mgr:
                 checkpoint.set_current(None)
         notification_manager.remove_listener(state)
+        # Back to unmanaged: a preemption notice during teardown (the
+        # launcher's own stop path) exits cleanly from the handler.
+        drain.coordinator.set_managed(False)

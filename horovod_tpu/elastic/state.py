@@ -56,9 +56,16 @@ class State:
         # committed-step cursor replay accounting rewinds to. BEFORE
         # the host-update check for the same reason the snapshot is:
         # a HostsUpdatedInterrupt must not lose the committed step.
-        from ..common import goodput
+        from ..common import drain, goodput
 
         goodput.note_commit()
+        # Drain plane (docs/fault_tolerance.md "Announced preemption"):
+        # a pending preemption notice anywhere in the world completes
+        # here — all ranks force this commit durable together and the
+        # draining rank departs via WorkerPreempted. BEFORE the
+        # host-update check: the drain must hand off against the commit
+        # that just landed, not be lost to a reset.
+        drain.commit_barrier(self)
         self.check_host_updates()
 
     def check_host_updates(self):

@@ -605,6 +605,47 @@ class Engine:
                 if ctrl.is_coordinator:
                     self._fleet_alerts = alerts_mod.FleetAlerts(self.size)
                     ctrl.alert_sink = self._fleet_alerts
+                    # Mirror the fleet verdicts to the rendezvous KV
+                    # (``alerts/fleet``) each sampler tick: the driver-
+                    # side elasticity controller reads firing_by_rule
+                    # there to name straggler ranks worth draining out
+                    # (runner/elastic/controller.py). Best-effort — a
+                    # down KV must never stall the sampler.
+                    from ..common.drain import _kv_from_env
+
+                    kv = _kv_from_env()
+                    if kv is not None:
+                        import json as _json
+
+                        fleet = self._fleet_alerts
+                        inflight = {"busy": False}
+
+                        def _mirror_alerts(_store, _kv=kv, _fleet=fleet):
+                            # Ship off-thread, never overlapping: a put
+                            # into a down KV retries with backoff, and
+                            # that wait belongs to a throwaway daemon
+                            # thread, not the sampler tick.
+                            if inflight["busy"]:
+                                return
+                            inflight["busy"] = True
+                            snap = _fleet.snapshot()
+
+                            def _send():
+                                try:
+                                    _kv.put("alerts", "fleet", _json.dumps(
+                                        {"wall": time.time(),
+                                         "firing_by_rule":
+                                             snap["firing_by_rule"]},
+                                        separators=(",", ":")).encode())
+                                except Exception:
+                                    pass
+                                finally:
+                                    inflight["busy"] = False
+
+                            threading.Thread(target=_send, daemon=True,
+                                             name="hvd-alerts-kv").start()
+
+                        self.sampler.add_tick_callback(_mirror_alerts)
             self.sampler.start()
             for exp in self._exporters:
                 if isinstance(exp, metrics_export.MetricsHTTPServer):

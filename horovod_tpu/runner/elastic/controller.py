@@ -1,0 +1,262 @@
+"""Goodput-driven elasticity controller (docs/elastic.md "The
+elasticity controller").
+
+A driver-side loop that closes the observability loop the rest of the
+stack already publishes into the rendezvous KV: the goodput stamp
+mirror (``goodput/status``, common/goodput.py), the coordinator's
+fleet-alert mirror (``alerts/fleet``, engine/engine.py), and — when
+several jobs share one rendezvous server — the capacity grant the
+server arbitrates under ``capacity/grant`` (runner/rendezvous_server.py).
+From those plus the driver's own liveness view it makes one of three
+calls per tick:
+
+    scale_up    idle capacity exists and the grant allows it — resume()
+                the driver so the next activation folds the slots in.
+    scale_down  the capacity grant shrank below the current world, or a
+                persistently alert-firing straggler rank is dragging
+                fleet goodput — hand the victim worker a *preemption
+                notice* (the configured drain signal), so the shrink
+                rides the graceful-drain path: checkpoint-now,
+                announced eviction, no failure strike, no liveness
+                timeout.
+    hold        anything else (including: a drain already in flight —
+                the drain path owns that re-mesh).
+
+Decisions are rate-limited by a cooldown (3 ticks) so one bad stamp
+cannot flap the mesh, counted per decision kind
+(``horovod_controller_decisions_total``), and mirrored to the KV at
+``controller/last`` for operators. ``decide()`` is pure — the whole
+policy is unit-testable without a driver (tests/test_preemption.py).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+from ...common import telemetry
+from ...utils import env as env_cfg
+from ...utils.logging import get_logger
+
+logger = get_logger()
+
+SCALE_UP = "scale_up"
+SCALE_DOWN = "scale_down"
+HOLD = "hold"
+
+# A straggler eviction needs evidence, not one noisy tick: the same
+# rank must be named by the alert mirror on this many consecutive
+# controller ticks before it is drained out.
+STRAGGLER_STRIKES = 3
+
+# Alert rules whose firing names a rank as a straggler worth shrinking
+# around (the stall/exposure families; an allreduce-latency alert names
+# a symptom, not a culprit).
+STRAGGLER_RULES = ("stall", "straggler", "slow_rank", "exposed")
+
+
+def decide(*, current_np: int, min_np: int, max_np: Optional[int],
+           available_slots: int, grant: Optional[int] = None,
+           straggler_rank: Optional[int] = None,
+           fleet_draining: bool = False) -> Tuple[str, int, str]:
+    """Pure policy: (action, target_np, reason).
+
+    Precedence: an in-flight drain freezes everything; then the
+    capacity grant (an outside authority) binds in both directions;
+    then straggler eviction; then opportunistic growth."""
+    if fleet_draining:
+        return (HOLD, current_np,
+                "drain in flight; the drain path owns the re-mesh")
+    cap = max_np if max_np is not None else available_slots
+    if grant is not None:
+        cap = min(cap, grant)
+        if grant < current_np and max(grant, min_np) < current_np:
+            return (SCALE_DOWN, max(grant, min_np),
+                    f"capacity grant {grant} below current world "
+                    f"{current_np}")
+    if straggler_rank is not None and current_np - 1 >= min_np:
+        return (SCALE_DOWN, current_np - 1,
+                f"rank {straggler_rank} named straggler for "
+                f"{STRAGGLER_STRIKES} consecutive ticks")
+    target = min(available_slots, cap)
+    if target > current_np:
+        return (SCALE_UP, target,
+                f"{available_slots} slots available, world is "
+                f"{current_np}")
+    return (HOLD, current_np, "steady state")
+
+
+class ElasticityController:
+    """Periodic decide-and-act loop around an ElasticDriver."""
+
+    def __init__(self, driver, interval: Optional[float] = None):
+        self.driver = driver
+        self.interval = (env_cfg.controller_interval_seconds()
+                         if interval is None else interval)
+        self.cooldown = self.interval * 3.0
+        self._ns = env_cfg.job_kv_prefix()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_action_mono: Optional[float] = None
+        # rank -> consecutive ticks it was named by a straggler rule
+        self._strikes: Dict[int, int] = {}
+        self._m = {
+            d: telemetry.counter(
+                "horovod_controller_decisions_total",
+                "Elasticity controller decisions by kind",
+                labels={"decision": d})
+            for d in (SCALE_UP, SCALE_DOWN, HOLD)
+        }
+
+    # -- KV readings ---------------------------------------------------
+    def _kv_json(self, key: str) -> Optional[dict]:
+        raw = self.driver.rendezvous.handle_get(f"{self._ns}{key}")
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _read_grant(self) -> Optional[int]:
+        if not self._ns:
+            return None  # capacity arbitration is a namespaced feature
+        raw = self.driver.rendezvous.handle_get(f"{self._ns}capacity/grant")
+        if raw is None:
+            return None
+        try:
+            return int(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _straggler_from_alerts(self) -> Optional[int]:
+        """A rank is a straggler when a stall-family alert names it for
+        STRAGGLER_STRIKES consecutive ticks; one clean tick clears its
+        strikes (a transient blip must not cost a machine)."""
+        doc = self._kv_json("alerts/fleet") or {}
+        named = set()
+        for rule, ranks in (doc.get("firing_by_rule") or {}).items():
+            if any(s in rule for s in STRAGGLER_RULES):
+                named.update(int(r) for r in ranks)
+        self._strikes = {r: self._strikes.get(r, 0) + 1 for r in named}
+        ripe = [r for r, n in self._strikes.items()
+                if n >= STRAGGLER_STRIKES]
+        return min(ripe) if ripe else None
+
+    # -- act -----------------------------------------------------------
+    def tick(self) -> Tuple[str, int, str]:
+        """One observe→decide→act round; returns the decision."""
+        drv = self.driver
+        with drv._lock:
+            current_np = len(drv._assignments)
+            draining = bool(drv._draining)
+        available = drv.host_manager.available_slots()
+        grant = self._read_grant()
+        straggler = self._straggler_from_alerts()
+        action, target, reason = decide(
+            current_np=current_np, min_np=drv.min_np, max_np=drv.max_np,
+            available_slots=available, grant=grant,
+            straggler_rank=straggler, fleet_draining=draining)
+        now = time.monotonic()
+        if action != HOLD and self._last_action_mono is not None \
+                and now - self._last_action_mono < self.cooldown:
+            action, target, reason = (
+                HOLD, current_np,
+                f"cooldown ({self.cooldown:.0f}s) after the last action")
+        self._m[action].inc()
+        self._publish(action, target, current_np, reason)
+        if action == HOLD:
+            return action, target, reason
+        self._last_action_mono = now
+        logger.warning("elasticity controller: %s %d -> %d (%s)",
+                       action, current_np, target, reason)
+        if action == SCALE_UP:
+            drv.resume()
+        else:
+            self._drain_out(current_np - target, straggler)
+        return action, target, reason
+
+    def _drain_out(self, count: int, straggler_rank: Optional[int]):
+        """Shrink by handing workers the preemption signal — the SAME
+        notice the platform would send, so the whole graceful-drain
+        machinery (checkpoint-now, announced eviction, quarantine
+        without strikes) does the rest. Victims: the named straggler
+        first, then the highest ranks (the ones a shrink renumbers
+        away anyway)."""
+        drv = self.driver
+        sig = env_cfg.preempt_signal()
+        with drv._lock:
+            by_rank = sorted(
+                ((slot.rank, key) for key, slot in drv._assignments.items()
+                 if key not in drv._draining),
+                reverse=True)
+            victims = []
+            if straggler_rank is not None:
+                victims = [(r, k) for r, k in by_rank
+                           if r == straggler_rank]
+            for r, k in by_rank:
+                if len(victims) >= count:
+                    break
+                if (r, k) not in victims:
+                    victims.append((r, k))
+            recs = [(r, k, drv._workers.get(k)) for r, k in victims]
+        for rank, key, rec in recs:
+            if rec is None or rec.proc.poll() is not None:
+                continue
+            self._strikes.pop(rank, None)
+            logger.warning(
+                "elasticity controller: sending preemption notice "
+                "(signal %d) to rank %d (%s:%d)", sig, rank, *key)
+            try:
+                rec.proc.send_signal(sig)
+            except OSError as e:  # pragma: no cover - already gone
+                logger.warning("preempt signal to %s:%d failed: %s",
+                               key[0], key[1], e)
+
+    def _publish(self, action: str, target: int, current_np: int,
+                 reason: str):
+        try:
+            self.driver.rendezvous.handle_put(
+                f"{self._ns}controller/last",
+                json.dumps({
+                    "wall": time.time(), "action": action,
+                    "current_np": current_np, "target_np": target,
+                    "reason": reason,
+                }, separators=(",", ":")).encode())
+        except Exception:  # pragma: no cover - observability only
+            pass
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        if self.interval <= 0 or self._thread is not None:
+            return
+        # Declare our appetite so a shared server can arbitrate: want
+        # max_np (or min_np when uncapped — a modest ask beats hogging).
+        if self._ns:
+            want = self.driver.max_np or self.driver.min_np
+            try:
+                self.driver.rendezvous.handle_put(
+                    f"{self._ns}capacity/want", str(want).encode())
+            except Exception:  # pragma: no cover
+                pass
+        self._thread = threading.Thread(
+            target=self._loop, name="elasticity-controller", daemon=True)
+        self._thread.start()
+        logger.info("elasticity controller started (interval %.0fs)",
+                    self.interval)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            if self.driver.finished:
+                return
+            try:
+                self.tick()
+            except Exception as e:  # a bad tick must not kill the loop
+                logger.warning("elasticity controller tick failed: %s", e)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -91,6 +91,9 @@ class HostManager:
         self._current: Dict[str, int] = {}
         # host -> blacklist expiry (monotonic; inf = permanent)
         self._blacklist: Dict[str, float] = {}
+        # host -> drain-quarantine expiry (monotonic). Strike-free
+        # sibling of the blacklist for ANNOUNCED preemptions.
+        self._quarantine: Dict[str, float] = {}
         self._strikes: Dict[str, int] = {}
         self._cooldown = (env_cfg.blacklist_cooldown_seconds()
                           if cooldown is None else cooldown)
@@ -116,17 +119,17 @@ class HostManager:
             # present in active, i.e. an ADDED update — otherwise the
             # recovered host is invisible (NO_UPDATE) and a driver
             # parked on "not enough slots" never re-assigns.
-            prev_blacklist = set(self._blacklist)
-            blacklist = self._active_blacklist()
+            prev_excluded = set(self._blacklist) | set(self._quarantine)
+            excluded = self._active_blacklist() | self._active_quarantine()
             prev_active = {
                 h: s for h, s in self._current.items()
-                if h not in prev_blacklist
+                if h not in prev_excluded
             }
             res = HostUpdateResult.NO_UPDATE
             for h in new:
                 if h not in self._order:
                     self._order.append(h)
-            active = {h: s for h, s in new.items() if h not in blacklist}
+            active = {h: s for h, s in new.items() if h not in excluded}
             if set(active) - set(prev_active) or any(
                 active.get(h, 0) > prev_active.get(h, 0) for h in active
             ):
@@ -144,12 +147,40 @@ class HostManager:
         """Active (hostname, slots), oldest first."""
         with self._lock:
             blacklist = self._active_blacklist()
+            quarantined = self._active_quarantine()
             return [
                 (h, self._current[h])
                 for h in self._order
                 if h in self._current and h not in blacklist
-                and self._current[h] > 0
+                and h not in quarantined and self._current[h] > 0
             ]
+
+    def _active_quarantine(self) -> set:
+        """Prune expired quarantines; call with the lock held."""
+        now = time.monotonic()
+        for h in [h for h, exp in self._quarantine.items() if exp <= now]:
+            del self._quarantine[h]
+            logger.info("drain quarantine expired for host %s; it is "
+                        "eligible again", h)
+        return set(self._quarantine)
+
+    def quarantine(self, host: str, seconds: float):
+        """Temporarily exclude a DRAINING host from assignment
+        (docs/fault_tolerance.md "Announced preemption"). Deliberately
+        NOT the blacklist: a drain is intentional, so it must cost the
+        host no failure strikes and never escalate to permanent — the
+        platform usually takes the machine away anyway, and if it
+        survives the quarantine it is welcome back."""
+        with self._lock:
+            expiry = time.monotonic() + max(seconds, 0.0)
+            self._quarantine[host] = max(
+                expiry, self._quarantine.get(host, 0.0))
+            logger.warning("quarantining draining host %s for %.0fs",
+                           host, max(seconds, 0.0))
+
+    def is_quarantined(self, host: str) -> bool:
+        with self._lock:
+            return host in self._active_quarantine()
 
     def blacklist(self, host: str):
         from ...common import telemetry

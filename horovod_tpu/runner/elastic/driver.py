@@ -33,6 +33,7 @@ logger = get_logger()
 
 INVALID_ROW = "-1,-1,-1,-1,-1,-1"
 READY_PREFIX = "ready_e"
+DRAIN_PREFIX = "drain_e"
 
 
 class _WorkerRecord:
@@ -94,6 +95,18 @@ class ElasticDriver:
         # the recovery-duration histogram when the next activation
         # completes (failure -> re-meshed).
         self._failure_t0: Optional[float] = None
+        # Drain plane (docs/fault_tolerance.md "Announced preemption"):
+        # slots whose worker ANNOUNCED a drain. Their exits are
+        # intentional (never failures, never blacklist strikes), their
+        # liveness verdicts are moot, and the notice -> re-meshed
+        # window gets its own histogram.
+        self._draining: Dict[Tuple[str, int], float] = {}
+        self._drain_t0: Optional[float] = None
+        # Per-job KV namespace: the driver prefixes every key it
+        # publishes/reads exactly like namespaced RendezvousClients do,
+        # so a trainer and a server job can share one server
+        # (docs/elastic.md "Sharing one rendezvous server").
+        self._ns = env_cfg.job_kv_prefix()
         self._m_evictions = telemetry.counter(
             "horovod_elastic_evictions_total",
             "Reset-barrier slots evicted at the ready deadline "
@@ -102,7 +115,18 @@ class ElasticDriver:
             "horovod_elastic_recovery_seconds",
             "Failure detection to re-meshed activation", min_exp=-4,
             max_exp=10)
+        self._m_drain = telemetry.histogram(
+            "horovod_drain_evict_seconds",
+            "Drain notice to re-meshed activation (the announced-"
+            "preemption fast path — no liveness timeout)", min_exp=-4,
+            max_exp=10)
         rendezvous.put_hook = self._observe_put
+
+    def _put(self, key: str, value: bytes):
+        self.rendezvous.handle_put(f"{self._ns}{key}", value)
+
+    def _get(self, key: str):
+        return self.rendezvous.handle_get(f"{self._ns}{key}")
 
     # ------------------------------------------------------------------
     @property
@@ -156,7 +180,7 @@ class ElasticDriver:
             manifest["world_size"])
         import json as _json
 
-        self.rendezvous.handle_put(
+        self._put(
             f"{ckpt.LATEST_SCOPE}/{ckpt.RESUME_KEY}",
             _json.dumps({"step": step,
                          "world_size": manifest["world_size"]}).encode())
@@ -224,15 +248,15 @@ class ElasticDriver:
             # that lost their slot; epoch key LAST.
             scope = f"rank_and_size_e{self.epoch}"
             for (host, idx), slot in new_assignments.items():
-                self.rendezvous.handle_put(
+                self._put(
                     f"{scope}/{host}:{idx}", slot.to_response_string().encode()
                 )
             for key in self._workers:
                 if key not in new_assignments:
-                    self.rendezvous.handle_put(
+                    self._put(
                         f"{scope}/{key[0]}:{key[1]}", INVALID_ROW.encode()
                     )
-            self.rendezvous.handle_put("meta/epoch", str(self.epoch).encode())
+            self._put("meta/epoch", str(self.epoch).encode())
             self._assignments = new_assignments
 
             # Spawn processes for slots with no live worker.
@@ -255,6 +279,14 @@ class ElasticDriver:
                 self._m_recovery.observe(
                     time.monotonic() - self._failure_t0)
                 self._failure_t0 = None
+            # Drained slots that lost their assignment are evicted for
+            # good: close out the notice -> re-meshed window.
+            for key in [k for k in self._draining
+                        if k not in new_assignments]:
+                del self._draining[key]
+            if self._drain_t0 is not None and not self._draining:
+                self._m_drain.observe(time.monotonic() - self._drain_t0)
+                self._drain_t0 = None
         if notify_update:
             self._notify_workers(notify_update)
 
@@ -315,8 +347,12 @@ class ElasticDriver:
             if self._finished.is_set() or reg_epoch != self.registry.epoch:
                 return  # that barrier already resolved
             verdicts = self.registry.verdicts()
+            # Draining slots are exempt: their silence is expected (the
+            # worker is checkpointing, then exiting) and the drain path
+            # owns their eviction.
             missing = [k for k in self._assignments
-                       if f"{k[0]}:{k[1]}" not in verdicts]
+                       if f"{k[0]}:{k[1]}" not in verdicts
+                       and k not in self._draining]
             if not missing:
                 return
             stragglers = []
@@ -363,7 +399,12 @@ class ElasticDriver:
         for var in (env_cfg.CHECKPOINT_DIR, env_cfg.CHECKPOINT_INTERVAL,
                     env_cfg.CHECKPOINT_KEEP,
                     env_cfg.CHECKPOINT_COMMIT_TIMEOUT,
-                    env_cfg.CHECKPOINT_FSYNC):
+                    env_cfg.CHECKPOINT_FSYNC,
+                    # Drain/namespace plane: workers must agree with the
+                    # driver on the preempt signal, the grace budget,
+                    # and the per-job KV prefix.
+                    env_cfg.DRAIN_GRACE_SECONDS, env_cfg.PREEMPT_SIGNAL,
+                    env_cfg.JOB_NAME):
             if var in _os.environ:
                 extra_env[var] = _os.environ[var]
         proc = self._create_worker(slot, extra_env)
@@ -391,7 +432,10 @@ class ElasticDriver:
             # barrier — its verdict belongs to a previous incident.
             stale = cur is not rec
             assigned = rec.key in self._assignments
-        if rc == 0:
+            draining = rec.key in self._draining
+        if rc == 0 or draining:
+            # A draining worker's exit is the PLAN even when nonzero
+            # (killed past its grace window): success, no strike.
             if assigned and not stale:
                 self.registry.record_success(host, idx)
             # else: worker exited after an INVALID row — expected.
@@ -408,7 +452,24 @@ class ElasticDriver:
         the coordinator worker's heartbeat monitor trigger the eviction
         fast path — the driver blacklists the host that FAILED (named
         in the verdict), not the host that reported it, and does not
-        have to wait out the full ready deadline."""
+        have to wait out the full ready deadline. Drain notices from
+        preempted workers take the same fast path: quarantine + planned
+        eviction with no liveness timeout at all. With a job namespace
+        set, only keys in OUR namespace are interpreted — a co-tenant
+        job's protocol traffic is someone else's."""
+        if self._ns:
+            if not key.startswith(self._ns):
+                return
+            key = key[len(self._ns):]
+        if key.startswith(DRAIN_PREFIX):
+            epoch_part, _, ident = key[len(DRAIN_PREFIX):].partition("/")
+            try:
+                epoch = int(epoch_part)
+            except ValueError:
+                return
+            if ident and ident != "any":
+                self._on_drain_notice(epoch, ident)
+            return
         if key.startswith(VERDICT_KEY_PREFIX):
             try:
                 epoch = int(key[len(VERDICT_KEY_PREFIX):])
@@ -456,6 +517,12 @@ class ElasticDriver:
         if target is None:
             return
         (thost, idx), rec = target
+        with self._lock:
+            if (thost, idx) in self._draining:
+                # The worker announced a drain; its heartbeats stopping
+                # is the PLAN, not a failure — the drain path owns the
+                # eviction and the host must collect no strike.
+                return
         already = self.registry.verdicts().get(f"{thost}:{idx}")
         if already == FAILURE:
             return
@@ -470,6 +537,69 @@ class ElasticDriver:
                 pass
         self.registry.record_failure(thost, idx, epoch=reg_epoch)
 
+    def _on_drain_notice(self, epoch: int, ident: str):
+        """A worker announced a preemption drain (common/drain.py
+        publishes drain_e<epoch>/<identity> the moment the notice
+        lands). The announced-preemption fast path: quarantine the host
+        (strike-free), then evict on the worker's own clean exit —
+        no liveness timeout is ever waited out."""
+        host, _, idx_s = ident.rpartition(":")
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            return
+        grace = env_cfg.drain_grace_seconds()
+        key = (host, idx)
+        with self._lock:
+            if self._finished.is_set() or epoch != self.epoch:
+                return  # stale notice from a pre-reset mesh
+            if key not in self._assignments:
+                return
+            if key in self._draining:
+                return  # "requested" then "drained": one eviction
+            self._draining[key] = time.monotonic()
+            if self._drain_t0 is None:
+                self._drain_t0 = time.monotonic()
+            rec = self._workers.get(key)
+        logger.warning(
+            "drain notice from %s:%d: quarantining host, re-mesh on its "
+            "exit (announced preemption — no liveness timeout)", host, idx)
+        # Cover grace + re-mesh; a host the platform did NOT take away
+        # becomes eligible again afterwards (scale-up readds it).
+        self.host_manager.quarantine(host, max(grace * 2.0, 60.0))
+        t = threading.Thread(target=self._drain_evict, args=(key, rec),
+                             daemon=True, name=f"drain-{host}:{idx}")
+        t.start()
+
+    def _drain_evict(self, key: Tuple[str, int], rec):
+        """Wait out the drained worker's clean exit (bounded by grace +
+        margin; kill past it — the platform would have), then
+        re-activate so survivors re-mesh against the shrunk world."""
+        grace = env_cfg.drain_grace_seconds()
+        if rec is not None:
+            try:
+                rec.proc.wait(timeout=grace + 10.0)
+            except Exception:
+                logger.error(
+                    "drained worker %s:%d outlived its grace window; "
+                    "killing it", key[0], key[1])
+                try:
+                    rec.proc.kill()
+                except OSError:  # pragma: no cover - already gone
+                    pass
+        with self._lock:
+            if self._finished.is_set():
+                return
+            if key not in self._assignments:
+                return  # an activation already re-meshed without it
+        if self.host_manager.available_slots() < self.min_np:
+            logger.warning(
+                "drain of %s:%d leaves fewer than min_np=%d slots; "
+                "waiting for discovery to find replacements",
+                key[0], key[1], self.min_np)
+            return
+        self._activate(notify_update=HostUpdateResult.REMOVED)
+
     def _notify_workers(self, update_res: int):
         """Ping every live worker's notification endpoint
         (ref: runner/elastic/worker.py HostsUpdatedRequest)."""
@@ -479,7 +609,7 @@ class ElasticDriver:
         with self._lock:
             keys = list(self._workers)
         for host, idx in keys:
-            addr = self.rendezvous.handle_get(f"workers_notify/{host}:{idx}")
+            addr = self._get(f"workers_notify/{host}:{idx}")
             if addr is None:
                 continue
             h, _, p = addr.decode().rpartition(":")
@@ -505,9 +635,13 @@ class ElasticDriver:
                     w.proc.terminate()
                 except OSError:
                     pass
+        # Teardown reuses the drain protocol's grace budget: workers see
+        # SIGTERM as a preemption notice and may be mid-checkpoint, so
+        # give them the same window before escalating to SIGKILL.
+        grace = max(10.0, env_cfg.drain_grace_seconds())
         for w in workers:
             try:
-                w.proc.wait(timeout=10)
+                w.proc.wait(timeout=grace)
             except Exception:
                 try:
                     w.proc.kill()

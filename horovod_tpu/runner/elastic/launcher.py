@@ -58,11 +58,19 @@ def launch_elastic(args, command: Sequence[str],
         )
         return handle.proc
 
+    # Goodput-driven elasticity (docs/elastic.md "The elasticity
+    # controller"): off unless HOROVOD_CONTROLLER_INTERVAL_SECONDS > 0.
+    from .controller import ElasticityController
+
+    controller = ElasticityController(driver)
+
     try:
         driver.start(create_worker)
+        controller.start()
         code = driver.wait()
         return code if code is not None else 1
     finally:
+        controller.stop()
         driver.stop()
         server.stop()
 

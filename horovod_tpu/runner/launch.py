@@ -131,8 +131,11 @@ class WorkerHandle:
         return self.proc.wait(timeout=timeout)
 
     def terminate(self):
+        # Teardown reuses the drain protocol: the configured preemption
+        # signal lets workers treat launcher shutdown exactly like a
+        # platform preemption notice (checkpoint-now, clean exit).
         try:
-            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            os.killpg(os.getpgid(self.proc.pid), env_cfg.preempt_signal())
         except (ProcessLookupError, PermissionError):
             pass
 
@@ -288,9 +291,13 @@ def terminate_workers(handles: List[WorkerHandle]):
     for h in handles:
         if h.poll() is None:
             h.terminate()
+    # Workers received a preemption notice (see WorkerHandle.terminate)
+    # and may be writing their drain checkpoint: wait out the drain
+    # grace budget, not an arbitrary 10s, before escalating to SIGKILL.
+    grace = max(10.0, env_cfg.drain_grace_seconds())
     for h in handles:
         try:
-            h.wait(timeout=10)
+            h.wait(timeout=grace)
         except subprocess.TimeoutExpired:
             h.kill()
 

@@ -19,12 +19,48 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from .util import secret as secret_util
+
+# Per-job namespace layout (docs/elastic.md "Sharing one rendezvous
+# server"): clients with HOROVOD_JOB_NAME set prefix every key with
+# jobs/<name>/. The server stays namespace-agnostic for the KV protocol
+# itself; the one namespace-aware feature is capacity arbitration —
+# jobs declare how many slots they want under
+# jobs/<name>/capacity/want, and (with a fleet size configured) the
+# server answers with a fair split under jobs/<name>/capacity/grant.
+_CAPACITY_WANT_RE = re.compile(r"^jobs/([A-Za-z0-9._-]+)/capacity/want$")
+
+
+def arbitrate_capacity(wants: Dict[str, int], total: int) -> Dict[str, int]:
+    """Max-min fair integer split of ``total`` fleet slots across jobs.
+
+    Water-filling: every unsatisfied job repeatedly receives an equal
+    share of what is left, so a small job is fully satisfied before big
+    jobs start dividing the surplus. Deterministic — remainders and
+    one-slot rounds resolve in job-name order — so every caller
+    computes the same grants from the same wants."""
+    grants = {j: 0 for j in wants}
+    remaining = max(0, total)
+    unsat = sorted(j for j, w in wants.items() if w > 0)
+    while unsat and remaining > 0:
+        share = max(1, remaining // len(unsat))
+        nxt = []
+        for j in unsat:
+            take = min(wants[j] - grants[j], share, remaining)
+            grants[j] += take
+            remaining -= take
+            if grants[j] < wants[j]:
+                nxt.append(j)
+            if remaining <= 0:
+                break
+        unsat = nxt
+    return grants
 
 # Requests older than this (or from further in the future) are rejected;
 # within the window a digest may be accepted only once, so a captured
@@ -149,7 +185,15 @@ class _KVHandler(BaseHTTPRequestHandler):
 
 class RendezvousServer:
     def __init__(self, verbose: int = 0,
-                 secret_key: Optional[bytes] = None):
+                 secret_key: Optional[bytes] = None,
+                 fleet_slots: Optional[int] = None):
+        if fleet_slots is None:
+            from ..utils import env as env_cfg
+
+            fleet_slots = env_cfg.fleet_slots()
+        # >0 enables capacity arbitration between per-job namespaces;
+        # 0 (the default) keeps the server a plain KV store.
+        self.fleet_slots = fleet_slots
         self.secret_key = secret_key
         self._store: Dict[str, bytes] = {}
         self._seen_digests: Dict[str, float] = {}
@@ -226,6 +270,27 @@ class RendezvousServer:
             self.put_hook(key, value)
         with self._lock:
             self._store[key] = value
+        if self.fleet_slots > 0 and _CAPACITY_WANT_RE.match(key):
+            self._arbitrate()
+
+    def _arbitrate(self):
+        """Recompute per-job capacity grants from every declared want.
+        Runs on each want-update; grants land in the store so any job
+        (or the elasticity controller) reads its budget with a plain
+        GET on jobs/<name>/capacity/grant."""
+        with self._lock:
+            wants: Dict[str, int] = {}
+            for k, v in self._store.items():
+                m = _CAPACITY_WANT_RE.match(k)
+                if m is None:
+                    continue
+                try:
+                    wants[m.group(1)] = max(0, int(v.decode()))
+                except (ValueError, UnicodeDecodeError):
+                    wants[m.group(1)] = 0
+            grants = arbitrate_capacity(wants, self.fleet_slots)
+            for j, g in grants.items():
+                self._store[f"jobs/{j}/capacity/grant"] = str(g).encode()
 
     def handle_delete(self, key: str):
         with self._lock:

@@ -143,11 +143,47 @@ ELASTIC_RESET_TIMEOUT = "HOROVOD_ELASTIC_RESET_TIMEOUT"
 # failure (the pre-cooldown behavior).
 BLACKLIST_COOLDOWN = "HOROVOD_BLACKLIST_COOLDOWN_SECONDS"
 
+# -- preemption / drain knobs (docs/fault_tolerance.md "Announced
+#    preemption") ------------------------------------------------------
+# Grace window a worker has between the preemption notice (the signal
+# named by HOROVOD_PREEMPT_SIGNAL, SIGTERM by default) and its forced
+# exit. A drain-aware loop (hvd.elastic.run) uses the window to force a
+# final checkpoint, release the goodput stamp and publish the drain
+# notice; the deadline timer then hard-exits with code 0 so a stuck
+# drain can never outlive the platform's own kill.
+DRAIN_GRACE_SECONDS = "HOROVOD_DRAIN_GRACE_SECONDS"
+# Signal treated as the preemption notice (name like "SIGTERM"/"TERM"
+# or a number). Spot/multi-tenant platforms differ; the drain handler,
+# the launcher's teardown path and the fault injector's `preempt`
+# action all send/catch this one signal.
+PREEMPT_SIGNAL = "HOROVOD_PREEMPT_SIGNAL"
+# Cadence of the goodput-driven elasticity controller in the elastic
+# runner (runner/elastic/controller.py): every interval it reads the
+# goodput stamp, the fleet alert verdicts and rendezvous liveness and
+# decides scale-up / scale-down / hold. 0 disables the controller.
+CONTROLLER_INTERVAL_SECONDS = "HOROVOD_CONTROLLER_INTERVAL_SECONDS"
+# Job identity for sharing ONE rendezvous server between jobs (a
+# trainer and a server on the same fleet): when set, every KV key the
+# client and driver touch is prefixed with `jobs/<name>/`, so two jobs
+# never collide, and the prefix doubles as the registration the
+# server's capacity arbitration (HOROVOD_FLEET_SLOTS) grants slots
+# against. Empty (default) = no namespace, the single-job layout.
+JOB_NAME = "HOROVOD_JOB_NAME"
+# Total fleet slots a SHARED rendezvous server arbitrates between jobs
+# (runner/rendezvous_server.py arbitrate_capacity): each job PUTs its
+# want under jobs/<name>/capacity/want and reads its max-min-fair grant
+# back from jobs/<name>/capacity/grant. 0 (default) disables
+# arbitration — the server is a plain KV store.
+FLEET_SLOTS = "HOROVOD_FLEET_SLOTS"
+
 DEFAULT_HEARTBEAT_INTERVAL_SECONDS = 5.0
 DEFAULT_HEARTBEAT_MISS_LIMIT = 6
 DEFAULT_ELASTIC_READY_TIMEOUT = 180.0
 DEFAULT_ELASTIC_RESET_TIMEOUT = 600.0
 DEFAULT_BLACKLIST_COOLDOWN_SECONDS = 600.0
+DEFAULT_DRAIN_GRACE_SECONDS = 30.0
+DEFAULT_PREEMPT_SIGNAL = "SIGTERM"
+DEFAULT_CONTROLLER_INTERVAL_SECONDS = 30.0
 
 # -- pipelined execution knobs (docs/running.md) -----------------------
 # Number of concurrent executor channels the coordinator round-robins
@@ -506,6 +542,81 @@ def elastic_reset_timeout() -> float:
 def blacklist_cooldown_seconds() -> float:
     """First-failure blacklist duration; 0 = permanent immediately."""
     return get_float(BLACKLIST_COOLDOWN, DEFAULT_BLACKLIST_COOLDOWN_SECONDS)
+
+
+def drain_grace_seconds() -> float:
+    """Preemption-notice grace window (floor 0). A bogus value falls to
+    the default — a typo in an operator override must never turn the
+    drain deadline off or make it negative."""
+    try:
+        return max(get_float(DRAIN_GRACE_SECONDS,
+                             DEFAULT_DRAIN_GRACE_SECONDS), 0.0)
+    except ValueError:
+        return DEFAULT_DRAIN_GRACE_SECONDS
+
+
+def preempt_signal() -> int:
+    """HOROVOD_PREEMPT_SIGNAL as a signal number. Accepts a name with
+    or without the SIG prefix ("SIGTERM", "term", "USR1") or a plain
+    number; anything unrecognized falls back to SIGTERM — the drain
+    handler and the sender MUST agree, and a typo that made them
+    diverge would turn every intentional stop back into a hard kill."""
+    import signal as _signal
+
+    v = get_str(PREEMPT_SIGNAL, DEFAULT_PREEMPT_SIGNAL).strip()
+    if not v:
+        return _signal.SIGTERM
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    name = v.upper()
+    if not name.startswith("SIG"):
+        name = "SIG" + name
+    sig = getattr(_signal, name, None)
+    if isinstance(sig, _signal.Signals):
+        return int(sig)
+    return _signal.SIGTERM
+
+
+def controller_interval_seconds() -> float:
+    """Elasticity-controller cadence; 0 disables the controller. Bogus
+    values fall to the default (a broken override must never silently
+    disable the decision loop)."""
+    try:
+        return max(get_float(CONTROLLER_INTERVAL_SECONDS,
+                             DEFAULT_CONTROLLER_INTERVAL_SECONDS), 0.0)
+    except ValueError:
+        return DEFAULT_CONTROLLER_INTERVAL_SECONDS
+
+
+def job_name() -> str:
+    """HOROVOD_JOB_NAME sanitized to [A-Za-z0-9._-] (the name becomes a
+    KV key segment; a slash or whitespace would split or corrupt the
+    namespace). A value with no valid characters falls to "" — the
+    un-namespaced single-job layout."""
+    import re as _re
+
+    return _re.sub(r"[^A-Za-z0-9._-]", "", get_str(JOB_NAME, ""))
+
+
+def job_kv_prefix() -> str:
+    """The per-job KV key prefix ("jobs/<name>/", or "" when no job
+    name is set). Clients and the elastic driver both apply it, so one
+    rendezvous server can host a trainer and a server fleet without key
+    collisions (docs/elastic.md "Sharing one rendezvous server")."""
+    name = job_name()
+    return f"jobs/{name}/" if name else ""
+
+
+def fleet_slots() -> int:
+    """Total slots the shared rendezvous server arbitrates between
+    jobs; 0 (default, and the fallback for bogus values) disables
+    capacity arbitration."""
+    try:
+        return max(get_int(FLEET_SLOTS, 0), 0)
+    except ValueError:
+        return 0
 
 
 def num_channels() -> int:

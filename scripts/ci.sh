@@ -34,6 +34,9 @@ python scripts/chaos_smoke.py --transport shm --wedge
 echo "=== elastic recovery smoke (wedge 1 of 4, survivors resume at np=3) ==="
 python scripts/elastic_smoke.py
 
+echo "=== preemption smoke (announced drain: zero lost steps, preemption-bucket attribution, graceful beats timeout goodput) ==="
+python scripts/preemption_smoke.py
+
 echo "=== durability smoke (kill ALL ranks, restart, bitwise resume) ==="
 python scripts/checkpoint_smoke.py
 

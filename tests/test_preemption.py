@@ -1,0 +1,622 @@
+"""Preemption plane tests (docs/fault_tolerance.md "Announced
+preemption"): drain knob parsing, the chaos injector's `preempt`
+action, DrainCoordinator semantics, preemption-vs-failure badput
+attribution with the stamp release/adopt handoff, the elasticity
+controller's decision table, per-job KV namespaces on one rendezvous
+server, and the strike-free drain quarantine."""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import drain as drain_mod
+from horovod_tpu.common import goodput as goodput_mod
+from horovod_tpu.common import telemetry
+from horovod_tpu.common.exceptions import WorkerPreempted
+from horovod_tpu.common.fault_injection import injector, parse_spec
+from horovod_tpu.runner.elastic import controller as ctl
+from horovod_tpu.runner.elastic.discovery import (
+    FixedHosts, HostManager, HostUpdateResult,
+)
+from horovod_tpu.runner.rendezvous_server import (
+    RendezvousServer, arbitrate_capacity,
+)
+from horovod_tpu.utils import env as env_cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_drain_state(monkeypatch):
+    """Every test starts with a fresh coordinator and injector, and
+    none of the drain knobs leaking in from the host environment."""
+    for var in (env_cfg.DRAIN_GRACE_SECONDS, env_cfg.PREEMPT_SIGNAL,
+                env_cfg.CONTROLLER_INTERVAL_SECONDS, env_cfg.JOB_NAME,
+                env_cfg.FLEET_SLOTS):
+        monkeypatch.delenv(var, raising=False)
+        monkeypatch.delenv(var.replace("HOROVOD_", "HVD_TPU_", 1),
+                           raising=False)
+    injector.clear()
+    drain_mod.coordinator.reset()
+    yield
+    injector.clear()
+    drain_mod.coordinator.reset()
+
+
+def _registry():
+    return telemetry.MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Env knobs
+
+
+def test_drain_knob_defaults():
+    assert env_cfg.drain_grace_seconds() == 30.0
+    assert env_cfg.preempt_signal() == signal.SIGTERM
+    assert env_cfg.controller_interval_seconds() == 30.0
+    assert env_cfg.job_name() == ""
+    assert env_cfg.job_kv_prefix() == ""
+    assert env_cfg.fleet_slots() == 0
+
+
+def test_drain_knobs_parse(monkeypatch):
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "12.5")
+    monkeypatch.setenv(env_cfg.PREEMPT_SIGNAL, "SIGUSR1")
+    monkeypatch.setenv(env_cfg.CONTROLLER_INTERVAL_SECONDS, "5")
+    monkeypatch.setenv(env_cfg.JOB_NAME, "trainer-a")
+    monkeypatch.setenv(env_cfg.FLEET_SLOTS, "16")
+    assert env_cfg.drain_grace_seconds() == 12.5
+    assert env_cfg.preempt_signal() == signal.SIGUSR1
+    assert env_cfg.controller_interval_seconds() == 5.0
+    assert env_cfg.job_name() == "trainer-a"
+    assert env_cfg.job_kv_prefix() == "jobs/trainer-a/"
+    assert env_cfg.fleet_slots() == 16
+
+
+def test_drain_knobs_hvd_tpu_alias(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DRAIN_GRACE_SECONDS", "7")
+    monkeypatch.setenv("HVD_TPU_PREEMPT_SIGNAL", "USR2")
+    monkeypatch.setenv("HVD_TPU_JOB_NAME", "b")
+    assert env_cfg.drain_grace_seconds() == 7.0
+    assert env_cfg.preempt_signal() == signal.SIGUSR2
+    assert env_cfg.job_kv_prefix() == "jobs/b/"
+
+
+def test_drain_knobs_bogus_fall_back_to_defaults(monkeypatch):
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "soon")
+    monkeypatch.setenv(env_cfg.PREEMPT_SIGNAL, "SIGBOGUS")
+    monkeypatch.setenv(env_cfg.CONTROLLER_INTERVAL_SECONDS, "often")
+    monkeypatch.setenv(env_cfg.FLEET_SLOTS, "many")
+    assert env_cfg.drain_grace_seconds() == 30.0
+    assert env_cfg.preempt_signal() == signal.SIGTERM
+    assert env_cfg.controller_interval_seconds() == 30.0
+    assert env_cfg.fleet_slots() == 0
+
+
+def test_preempt_signal_numeric(monkeypatch):
+    monkeypatch.setenv(env_cfg.PREEMPT_SIGNAL, str(int(signal.SIGUSR1)))
+    assert env_cfg.preempt_signal() == signal.SIGUSR1
+
+
+def test_job_name_sanitized(monkeypatch):
+    # A name with path-meta characters must not break the KV layout.
+    monkeypatch.setenv(env_cfg.JOB_NAME, "a/b c!")
+    prefix = env_cfg.job_kv_prefix()
+    assert prefix.startswith("jobs/") and prefix.endswith("/")
+    assert "/" not in prefix[len("jobs/"):-1]
+    assert " " not in prefix and "!" not in prefix
+
+
+# ---------------------------------------------------------------------------
+# Chaos injector: the `preempt` action
+
+
+def test_preempt_rule_parses_step_and_secs():
+    rules = parse_spec("preempt:step=4;preempt:secs=2.5:rank=1")
+    assert rules[0].action == "preempt" and rules[0].step == 4
+    assert rules[1].secs == 2.5 and rules[1].rank == 1
+
+
+def test_preempt_rule_requires_trigger():
+    with pytest.raises(ValueError):
+        parse_spec("preempt")
+
+
+def test_preempt_step_trigger_fires_once(monkeypatch):
+    """advance_step past the trigger delivers the preemption signal to
+    the process exactly once — the installed drain handler turns it
+    into a drain request instead of a death."""
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "600")
+    coord = drain_mod.coordinator
+    assert coord.install(managed=True)
+    counter_before = drain_mod._m_preemptions().value
+    injector.add_rule(parse_spec("preempt:step=2")[0])
+    injector.advance_step()
+    assert not coord.pending()
+    injector.advance_step()
+    assert coord.pending()
+    # Fire-once: further steps do not re-deliver.
+    injector.advance_step()
+    assert drain_mod._m_preemptions().value == counter_before + 1
+
+
+def test_preempt_rules_do_not_consume_io_checks():
+    injector.add_rule(parse_spec("preempt:step=100")[0])
+    assert injector.check_io(0, 1, "send") == "pass"  # no hit consumed
+    assert injector._rules[0].hits == 0
+
+
+# ---------------------------------------------------------------------------
+# DrainCoordinator semantics
+
+
+def test_unmanaged_notice_exits_zero():
+    coord = drain_mod.coordinator
+    exits = []
+    coord._exit = exits.append
+    coord.request("platform notice")
+    assert exits == [0]
+
+
+def test_managed_notice_defers_to_commit(monkeypatch):
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "600")
+    coord = drain_mod.coordinator
+    coord.set_managed(True)
+    exits = []
+    coord._exit = exits.append
+    coord.request("spot reclaim")
+    assert coord.pending() and exits == []
+    assert coord.reason == "spot reclaim"
+    # Idempotent: a duplicate signal neither re-counts nor re-arms.
+    before = drain_mod._m_preemptions().value
+    coord.request("dup")
+    assert coord.reason == "spot reclaim"
+    assert drain_mod._m_preemptions().value == before
+
+
+def test_grace_deadline_forces_exit(monkeypatch):
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "0.05")
+    coord = drain_mod.coordinator
+    coord.set_managed(True)
+    exited = threading.Event()
+    coord._exit = lambda code: exited.set()
+    coord.request("reclaim")
+    assert exited.wait(5.0), "grace deadline never fired"
+
+
+def test_checkpoint_budget_tracks_grace(monkeypatch):
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "20")
+    coord = drain_mod.coordinator
+    coord.set_managed(True)
+    coord._exit = lambda code: None
+    coord.request("reclaim")
+    assert 1.0 <= coord.checkpoint_budget() <= 18.0
+
+
+def test_execute_releases_and_raises(monkeypatch, tmp_path):
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "600")
+    reg = _registry()
+    led = goodput_mod.GoodputLedger(
+        registry=reg, rank=0, enabled=True,
+        stamp_path=str(tmp_path / "goodput.json"))
+    goodput_mod.set_current(led)
+    try:
+        coord = drain_mod.coordinator
+        coord.set_managed(True)
+        coord._exit = lambda code: None
+        coord.request("reclaim")
+        with pytest.raises(WorkerPreempted):
+            coord.execute(state=None)
+        doc = json.loads((tmp_path / "goodput.json").read_text())
+        assert doc["draining"] is True
+    finally:
+        goodput_mod.set_current(None)
+
+
+def test_worker_preempted_is_clean_exit():
+    assert issubclass(WorkerPreempted, SystemExit)
+    assert WorkerPreempted("x").code == 0
+
+
+def test_fleet_draining_peer_attribution():
+    coord = drain_mod.coordinator
+    assert not coord.fleet_draining()
+    coord.note_peer_draining()
+    assert coord.fleet_draining()
+    assert not coord.fleet_draining(window=0.0)
+
+
+def test_commit_barrier_runs_save_now_uninitialized(monkeypatch):
+    """Outside an initialized world the barrier skips the collective
+    but a pending drain still checkpoints and departs."""
+    monkeypatch.setenv(env_cfg.DRAIN_GRACE_SECONDS, "600")
+    coord = drain_mod.coordinator
+    coord.set_managed(True)
+    coord._exit = lambda code: None
+    coord.request("reclaim")
+
+    calls = []
+
+    class FakeMgr:
+        def save_now(self, state, timeout):
+            calls.append(timeout)
+            return True
+
+    class FakeState:
+        _checkpoint_manager = FakeMgr()
+
+    with pytest.raises(WorkerPreempted):
+        drain_mod.commit_barrier(FakeState())
+    assert len(calls) == 1 and calls[0] >= 1.0
+
+
+def test_commit_barrier_noop_when_unmanaged():
+    state = object()  # would explode if touched
+    drain_mod.commit_barrier(state)
+
+
+# ---------------------------------------------------------------------------
+# Badput attribution: preemption vs failure, stamp handoff
+
+
+def test_disruption_bucket_routing():
+    led = goodput_mod.GoodputLedger(registry=_registry(), rank=0,
+                                    enabled=True)
+    led.disruption_begin("drain", bucket="preemption")
+    time.sleep(0.01)
+    led.disruption_end()
+    assert led.preempt_seconds > 0.0
+    assert led.downtime_seconds == 0.0
+
+
+def test_disruption_upgrades_to_preemption():
+    """The collective failure is bracketed first; the drain notice
+    arrives after — the open window upgrades, never downgrades."""
+    led = goodput_mod.GoodputLedger(registry=_registry(), rank=0,
+                                    enabled=True)
+    led.disruption_begin("collective failure", bucket="failure")
+    led.disruption_begin("peer draining", bucket="preemption")
+    led.disruption_begin("late failure evidence", bucket="failure")
+    time.sleep(0.01)
+    led.disruption_end()
+    assert led.preempt_seconds > 0.0
+    assert led.downtime_seconds == 0.0
+
+
+def test_stamp_release_and_adopt_roundtrip(tmp_path):
+    """Owner releases at drain; a promoted survivor adopts: totals fold
+    into its prior lifetime, generation advances, no double count."""
+    p = str(tmp_path / "goodput.json")
+    led1 = goodput_mod.GoodputLedger(registry=_registry(), rank=0,
+                                     enabled=True, stamp_path=p)
+    led1.steps = 5
+    led1.step_seconds = 2.0
+    led1.committed_step = 5
+    assert led1.release_stamp()
+
+    led2 = goodput_mod.GoodputLedger(registry=_registry(), rank=1,
+                                     enabled=True, stamp_path=p)
+    led2.steps = 3  # survivor's own (already-stamped-by-owner) window
+    assert led2.try_adopt_stamp()
+    assert led2.prior_steps == 5
+    assert led2.steps == 0          # own window dropped, not doubled
+    assert led2.generation == 2
+    # Adoption confers ownership: the survivor stamps from here on.
+    assert led2._stamp_owner
+
+
+def test_adopt_refuses_unreleased_stamp(tmp_path):
+    p = str(tmp_path / "goodput.json")
+    led1 = goodput_mod.GoodputLedger(registry=_registry(), rank=0,
+                                     enabled=True, stamp_path=p)
+    led1.stamp(force=True)  # a live, NOT-draining stamp
+    led2 = goodput_mod.GoodputLedger(registry=_registry(), rank=1,
+                                     enabled=True, stamp_path=p)
+    assert not led2.try_adopt_stamp()
+
+
+def test_restart_gap_after_drain_is_preemption_badput(tmp_path):
+    """A follow-up lifetime that loads a `draining` stamp attributes
+    the restart gap to the preemption bucket, not failure."""
+    p = tmp_path / "goodput.json"
+    led1 = goodput_mod.GoodputLedger(registry=_registry(), rank=0,
+                                     enabled=True, stamp_path=str(p))
+    assert led1.release_stamp()
+    doc = json.loads(p.read_text())
+    doc["stamp_wall"] = time.time() - 5.0
+    p.write_text(json.dumps(doc))
+
+    led2 = goodput_mod.GoodputLedger(registry=_registry(), rank=0,
+                                     enabled=True, stamp_path=str(p))
+    assert led2.preempt_seconds >= 4.0
+    assert led2.downtime_seconds == 0.0
+    assert led2.generation == 2
+
+
+# ---------------------------------------------------------------------------
+# Elasticity controller: the decision table
+
+
+def test_decide_scale_up_on_idle_capacity():
+    action, target, _ = ctl.decide(current_np=4, min_np=2, max_np=8,
+                                   available_slots=6)
+    assert (action, target) == (ctl.SCALE_UP, 6)
+
+
+def test_decide_scale_up_capped_by_max_np():
+    action, target, _ = ctl.decide(current_np=4, min_np=2, max_np=5,
+                                   available_slots=8)
+    assert (action, target) == (ctl.SCALE_UP, 5)
+
+
+def test_decide_scale_up_capped_by_grant():
+    action, target, _ = ctl.decide(current_np=4, min_np=2, max_np=8,
+                                   available_slots=8, grant=5)
+    assert (action, target) == (ctl.SCALE_UP, 5)
+
+
+def test_decide_grant_shrink_binds():
+    action, target, reason = ctl.decide(current_np=6, min_np=2, max_np=8,
+                                        available_slots=6, grant=3)
+    assert (action, target) == (ctl.SCALE_DOWN, 3)
+    assert "grant" in reason
+
+
+def test_decide_grant_never_shrinks_below_min_np():
+    action, target, _ = ctl.decide(current_np=4, min_np=4, max_np=8,
+                                   available_slots=4, grant=1)
+    assert action == ctl.HOLD
+
+
+def test_decide_straggler_drains_one():
+    action, target, reason = ctl.decide(current_np=4, min_np=2, max_np=8,
+                                        available_slots=4,
+                                        straggler_rank=3)
+    assert (action, target) == (ctl.SCALE_DOWN, 3)
+    assert "rank 3" in reason
+
+
+def test_decide_straggler_needs_min_np_headroom():
+    action, _, _ = ctl.decide(current_np=2, min_np=2, max_np=8,
+                              available_slots=2, straggler_rank=1)
+    assert action == ctl.HOLD
+
+
+def test_decide_drain_in_flight_freezes():
+    action, _, _ = ctl.decide(current_np=4, min_np=2, max_np=8,
+                              available_slots=8, fleet_draining=True)
+    assert action == ctl.HOLD
+
+
+def test_decide_steady_state_holds():
+    action, _, _ = ctl.decide(current_np=4, min_np=2, max_np=4,
+                              available_slots=6)
+    assert action == ctl.HOLD
+
+
+# -- controller tick against a fake driver ----------------------------------
+
+
+class _FakeProc:
+    def __init__(self):
+        self.signals = []
+
+    def poll(self):
+        return None
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+
+class _FakeRec:
+    def __init__(self):
+        self.proc = _FakeProc()
+
+
+class _FakeSlot:
+    def __init__(self, rank):
+        self.rank = rank
+
+
+class _FakeHostManager:
+    def __init__(self, slots):
+        self.slots = slots
+
+    def available_slots(self):
+        return self.slots
+
+
+class _FakeDriver:
+    def __init__(self, np_=4, slots=4, min_np=2, max_np=8):
+        self._lock = threading.RLock()
+        self._assignments = {(f"h{r}", 0): _FakeSlot(r)
+                             for r in range(np_)}
+        self._workers = {k: _FakeRec() for k in self._assignments}
+        self._draining = {}
+        self.min_np = min_np
+        self.max_np = max_np
+        self.host_manager = _FakeHostManager(slots)
+        self.rendezvous = RendezvousServer()
+        self.finished = False
+        self.resumed = 0
+
+    def resume(self):
+        self.resumed += 1
+
+
+def _firing(ranks):
+    return json.dumps({"wall": time.time(),
+                       "firing_by_rule":
+                           {"step_stall": list(ranks)}}).encode()
+
+
+def test_controller_straggler_needs_consecutive_strikes():
+    drv = _FakeDriver()
+    c = ctl.ElasticityController(drv, interval=10.0)
+    drv.rendezvous.handle_put("alerts/fleet", _firing([2]))
+    for _ in range(ctl.STRAGGLER_STRIKES - 1):
+        action, _, _ = c.tick()
+        assert action == ctl.HOLD
+    action, target, _ = c.tick()
+    assert (action, target) == (ctl.SCALE_DOWN, 3)
+    # The named straggler got the preemption notice, nobody else did.
+    victim = drv._workers[("h2", 0)].proc
+    assert victim.signals == [env_cfg.preempt_signal()]
+    others = [r.proc.signals for k, r in drv._workers.items()
+              if k != ("h2", 0)]
+    assert all(s == [] for s in others)
+
+
+def test_controller_one_clean_tick_clears_strikes():
+    drv = _FakeDriver()
+    c = ctl.ElasticityController(drv, interval=10.0)
+    drv.rendezvous.handle_put("alerts/fleet", _firing([2]))
+    c.tick()
+    c.tick()
+    drv.rendezvous.handle_put("alerts/fleet", _firing([]))
+    c.tick()  # clean tick: strikes reset
+    drv.rendezvous.handle_put("alerts/fleet", _firing([2]))
+    action, _, _ = c.tick()
+    assert action == ctl.HOLD
+
+
+def test_controller_cooldown_rate_limits():
+    drv = _FakeDriver(np_=4, slots=8)
+    c = ctl.ElasticityController(drv, interval=10.0)
+    action, _, _ = c.tick()
+    assert action == ctl.SCALE_UP and drv.resumed == 1
+    action, _, reason = c.tick()
+    assert action == ctl.HOLD and "cooldown" in reason
+    assert drv.resumed == 1
+
+
+def test_controller_holds_while_draining():
+    drv = _FakeDriver(np_=4, slots=8)
+    drv._draining[("h0", 0)] = time.monotonic()
+    c = ctl.ElasticityController(drv, interval=10.0)
+    action, _, _ = c.tick()
+    assert action == ctl.HOLD and drv.resumed == 0
+
+
+def test_controller_publishes_last_decision():
+    drv = _FakeDriver(np_=4, slots=4)
+    c = ctl.ElasticityController(drv, interval=10.0)
+    c.tick()
+    doc = json.loads(drv.rendezvous.handle_get("controller/last").decode())
+    assert doc["action"] == ctl.HOLD and doc["current_np"] == 4
+
+
+def test_controller_reads_namespaced_grant(monkeypatch):
+    monkeypatch.setenv(env_cfg.JOB_NAME, "a")
+    drv = _FakeDriver(np_=6, slots=6, min_np=2)
+    c = ctl.ElasticityController(drv, interval=10.0)
+    drv.rendezvous.handle_put("jobs/a/capacity/grant", b"3")
+    action, target, _ = c.tick()
+    assert (action, target) == (ctl.SCALE_DOWN, 3)
+
+
+def test_controller_decision_counters():
+    drv = _FakeDriver(np_=4, slots=4)
+    c = ctl.ElasticityController(drv, interval=10.0)
+    before = c._m[ctl.HOLD].value
+    c.tick()
+    assert c._m[ctl.HOLD].value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Per-job KV namespaces and capacity arbitration
+
+
+def test_arbitrate_capacity_max_min_fair():
+    assert arbitrate_capacity({"a": 10, "b": 2, "c": 5}, 12) == \
+        {"a": 5, "b": 2, "c": 5}
+    assert arbitrate_capacity({"a": 10, "b": 10}, 5) == {"a": 3, "b": 2}
+    assert arbitrate_capacity({}, 5) == {}
+    assert arbitrate_capacity({"a": 3}, 0) == {"a": 0}
+    assert arbitrate_capacity({"a": 4, "b": 4}, 16) == {"a": 4, "b": 4}
+
+
+def test_server_arbitrates_on_want_put():
+    srv = RendezvousServer(fleet_slots=8)
+    srv.handle_put("jobs/a/capacity/want", b"6")
+    srv.handle_put("jobs/b/capacity/want", b"6")
+    assert int(srv.handle_get("jobs/a/capacity/grant")) == 4
+    assert int(srv.handle_get("jobs/b/capacity/grant")) == 4
+    # A job shrinking its want frees slots for the other.
+    srv.handle_put("jobs/b/capacity/want", b"2")
+    assert int(srv.handle_get("jobs/a/capacity/grant")) == 6
+    assert int(srv.handle_get("jobs/b/capacity/grant")) == 2
+
+
+def test_server_ignores_wants_without_fleet_slots():
+    srv = RendezvousServer()  # fleet_slots=0: plain KV store
+    srv.handle_put("jobs/a/capacity/want", b"6")
+    assert srv.handle_get("jobs/a/capacity/grant") is None
+
+
+def test_kv_namespace_isolation():
+    """Two namespaced clients on ONE server never see each other's
+    keys — the whole elastic protocol is scoped by the prefix."""
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        a = RendezvousClient("127.0.0.1", port, timeout=5.0,
+                             secret_key=None, namespace="jobs/a/")
+        b = RendezvousClient("127.0.0.1", port, timeout=5.0,
+                             secret_key=None, namespace="jobs/b/")
+        a.put("meta", "epoch", b"3")
+        b.put("meta", "epoch", b"7")
+        assert a.get("meta", "epoch") == b"3"
+        assert b.get("meta", "epoch") == b"7"
+        assert srv.handle_get("jobs/a/meta/epoch") == b"3"
+        assert srv.handle_get("jobs/b/meta/epoch") == b"7"
+        # DELETE is scoped too.
+        a.delete("meta")
+        assert a.get("meta", "epoch") is None
+        assert b.get("meta", "epoch") == b"7"
+    finally:
+        srv.stop()
+
+
+def test_unnamespaced_client_layout_unchanged():
+    from horovod_tpu.backend.rendezvous import RendezvousClient
+
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        c = RendezvousClient("127.0.0.1", port, timeout=5.0,
+                             secret_key=None, namespace="")
+        c.put("meta", "epoch", b"1")
+        assert srv.handle_get("meta/epoch") == b"1"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Strike-free drain quarantine
+
+
+def test_quarantine_excludes_without_strikes():
+    mgr = HostManager(FixedHosts({"a": 1, "b": 1}), cooldown=600.0)
+    mgr.update_available_hosts()
+    mgr.quarantine("a", 60.0)
+    assert [h for h, _ in mgr.current_hosts] == ["b"]
+    assert mgr.is_quarantined("a")
+    assert mgr.blacklist_strikes("a") == 0
+    assert not mgr.is_blacklisted("a")
+
+
+def test_quarantine_expiry_surfaces_as_added():
+    mgr = HostManager(FixedHosts({"a": 1, "b": 1}), cooldown=600.0)
+    mgr.update_available_hosts()
+    mgr.quarantine("a", 0.01)
+    assert [h for h, _ in mgr.current_hosts] == ["b"]
+    time.sleep(0.05)
+    res = mgr.update_available_hosts()
+    assert res & HostUpdateResult.ADDED
+    assert [h for h, _ in mgr.current_hosts] == ["a", "b"]
