@@ -531,6 +531,12 @@ class AlertEngine:
                         st.fires += 1
                         self._count(rule.name, "fire")
                         self._instant("alert.fire", rule, st)
+                        from . import events as events_mod
+
+                        events_mod.emit(
+                            events_mod.ALERT_FIRE,
+                            severity=events_mod.WARN,
+                            rule=rule.name, value=st.value)
                         logger.warning(
                             "ALERT FIRING %s: value=%s %s", rule.name,
                             st.value, st.detail)
@@ -545,6 +551,10 @@ class AlertEngine:
                             st.clear_start = None
                             self._count(rule.name, "resolve")
                             self._instant("alert.resolve", rule, st)
+                            from . import events as events_mod
+
+                            events_mod.emit(events_mod.ALERT_CLEAR,
+                                            rule=rule.name)
                             logger.info("alert resolved: %s", rule.name)
             self._m_firing.set(
                 sum(1 for s in self._state.values() if s.firing))

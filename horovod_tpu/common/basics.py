@@ -166,6 +166,16 @@ def init(ranks: Optional[Sequence[int]] = None, devices=None, axis_name: str = "
                 },
                 rank=_state.rank,
             )
+            # Events plane (docs/events.md): arm the recorder (spool
+            # env included) and serve the local ring at /events — mesh
+            # mode has no engine to do either.
+            from . import events as events_mod
+
+            events_mod.current(rank=_state.rank)
+            events_mod.set_rank(_state.rank)
+            for exp in _state.exporters:
+                if isinstance(exp, metrics_export.MetricsHTTPServer):
+                    exp.add_view("events", events_mod.local_view)
         _state.initialized = True
         # Baseline gauge for "world shrank" alerts — set on EVERY init,
         # not only after an elastic reset (elastic/run.py updates it too).

@@ -785,6 +785,10 @@ class CheckpointManager:
         self._m_commits.inc()
         self._m_last_step.set(snap.step)
         self._m_commit_s.observe(time.perf_counter() - t0)
+        from . import events as events_mod
+
+        events_mod.emit(events_mod.CKPT_COMMIT, ckpt_step=snap.step,
+                        shards=snap.size)
         logger.info("checkpoint committed at step %d (%d shards)",
                     snap.step, snap.size)
         try:
@@ -847,6 +851,11 @@ class CheckpointManager:
             # satisfy a repeated commit barrier at the same step with
             # pre-crash bytes.
             purge_newer_than(self.directory, step)
+            from . import events as events_mod
+
+            events_mod.emit(events_mod.CKPT_RESTORE, ckpt_step=step,
+                            written_world=man["world_size"],
+                            restore_world=self._world()[1])
             logger.info(
                 "restored checkpoint step %d (written at world size %d, "
                 "restoring at world size %d)", step, man["world_size"],

@@ -58,6 +58,7 @@ from typing import Optional
 
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
+from . import events as events_mod
 from .exceptions import WorkerPreempted
 
 logger = get_logger()
@@ -179,6 +180,8 @@ class DrainCoordinator:
             self._t0 = time.monotonic()
             managed = self._managed
         _m_preemptions().inc()
+        events_mod.emit(events_mod.DRAIN_NOTICE, severity=events_mod.WARN,
+                        reason=reason, managed=managed)
         grace = env_cfg.drain_grace_seconds()
         if not managed:
             logger.warning(
@@ -239,11 +242,16 @@ class DrainCoordinator:
             t0 = self._t0
         if t0 is not None:
             _m_drain_seconds().observe(time.monotonic() - t0)
+        events_mod.emit(events_mod.DRAIN_DRAINED,
+                        severity=events_mod.WARN, reason=self._reason)
         logger.warning("drained cleanly (%s); exiting", self._reason)
         raise WorkerPreempted(self._reason or "preempted")
 
     # -- survivor-side attribution -------------------------------------
     def note_peer_draining(self):
+        if self._peer_mono is None:
+            events_mod.emit(events_mod.DRAIN_PEER,
+                            severity=events_mod.WARN)
         self._peer_mono = time.monotonic()
 
     def fleet_draining(self, window: float = 600.0) -> bool:
@@ -376,6 +384,8 @@ def commit_barrier(state) -> None:
 
 
 def _drain_commit(coord: DrainCoordinator, state, draining: bool):
+    events_mod.emit(events_mod.DRAIN_COMMIT, severity=events_mod.WARN,
+                    draining=draining, reason=coord.reason)
     mgr = getattr(state, "_checkpoint_manager", None)
     if mgr is not None:
         try:

@@ -1,0 +1,636 @@
+"""Lifecycle event journal — the fifth observability plane
+(docs/events.md).
+
+The metrics, tracing, timeseries/alert and goodput planes answer "how
+much", "where did the time go", "what is trending wrong" and "how much
+became training" — but *lifecycle* truth (re-mesh epochs, drains,
+preemptions, checkpoint commits/restores, weight swaps, alert
+fire/clear, controller decisions, host quarantines) was scattered
+across KV rows, log lines and counters. This module records it as
+typed, causally orderable events:
+
+    (seq, wall_ns, mono_ns, rank, epoch, step, severity, kind, attrs)
+
+``seq`` is a per-process monotonically increasing index (the dedup
+key, exactly the flight recorder's scheme); ``epoch`` is the elastic
+topology epoch the process was meshed into when the event fired;
+``step`` is the goodput ledger's global committed-step cursor. Epoch
+and step are what make the journal *causally* orderable across ranks:
+wall clocks skew, but a `drain.drained` at (epoch 3, step 120) is
+unambiguously before the `elastic.remesh` that opened epoch 4.
+
+Three sinks, none on the hot path:
+
+* **Ring** — a bounded in-memory buffer (`EventRecorder`, the
+  SpanRecorder design: GIL-atomic append, amortized trim, overwrites
+  counted in ``horovod_events_dropped_total``), always available for
+  /events, /status and post-mortems.
+* **Spool** — with ``HOROVOD_EVENTS_DIR`` set, a writer thread appends
+  each event as one JSON line to ``events_rank<r>.jsonl`` (flushed
+  every ``HOROVOD_EVENTS_SPOOL_SECONDS``) and atomically writes a
+  clock-anchor sidecar via utils/atomic_file. The journal survives the
+  process; a torn tail line from a hard kill is tolerated on replay
+  (`read_journal`).
+* **Fleet fold** — each rank's new events ride the telemetry piggyback
+  to rank 0 (engine/controller.py, the same mechanism spans and alert
+  state use); `FleetEvents` dedups by (rank, seq), aligns wall clocks
+  with the health plane's RTT-estimated offsets, and serves the merged
+  causally-ordered chronicle at /events.
+
+``HOROVOD_EVENTS_BUFFER=0`` disables the plane entirely: `emit`
+returns before touching a clock, no spool thread, no fold.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import atomic_file, clock
+from ..utils import env as env_cfg
+from ..utils.logging import get_logger
+
+logger = get_logger()
+
+# -- severities --------------------------------------------------------
+INFO = "info"
+WARN = "warn"
+ERROR = "error"
+
+# -- event kinds (docs/events.md "Kinds") ------------------------------
+# Engine lifecycle (engine/engine.py)
+ENGINE_INIT = "engine.init"
+ENGINE_SHUTDOWN = "engine.shutdown"
+# Elastic run loop (elastic/run.py) + driver (runner/elastic/driver.py)
+ELASTIC_RESET = "elastic.reset"
+ELASTIC_RESTORE = "elastic.restore"
+ELASTIC_REMESH = "elastic.remesh"
+ELASTIC_JOIN = "elastic.join"
+ELASTIC_EVICT = "elastic.evict"
+# Graceful drain (common/drain.py)
+DRAIN_NOTICE = "drain.notice"
+DRAIN_COMMIT = "drain.commit_barrier"
+DRAIN_DRAINED = "drain.drained"
+DRAIN_PEER = "drain.peer"
+# Durability (common/checkpoint.py + goodput replay accounting)
+CKPT_COMMIT = "ckpt.commit"
+CKPT_RESTORE = "ckpt.restore"
+CKPT_REPLAY = "ckpt.replay"
+# Alert engine (common/alerts.py)
+ALERT_FIRE = "alert.fire"
+ALERT_CLEAR = "alert.clear"
+# Elasticity controller (runner/elastic/controller.py)
+CONTROLLER_DECISION = "controller.decision"
+# Serving plane (serving/replicas.py)
+SERVING_SWAP_PREPARE = "serving.swap_prepare"
+SERVING_SWAP = "serving.swap"
+SERVING_EVICT = "serving.evict"
+# Liveness plane (common/health.py)
+HEALTH_VERDICT = "health.verdict"
+# Host bookkeeping (runner/elastic/driver.py + discovery)
+HOST_QUARANTINE = "host.quarantine"
+HOST_BLACKLIST = "host.blacklist"
+
+# Journal filename scheme under HOROVOD_EVENTS_DIR. The driver process
+# (no rank) spools as rank -1 -> "events_driver.jsonl".
+JOURNAL_PREFIX = "events_rank"
+DRIVER_JOURNAL = "events_driver.jsonl"
+ANCHOR_SUFFIX = ".anchor.json"
+
+_FIELDS = ("seq", "wall_ns", "mono_ns", "rank", "epoch", "step", "sev",
+           "kind", "attrs")
+
+
+def journal_path(directory: str, rank: int) -> str:
+    name = DRIVER_JOURNAL if rank < 0 else f"{JOURNAL_PREFIX}{rank}.jsonl"
+    return os.path.join(directory, name)
+
+
+def to_dict(ev: tuple) -> dict:
+    d = dict(zip(_FIELDS, ev))
+    if d.get("attrs") is None:
+        d.pop("attrs", None)
+    return d
+
+
+# Worker processes learn their epoch from MESH_SCOPE; the driver
+# process has no scope env, so the ElasticDriver installs a provider
+# for its live epoch — otherwise every driver event would stamp -1 and
+# sort before the whole worker chronicle.
+_epoch_provider = None
+
+
+def set_epoch_provider(fn):
+    global _epoch_provider
+    _epoch_provider = fn
+
+
+def _current_epoch() -> int:
+    """The elastic topology epoch this process is meshed into; -1
+    outside elastic mode (static jobs have exactly one 'epoch')."""
+    try:
+        fn = _epoch_provider
+        if fn is not None:
+            e = fn()
+        else:
+            from ..backend import elastic_env
+
+            e = elastic_env._current_epoch()
+        return -1 if e is None else int(e)
+    except Exception:  # pragma: no cover - defensive
+        return -1
+
+
+def _current_step() -> int:
+    """The goodput ledger's global step cursor (0 before any step)."""
+    try:
+        from . import goodput
+
+        led = goodput.active()
+        return int(led.current_step) if led is not None else 0
+    except Exception:  # pragma: no cover - defensive
+        return 0
+
+
+class EventRecorder:
+    """Bounded ring of lifecycle events + optional JSONL spool.
+
+    The ring is the SpanRecorder design (common/tracing.py): `record`
+    is a GIL-atomic `list.append` with the seq drawn from an
+    `itertools.count`; the bound is enforced by an amortized trim once
+    the list doubles past capacity, and overwrites are counted in
+    ``horovod_events_dropped_total`` — losing the start of an incident
+    must never read as "nothing happened".
+    """
+
+    def __init__(self, capacity: Optional[int] = None, registry=None,
+                 rank: Optional[int] = None,
+                 spool_dir: Optional[str] = None,
+                 spool_seconds: Optional[float] = None):
+        from . import telemetry
+
+        if capacity is None:
+            capacity = env_cfg.events_buffer()
+        self.capacity = max(int(capacity), 0)
+        self.rank = (env_cfg.get_int(env_cfg.RANK, 0)
+                     if rank is None else rank)
+        self._buf: List[tuple] = []
+        self._seq = itertools.count()
+        self._trim_at = 2 * self.capacity
+        self._lock = threading.Lock()
+        self._m_dropped = None
+        self._m_recorded = None
+        if self.capacity:
+            registry = (telemetry.default_registry()
+                        if registry is None else registry)
+            self._m_dropped = registry.counter(
+                "horovod_events_dropped_total",
+                "Lifecycle events lost before reaching an output (ring "
+                "overwrites, spool queue drops)")
+            self._m_recorded = registry.counter(
+                "horovod_events_recorded_total",
+                "Lifecycle events recorded by the events plane")
+        # -- spool (HOROVOD_EVENTS_DIR) --------------------------------
+        self._spool_q: Optional[queue.Queue] = None
+        self._spool_thread: Optional[threading.Thread] = None
+        self._spool_stop = threading.Event()
+        self._spool_kick = threading.Event()
+        self._spool_path: Optional[str] = None
+        self._spool_seconds = (env_cfg.events_spool_seconds()
+                               if spool_seconds is None else spool_seconds)
+        if spool_dir is None:
+            spool_dir = env_cfg.events_dir()
+        if self.capacity and spool_dir:
+            self._start_spool(spool_dir)
+
+    # -- recording (the only call sites emitters touch) ----------------
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def record(self, kind: str, severity: str = INFO,
+               attrs: Optional[dict] = None,
+               rank: Optional[int] = None) -> Optional[tuple]:
+        if not self.capacity:
+            return None
+        mono = clock.mono_ns()
+        ev = (next(self._seq), clock.mono_to_wall_ns(mono), mono,
+              self.rank if rank is None else rank,
+              _current_epoch(), _current_step(), severity, kind,
+              dict(attrs) if attrs else None)
+        buf = self._buf
+        buf.append(ev)
+        if self._m_recorded is not None:
+            self._m_recorded.inc()
+        if len(buf) >= self._trim_at:
+            self._trim()
+        q = self._spool_q
+        if q is not None:
+            try:
+                q.put_nowait(ev)
+            except queue.Full:
+                if self._m_dropped is not None:
+                    self._m_dropped.inc()
+        return ev
+
+    def _trim(self):
+        with self._lock:
+            excess = len(self._buf) - self.capacity
+            if excess > 0:
+                del self._buf[:excess]
+                if self._m_dropped is not None:
+                    self._m_dropped.inc(excess)
+
+    def _total(self) -> int:
+        buf = self._buf
+        return buf[-1][0] + 1 if buf else 0
+
+    def depth(self) -> int:
+        return min(len(self._buf), self.capacity)
+
+    @property
+    def dropped(self) -> int:
+        """Events no longer retained by the ring (exact)."""
+        return max(self._total() - self.depth(), 0)
+
+    def snapshot(self) -> List[tuple]:
+        with self._lock:
+            evs = list(self._buf)
+        evs.sort(key=lambda e: e[0])
+        return evs[-self.capacity:]
+
+    def batch_since(self, cursor: int, limit: int = 1024
+                    ) -> Tuple[List[tuple], int]:
+        """Events with seq >= cursor (oldest `limit`) and the next
+        cursor — the piggyback's incremental read (tracing idiom)."""
+        evs = [e for e in self.snapshot() if e[0] >= cursor]
+        if len(evs) > limit:
+            evs = evs[:limit]
+        nxt = evs[-1][0] + 1 if evs else self._total()
+        return evs, nxt
+
+    def tail(self, n: int = 8) -> List[dict]:
+        """The newest n events, dict form — the /status compact tail."""
+        return [to_dict(e) for e in self.snapshot()[-n:]]
+
+    def status(self) -> dict:
+        st = {
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "depth": self.depth(),
+            "dropped": self.dropped,
+        }
+        if self._spool_path:
+            st["spool"] = {"path": self._spool_path,
+                           "flush_seconds": self._spool_seconds}
+        return st
+
+    # -- spool ---------------------------------------------------------
+    def _start_spool(self, directory: str):
+        """Arm the JSONL journal writer: events are queued here and
+        appended+flushed by a daemon thread (the timeline.py pattern —
+        the recording path never touches a file). The clock-anchor
+        sidecar is written atomically (utils/atomic_file) so readers
+        can align this journal's wall clock against other ranks'."""
+        try:
+            path = journal_path(directory, self.rank)
+            atomic_file.atomic_write_text(
+                path + ANCHOR_SUFFIX,
+                json.dumps({"rank": self.rank, **clock.anchor_meta()}),
+                make_dirs=True)
+        except OSError as e:
+            logger.warning("events spool disabled: %s", e)
+            return
+        self._spool_path = path
+        self._spool_q = queue.Queue(maxsize=max(self.capacity, 1024))
+        self._spool_thread = threading.Thread(
+            target=self._spool_loop, name="hvd-events-spool", daemon=True)
+        self._spool_thread.start()
+        # The writer is a daemon thread: without this, a clean exit
+        # (including the SystemExit a drain raises) could kill it with
+        # the final events — the interesting ones — still queued.
+        import atexit
+
+        atexit.register(self.flush_spool)
+
+    def _spool_loop(self):
+        assert self._spool_q is not None and self._spool_path is not None
+        try:
+            f = open(self._spool_path, "a", encoding="utf-8")
+        except OSError as e:  # pragma: no cover - dir vanished
+            logger.warning("events spool open failed: %s", e)
+            self._spool_q = None
+            return
+        with f:
+            while True:
+                self._spool_kick.wait(self._spool_seconds)
+                self._spool_kick.clear()
+                stopped = self._spool_stop.is_set()
+                wrote = False
+                while True:
+                    try:
+                        ev = self._spool_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    f.write(json.dumps(to_dict(ev),
+                                       separators=(",", ":")) + "\n")
+                    wrote = True
+                if wrote:
+                    f.flush()
+                if stopped:
+                    return
+
+    def flush_spool(self, timeout: float = 2.0):
+        """Kick the writer thread and wait (bounded) until everything
+        queued so far is on disk — engine shutdown calls this so the
+        journal's tail covers the shutdown events themselves."""
+        t, q = self._spool_thread, self._spool_q
+        if t is None or q is None or not t.is_alive():
+            return
+        self._spool_kick.set()
+        deadline = time.monotonic() + timeout
+        while not q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # one more tick so the write+flush after the final get lands
+        time.sleep(0.05)
+
+    def close_spool(self, timeout: float = 5.0):
+        """Drain and stop the journal writer (engine shutdown)."""
+        t = self._spool_thread
+        if t is None:
+            return
+        self._spool_stop.set()
+        self._spool_kick.set()
+        t.join(timeout=timeout)
+        self._spool_thread = None
+
+    # -- piggyback push (engine/controller.py wires this) --------------
+    def make_push(self):
+        """A zero-arg callable for the telemetry piggyback: each call
+        returns {"batch": [...new events...], "anchor": {...}} or None
+        when nothing is new. Cursor state lives in the closure — one
+        pusher per engine, exactly like the tracer's span cursor."""
+        state = {"cursor": 0}
+
+        def push() -> Optional[dict]:
+            evs, state["cursor"] = self.batch_since(state["cursor"])
+            if not evs:
+                return None
+            return {"batch": [list(e) for e in evs],
+                    "anchor": clock.anchor_meta()}
+
+        return push
+
+
+def causal_order(evs: List[dict]) -> List[dict]:
+    """Sort event dicts (each carrying epoch/step/adj_wall_ns/rank/seq)
+    into the chronicle order: epoch, step cursor, skew-adjusted wall,
+    rank, seq. Events from processes with no step cursor (step <= 0:
+    the driver, a worker before its first step) *inherit* the step of
+    the last stepped event at their wall position within the epoch —
+    otherwise every control-plane event (evict, quarantine, controller
+    decision) would sort to the front of its epoch instead of
+    interleaving where it happened. Deterministic: a pure function of
+    the event set, independent of ingestion order."""
+    def _wall(d):
+        return d.get("adj_wall_ns", d.get("wall_ns", 0))
+
+    pre = sorted(evs, key=lambda d: (d.get("epoch", -1), _wall(d),
+                                     d.get("rank", 0), d.get("seq", 0)))
+    eff: Dict[int, int] = {}
+    cur_epoch: Optional[int] = None
+    cursor = 0
+    for d in pre:
+        e = d.get("epoch", -1)
+        if e != cur_epoch:
+            cur_epoch, cursor = e, 0
+        s = d.get("step", 0) or 0
+        if s > 0:
+            cursor = max(cursor, s)
+            eff[id(d)] = s
+        else:
+            eff[id(d)] = cursor
+    pre.sort(key=lambda d: (d.get("epoch", -1), eff[id(d)], _wall(d),
+                            d.get("rank", 0), d.get("seq", 0)))
+    return pre
+
+
+class FleetEvents:
+    """Rank 0's fold of every rank's event batches (the piggyback
+    sink), merged into one causally-ordered chronicle.
+
+    Ordering: (epoch, step, skew-adjusted wall_ns, rank, seq) — epochs
+    are collectively agreed, the step cursor is collective at commit
+    boundaries, and only *within* one (epoch, step) cell does the
+    ordering fall back to wall clocks, where the skew adjustment (the
+    health plane's RTT-estimated offsets, wall anchors as fallback)
+    bounds the error to ~rtt/2. The same total order on the same event
+    set regardless of ingestion order — determinism is what makes two
+    operators reading the same chronicle see the same incident."""
+
+    def __init__(self, size: int, capacity: int = 4096):
+        self.size = size
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._by_rank: Dict[int, deque] = {}
+        self._seen: Dict[int, int] = {}  # rank -> next unseen seq
+        self._anchors: Dict[int, dict] = {}
+        # peer mono-clock offsets (peer - local), from health heartbeats
+        self._mono_offsets: Dict[int, int] = {}
+        self._local_anchor = clock.anchor_meta()
+
+    def set_offsets(self, offsets: Dict[int, int]):
+        """Best-effort mono-clock offsets from the heartbeat monitor
+        (tracing.estimate_offset samples, minimum-RTT wins)."""
+        with self._lock:
+            self._mono_offsets.update(offsets)
+
+    def ingest(self, rank: int, batch: List[list],
+               anchor: Optional[dict] = None):
+        with self._lock:
+            if anchor:
+                self._anchors[rank] = anchor
+            dq = self._by_rank.get(rank)
+            if dq is None:
+                dq = self._by_rank[rank] = deque(maxlen=self.capacity)
+            nxt = self._seen.get(rank, 0)
+            for ev in batch:
+                ev = tuple(ev)
+                if ev[0] < nxt:
+                    continue  # re-pushed batch (dedup by seq)
+                nxt = ev[0] + 1
+                dq.append(ev)
+            self._seen[rank] = nxt
+
+    def ingest_blob(self, peer_rank: int, blob: Optional[bytes]):
+        """Feed from a telemetry piggyback blob; tolerant of blobs
+        without an events section (mixed-version fleets)."""
+        if not blob:
+            return
+        try:
+            d = json.loads(blob.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            return
+        sec = d.get("events")
+        if not isinstance(sec, dict):
+            return
+        batch = sec.get("batch")
+        if isinstance(batch, list):
+            self.ingest(peer_rank, batch, anchor=sec.get("anchor"))
+
+    # -- clock alignment ------------------------------------------------
+    def skew_ns(self, rank: int) -> int:
+        """Estimated wall-clock skew of `rank` relative to this
+        process: remote_wall - local_wall at the same instant. The
+        health plane's mono offset is exact up to rtt/2 when present;
+        wall anchors reduce to 0 when both processes trust the same
+        wall clock (single host, NTP-synced fleet)."""
+        with self._lock:
+            anchor = self._anchors.get(rank)
+            mono_off = self._mono_offsets.get(rank)
+        if anchor is None:
+            return 0
+        try:
+            remote_w2m = (int(anchor["wall_anchor_ns"])
+                          - int(anchor["mono_anchor_ns"]))
+            local_w2m = (int(self._local_anchor["wall_anchor_ns"])
+                         - int(self._local_anchor["mono_anchor_ns"]))
+        except (KeyError, TypeError, ValueError):
+            return 0
+        if mono_off is None:
+            # Without an RTT sample, both walls are trusted: skew 0.
+            return 0
+        # remote_wall = remote_mono + remote_w2m; at the same instant
+        # remote_mono = local_mono + mono_off, so:
+        return mono_off + remote_w2m - local_w2m
+
+    def skews(self) -> Dict[int, int]:
+        with self._lock:
+            ranks = list(self._by_rank)
+        return {r: self.skew_ns(r) for r in ranks}
+
+    # -- merged chronicle -----------------------------------------------
+    def merged(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            rows = [(r, ev) for r, dq in self._by_rank.items()
+                    for ev in dq]
+        skews = {r: self.skew_ns(r) for r in {r for r, _ in rows}}
+        out = []
+        for r, ev in rows:
+            d = to_dict(ev)
+            d["adj_wall_ns"] = ev[1] - skews.get(r, 0)
+            out.append(d)
+        out = causal_order(out)
+        if limit is not None:
+            out = out[-limit:]
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            ranks = sorted(self._by_rank)
+            depths = {str(r): len(self._by_rank[r]) for r in ranks}
+        return {
+            "ranks": ranks,
+            "depths": depths,
+            "skew_ns": {str(r): self.skew_ns(r) for r in ranks},
+            "events": self.merged(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Journal replay (incident_report.py + tests)
+
+def read_journal(path: str) -> List[dict]:
+    """Parse one JSONL journal, tolerating the torn tail line a hard
+    kill leaves behind (the spool appends; only a complete line is a
+    complete event). Unparseable interior lines are skipped too — one
+    corrupt line must not cost the chronicle."""
+    out: List[dict] = []
+    try:
+        data = atomic_file.checked_read_bytes(path)
+    except (OSError, IOError):
+        return out
+    for line in data.decode("utf-8", "replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue  # torn/corrupt line
+        if isinstance(d, dict) and "kind" in d:
+            out.append(d)
+    return out
+
+
+def read_anchor(journal: str) -> Optional[dict]:
+    try:
+        data = atomic_file.checked_read_bytes(journal + ANCHOR_SUFFIX)
+        d = json.loads(data.decode("utf-8"))
+        return d if isinstance(d, dict) else None
+    except (OSError, IOError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Process-wide recorder (the emit() singleton; engines and the driver
+# share it — lifecycle truth is per-process, not per-engine).
+
+_current: Optional[EventRecorder] = None
+_current_lock = threading.Lock()
+
+
+def current(rank: Optional[int] = None) -> EventRecorder:
+    global _current
+    with _current_lock:
+        if _current is None:
+            _current = EventRecorder(rank=rank)
+        return _current
+
+
+def set_current(rec: Optional[EventRecorder]):
+    global _current
+    with _current_lock:
+        if _current is not None and _current is not rec:
+            _current.close_spool(timeout=1.0)
+        _current = rec
+
+
+def active() -> Optional[EventRecorder]:
+    return _current
+
+
+def local_view() -> dict:
+    """The single-rank /events body — mesh mode has no engine (and so
+    no fleet fold); its exporters serve this rank's ring alone, the
+    same ``local`` shape engine._events_view produces."""
+    rec = active()
+    if rec is None or not rec.enabled:
+        return {"local": {"enabled": False}}
+    return {"local": {**rec.status(), "events": rec.tail(n=rec.capacity)}}
+
+
+def set_rank(rank: int):
+    """Elastic renumbering: later events carry the live rank (the
+    journal file keeps its original name — events self-describe)."""
+    rec = _current
+    if rec is not None:
+        rec.rank = rank
+
+
+def emit(kind: str, severity: str = INFO, rank: Optional[int] = None,
+         **attrs) -> Optional[tuple]:
+    """The one-line emitter every subsystem calls. Zero cost when the
+    plane is disabled (capacity 0): one attribute read + one branch."""
+    rec = _current
+    if rec is None:
+        rec = current()
+    if not rec.capacity:
+        return None
+    return rec.record(kind, severity=severity, attrs=attrs or None,
+                      rank=rank)

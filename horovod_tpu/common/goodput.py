@@ -673,6 +673,12 @@ class GoodputLedger:
         if lost:
             self._m_replayed.inc(lost)
             self._m_replay_s.inc(replay_s)
+            from . import events as events_mod
+
+            events_mod.emit(events_mod.CKPT_REPLAY,
+                            severity=events_mod.WARN, rank=self.rank,
+                            restored_step=target, lost_steps=lost,
+                            replay_seconds=round(replay_s, 3))
             logger.info(
                 "goodput: restore to step %d loses %d executed steps "
                 "(~%.1fs of replay badput)", target, lost, replay_s)

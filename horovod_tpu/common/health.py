@@ -363,6 +363,12 @@ class HeartbeatMonitor:
             pass
         logger.error("liveness: %s", reason)
         self._m_dead.inc()
+        from . import events as events_mod
+
+        events_mod.emit(events_mod.HEALTH_VERDICT,
+                        severity=events_mod.ERROR, rank=self.rank,
+                        peer=peer, host=host,
+                        silence_s=round(silence, 1))
         self.verdicts[peer] = reason
         if self._first_declared is None:
             self._first_declared = time.monotonic()

@@ -553,12 +553,20 @@ def stitch_post_mortem(trace_dir: str, verdict: str = "",
                        health: Optional[dict] = None,
                        expect_ranks: Optional[int] = None,
                        grace_s: float = 5.0,
-                       out_name: str = POSTMORTEM_NAME) -> Optional[str]:
+                       out_name: str = POSTMORTEM_NAME,
+                       offsets: Optional[Dict[int, int]] = None
+                       ) -> Optional[str]:
     """Coordinator-side black box: read every rank's flight dump under
     `trace_dir` (polling up to `grace_s` for stragglers still writing —
     the dumps race the stitch on an engine death), align clocks via
-    wall anchors, and write one merged Chrome trace carrying the health
-    verdict. Returns the output path, or None if no dumps appeared."""
+    the health plane's RTT-estimated `offsets` (wall anchors as the
+    fallback — those trust each host's wall clock verbatim), and write
+    one merged Chrome trace carrying the health verdict. Each rank's
+    summary records the alignment actually applied as ``skew_ns``, so
+    incident tooling (scripts/incident_report.py) can re-order
+    cross-host events on one timebase. Lifecycle events riding the
+    flight dumps land as instant markers in the merged trace. Returns
+    the output path, or None if no dumps appeared."""
     deadline = time.monotonic() + max(grace_s, 0.0)
     paths: List[str] = []
     while True:
@@ -585,14 +593,20 @@ def stitch_post_mortem(trace_dir: str, verdict: str = "",
             local_anchor = d.get("anchor")
     if local_anchor is None and docs:
         local_anchor = docs[0].get("anchor")
+    offsets = offsets or {}
     for d in docs:
         anchor = d.get("anchor")
+        r = int(d.get("rank", -1))
+        off = offsets.get(r)
+        if off is None:
+            off = wall_anchor_offset(anchor, local_anchor)
         segments.append({
-            "rank": int(d.get("rank", -1)),
+            "rank": r,
             "events": d.get("events", []),
             "anchor": anchor,
-            "offset_ns": wall_anchor_offset(anchor, local_anchor),
+            "offset_ns": int(off),
         })
+    skew_by_rank = {s["rank"]: s["offset_ns"] for s in segments}
     base = (local_anchor or {}).get("mono_anchor_ns", 0)
     doc = render_chrome(segments, base_ns=base, metadata={
         "horovod_postmortem": {
@@ -619,10 +633,33 @@ def stitch_post_mortem(trace_dir: str, verdict: str = "",
                                       .get("goodput") or {}).get("ratio"),
                     "goodput_steps": ((d.get("goodput") or {})
                                       .get("steps") or {}).get("total"),
+                    # Clock alignment actually applied to this rank's
+                    # lane (peer mono clock minus the coordinator's,
+                    # ns): RTT-estimated when the health plane had a
+                    # sample, wall-anchor fallback otherwise.
+                    "skew_ns": skew_by_rank.get(int(d.get("rank", -1)), 0),
+                    "lifecycle_events": len(d.get("lifecycle") or []),
                 } for d in docs
             },
         },
     })
+    # Lifecycle markers (docs/events.md): each dump's events-plane tail
+    # becomes instant events on that rank's lane, so a re-mesh / drain /
+    # swap reads inline with the spans around it.
+    from ..utils import chrome_trace
+
+    for d in docs:
+        r = int(d.get("rank", -1))
+        off = skew_by_rank.get(r, 0)
+        for led in d.get("lifecycle") or []:
+            try:
+                ts_us = (int(led["mono_ns"]) - off - base) / 1e3
+            except (KeyError, TypeError, ValueError):
+                continue
+            doc["traceEvents"].append(chrome_trace.instant(
+                str(led.get("kind", "event")), ts_us, pid=r,
+                cat="lifecycle",
+                args={k: v for k, v in led.items() if k != "mono_ns"}))
     out = os.path.join(trace_dir, out_name)
     atomic_file.atomic_write(out, lambda f: json.dump(doc, f), mode="w")
     return out

@@ -5,6 +5,7 @@ import functools
 from typing import Callable
 
 from ..common import basics, drain, goodput, telemetry
+from ..common import events as events_mod
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 from .state import State
@@ -32,6 +33,7 @@ def _reset():
     from ..backend import elastic_env
 
     _m_resets.inc()
+    events_mod.emit(events_mod.ELASTIC_RESET)
     # shutdown() also stops the notification server (it must not leak
     # across resets); re-init it after the new topology lands so this
     # worker re-registers its endpoint — under the NEW epoch's env —
@@ -121,6 +123,9 @@ def run_fn(func: Callable, state: State, *args, **kwargs):
                     "collective failure",
                     bucket="preemption" if peer_drained else "failure")
                 _m_restores.inc()
+                events_mod.emit(events_mod.ELASTIC_RESTORE,
+                                severity=events_mod.WARN,
+                                peer_drained=peer_drained)
                 state.restore()
                 # In-memory rollback to the last commit: steps past it
                 # are replay badput.

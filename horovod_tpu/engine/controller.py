@@ -185,6 +185,14 @@ class Controller:
         # off.
         self.alert_push = None
         self.alert_sink = None
+        # -- events plane (common/events.py, docs/events.md) -----------
+        # Lifecycle-event batches ride the same piggyback: `events_push`
+        # (a callable returning {"batch", "anchor"} of new events) is
+        # merged into the push blob; `events_sink` (rank 0's
+        # FleetEvents) ingests every gathered blob. Wired by
+        # Engine.start(); None when the events plane is off.
+        self.events_push = None
+        self.events_sink = None
         # Per-tensor request-arrival stamps (coordinator): feed the
         # NEGOTIATE span and the straggler attribution gauges — the
         # rank whose request lands last is the one everyone waited for.
@@ -348,6 +356,13 @@ class Controller:
                         extra["alerts"] = self.alert_push()
                     except Exception:  # alerts must never stall a cycle
                         pass
+                if self.events_push is not None:
+                    try:
+                        ev_sec = self.events_push()
+                        if ev_sec:
+                            extra["events"] = ev_sec
+                    except Exception:  # events must never stall a cycle
+                        pass
                 req_list.telemetry = _telemetry.encode_push(
                     self.registry, self.rank, extra=extra or None)
             try:
@@ -382,6 +397,9 @@ class Controller:
                                 peer_rank, rl.telemetry)
                         if self.alert_sink is not None:
                             self.alert_sink.ingest_blob(
+                                peer_rank, rl.telemetry)
+                        if self.events_sink is not None:
+                            self.events_sink.ingest_blob(
                                 peer_rank, rl.telemetry)
                     shutdown = shutdown or rl.shutdown
                     for req in rl.requests:

@@ -318,6 +318,11 @@ class Engine:
         self.sampler = None
         self.alerts = None
         self._fleet_alerts = None
+        # Events plane (common/events.py, docs/events.md): the
+        # process-wide lifecycle journal; rank 0 folds every rank's
+        # batches (FleetEvents) for the /events chronicle. Wired by
+        # start(); None when HOROVOD_EVENTS_BUFFER=0.
+        self._fleet_events = None
         # Event-driven cycles: enqueues (and shutdown) set the event so
         # HOROVOD_CYCLE_TIME is a max-coalescing delay, not a floor.
         self._wake = threading.Event()
@@ -467,6 +472,14 @@ class Engine:
         # Goodput plane (docs/goodput.md): the step/badput ledger in
         # compact form — "how much of this job became training".
         st["goodput"] = self.goodput.status_summary()
+        # Events plane (docs/events.md): ring state + a compact tail of
+        # the newest lifecycle events — "what just happened to this
+        # job" without opening the full /events chronicle.
+        from ..common import events as _events
+
+        ev_rec = _events.active()
+        if ev_rec is not None and ev_rec.enabled:
+            st["events"] = {**ev_rec.status(), "tail": ev_rec.tail()}
         # Durability plane: last committed/pending checkpoint step,
         # last error (docs/checkpoint.md). The manager is owned by the
         # elastic run loop, not the engine — report whichever one is
@@ -559,6 +572,33 @@ class Engine:
                 fleet["max_exposed_comm_seconds"] = \
                     worst[1]["exposed_comm_seconds"]
             body["fleet"] = fleet
+        return body
+
+    # -- events plane view (docs/events.md) -----------------------------
+    def _events_view(self) -> dict:
+        """The /events body: this rank's ring state + tail, plus
+        (coordinator) the fleet fold — the merged causally-ordered
+        chronicle with per-rank clock-skew annotations."""
+        from ..common import events as events_mod
+
+        rec = events_mod.active()
+        if rec is None or not rec.enabled:
+            return {"local": {"enabled": False}}
+        body: dict = {"local": {**rec.status(),
+                                "events": rec.tail(n=rec.capacity)}}
+        fleet = self._fleet_events
+        if fleet is not None:
+            # Render-time freshness fold (the collect_local idiom):
+            # rank 0's own events never ride the piggyback, and skew
+            # estimates improve as heartbeats sample.
+            from ..utils import clock as _clock
+
+            fleet.ingest(self.rank, rec.snapshot(),
+                         anchor=_clock.anchor_meta())
+            health = self._health
+            if health is not None:
+                fleet.set_offsets(health.clock_offsets())
+            body["fleet"] = fleet.snapshot()
         return body
 
     # ------------------------------------------------------------------
@@ -657,6 +697,25 @@ class Engine:
         for exp in self._exporters:
             if isinstance(exp, metrics_export.MetricsHTTPServer):
                 exp.add_view("goodput", self._goodput_view)
+        # Events plane (docs/events.md): lifecycle batches ride the
+        # telemetry piggyback exactly like spans and alert state; rank 0
+        # folds them into the causally-ordered /events chronicle.
+        from ..common import events as events_mod
+
+        ev_rec = events_mod.current(rank=self.rank)
+        events_mod.set_rank(self.rank)
+        if ev_rec.enabled:
+            ctrl = self.controller
+            if ctrl is not None:
+                ctrl.events_push = ev_rec.make_push()
+                if ctrl.is_coordinator:
+                    self._fleet_events = events_mod.FleetEvents(self.size)
+                    ctrl.events_sink = self._fleet_events
+            for exp in self._exporters:
+                if isinstance(exp, metrics_export.MetricsHTTPServer):
+                    exp.add_view("events", self._events_view)
+            events_mod.emit(events_mod.ENGINE_INIT, rank=self.rank,
+                            size=self.size)
 
     def _background_loop(self):
         try:
@@ -1438,12 +1497,44 @@ class Engine:
             segments = [{"rank": self.rank,
                          "events": self.tracer.recorder.snapshot(),
                          "anchor": clock.anchor_meta(), "offset_ns": 0}]
-        return tracing.render_chrome(
+        doc = tracing.render_chrome(
             segments, base_ns=clock.MONO_ANCHOR_NS,
             metadata={"horovod_trace": {
                 "rank": self.rank, "size": self.size,
                 "clock_offsets_ns": {str(k): v for k, v in offsets.items()},
             }})
+        self._append_lifecycle_instants(doc, offsets)
+        return doc
+
+    def _append_lifecycle_instants(self, doc: dict, offsets: dict):
+        """Land the lifecycle chronicle (docs/events.md) as instant
+        events in the merged trace: every re-mesh, drain, commit and
+        swap shows as a vertical marker inline with the spans that
+        surround it. Coordinator uses the fleet fold (all ranks,
+        skew-adjusted); elsewhere the local ring."""
+        from ..common import events as events_mod
+        from ..utils import chrome_trace
+
+        base = clock.MONO_ANCHOR_NS
+        fleet = self._fleet_events
+        if fleet is not None:
+            rows = [(d["rank"], d) for d in fleet.merged()]
+        else:
+            rec = events_mod.active()
+            if rec is None or not rec.enabled:
+                return
+            rows = [(d["rank"], d)
+                    for d in (events_mod.to_dict(e)
+                              for e in rec.snapshot())]
+        for r, d in rows:
+            try:
+                ts_us = (int(d["mono_ns"]) - offsets.get(r, 0) - base) / 1e3
+            except (KeyError, TypeError, ValueError):
+                continue
+            doc["traceEvents"].append(chrome_trace.instant(
+                str(d.get("kind", "event")), ts_us, pid=r,
+                cat="lifecycle",
+                args={k: v for k, v in d.items() if k != "mono_ns"}))
 
     def _trace_json(self) -> str:
         import json
@@ -1497,6 +1588,16 @@ class Engine:
         # Goodput ledger: the post-mortem carries "how much of this job
         # had become training by the time it died" next to the spans.
         extra["goodput"] = self.goodput.view()
+        # Lifecycle chronicle (docs/events.md): the ring rides the
+        # flight dump so stitch_post_mortem can rebuild the incident
+        # sequence (notice -> commit -> drained -> re-mesh -> restore)
+        # even when no spool dir was configured.
+        from ..common import events as events_mod
+
+        ev_rec = events_mod.active()
+        if ev_rec is not None and ev_rec.enabled:
+            extra["lifecycle"] = [events_mod.to_dict(e)
+                                  for e in ev_rec.snapshot()]
         path = self.tracer.dump_flight(
             tracing.flight_path(trace_dir, self.rank), self.rank,
             extra=extra)
@@ -1516,6 +1617,8 @@ class Engine:
             verdict=str(self._fatal_error or ""),
             health=health,
             expect_ranks=self.size,
+            offsets=(self._health.clock_offsets()
+                     if self._health is not None else None),
         )
         if out:
             logger.error("post-mortem stitched to %s", out)
@@ -1530,10 +1633,20 @@ class Engine:
     def shutdown(self):
         if self._thread is None:
             return
+        from ..common import events as events_mod
+
+        events_mod.emit(events_mod.ENGINE_SHUTDOWN, rank=self.rank,
+                        size=self.size,
+                        reason=str(self._fatal_error or "requested"))
         self._shutdown_requested.set()
         self._wake.set()  # end any coalescing wait immediately
         self._thread.join(timeout=60)
         self._thread = None
+        # The recorder is process-wide and outlives this engine across
+        # elastic resets — flush the journal writer but keep it alive.
+        ev_rec = events_mod.active()
+        if ev_rec is not None:
+            ev_rec.flush_spool()
         # Goodput ledger: persist a final stamp so the very next
         # lifetime measures downtime from THIS moment, not the last
         # commit (the ledger itself is process-shared and survives).

@@ -99,6 +99,9 @@ class ElasticityController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_action_mono: Optional[float] = None
+        # Last (action, target, reason) journaled — decisions are
+        # events only when they CHANGE (docs/events.md).
+        self._last_published: Optional[tuple] = None
         # rank -> consecutive ticks it was named by a straggler rule
         self._strikes: Dict[int, int] = {}
         self._m = {
@@ -216,6 +219,19 @@ class ElasticityController:
 
     def _publish(self, action: str, target: int, current_np: int,
                  reason: str):
+        # Journal the decision (docs/events.md) — but only on CHANGE:
+        # a steady HOLD re-published every tick is one fact, not a
+        # stream, and must not wash real incidents out of the ring.
+        if (action, target, reason) != self._last_published:
+            self._last_published = (action, target, reason)
+            from ...common import events as events_mod
+
+            events_mod.emit(events_mod.CONTROLLER_DECISION,
+                            severity=(events_mod.INFO if action == HOLD
+                                      else events_mod.WARN),
+                            rank=-1, action=action,
+                            current_np=current_np, target_np=target,
+                            reason=reason)
         try:
             self.driver.rendezvous.handle_put(
                 f"{self._ns}controller/last",

@@ -177,6 +177,11 @@ class HostManager:
                 expiry, self._quarantine.get(host, 0.0))
             logger.warning("quarantining draining host %s for %.0fs",
                            host, max(seconds, 0.0))
+        from ...common import events as events_mod
+
+        events_mod.emit(events_mod.HOST_QUARANTINE,
+                        severity=events_mod.WARN, rank=-1, host=host,
+                        seconds=round(max(seconds, 0.0), 1))
 
     def is_quarantined(self, host: str) -> bool:
         with self._lock:
@@ -200,6 +205,12 @@ class HostManager:
                     "horovod_hosts_blacklisted_total",
                     "Hosts blacklisted after worker failures",
                 ).inc()
+                from ...common import events as events_mod
+
+                events_mod.emit(events_mod.HOST_BLACKLIST,
+                                severity=events_mod.ERROR, rank=-1,
+                                host=host, strikes=strikes,
+                                permanent=expiry == float("inf"))
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:

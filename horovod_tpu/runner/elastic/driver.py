@@ -21,6 +21,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...common import telemetry
+from ...common import events as events_mod
 from ...common.health import VERDICT_KEY_PREFIX, decode_verdict
 from ...utils import env as env_cfg
 from ...utils.logging import get_logger
@@ -66,6 +67,12 @@ class ElasticDriver:
         self.max_np = max_np
         self.poll_interval = poll_interval
         self.epoch = -1
+        # The driver process has no MESH_SCOPE env: stamp its lifecycle
+        # events (remesh/join/evict, quarantines, controller decisions)
+        # with the live mesh epoch so the fleet chronicle's causal sort
+        # interleaves them with the workers' (docs/events.md).
+        events_mod.set_epoch_provider(
+            lambda: self.epoch if self.epoch >= 0 else None)
         self._create_worker: Optional[Callable] = None
         self._workers: Dict[Tuple[str, int], _WorkerRecord] = {}
         self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
@@ -287,6 +294,10 @@ class ElasticDriver:
             if self._drain_t0 is not None and not self._draining:
                 self._m_drain.observe(time.monotonic() - self._drain_t0)
                 self._drain_t0 = None
+            events_mod.emit(events_mod.ELASTIC_REMESH, rank=-1,
+                            mesh_epoch=self.epoch,
+                            world=len(new_assignments),
+                            hosts=len({h for h, _ in new_assignments}))
         if notify_update:
             self._notify_workers(notify_update)
 
@@ -366,6 +377,9 @@ class ElasticDriver:
                 "barrier opened (HOROVOD_ELASTIC_READY_TIMEOUT)",
                 host, idx, self._ready_timeout)
             self._m_evictions.inc()
+            events_mod.emit(events_mod.ELASTIC_EVICT,
+                            severity=events_mod.ERROR, rank=-1,
+                            host=host, slot=idx, reason="ready_timeout")
             self._note_failure()
             if rec is not None and rec.proc.poll() is None:
                 try:
@@ -407,6 +421,9 @@ class ElasticDriver:
                     env_cfg.JOB_NAME):
             if var in _os.environ:
                 extra_env[var] = _os.environ[var]
+        events_mod.emit(events_mod.ELASTIC_JOIN, rank=-1,
+                        host=key[0], slot=key[1], worker_rank=slot.rank,
+                        mesh_epoch=self.epoch)
         proc = self._create_worker(slot, extra_env)
         rec = _WorkerRecord(key, proc)
         rec.thread = threading.Thread(
@@ -529,6 +546,10 @@ class ElasticDriver:
         logger.error("liveness verdict for rank %d (%s:%d): %s — evicting",
                      dead_rank, thost, idx, reason)
         self._m_evictions.inc()
+        events_mod.emit(events_mod.ELASTIC_EVICT,
+                        severity=events_mod.ERROR, rank=-1,
+                        host=thost, slot=idx, worker_rank=dead_rank,
+                        reason="liveness_verdict")
         self._note_failure()
         if rec is not None and rec.proc.poll() is None:
             try:
@@ -598,6 +619,9 @@ class ElasticDriver:
                 "waiting for discovery to find replacements",
                 key[0], key[1], self.min_np)
             return
+        events_mod.emit(events_mod.ELASTIC_EVICT,
+                        severity=events_mod.WARN, rank=-1,
+                        host=key[0], slot=key[1], reason="drain")
         self._activate(notify_update=HostUpdateResult.REMOVED)
 
     def _notify_workers(self, update_res: int):

@@ -263,6 +263,10 @@ class ReplicaSet:
         self.weight_step = step
         self._m_weight_step.set(step)
         self._m_swaps.inc()
+        from ..common import events as events_mod
+
+        events_mod.emit(events_mod.SERVING_SWAP, rank=self.rank,
+                        ckpt_step=step)
         logger.info("serving weights hot-swapped to checkpoint step %d",
                     step)
 
@@ -287,6 +291,12 @@ class ReplicaSet:
         verdict = str(exc)
         self.verdicts.append(verdict)
         self._m_evictions.inc()
+        from ..common import events as events_mod
+
+        events_mod.emit(events_mod.SERVING_EVICT,
+                        severity=events_mod.ERROR, rank=self.rank,
+                        evicted_world_rank=dead_world,
+                        survivors=len(survivors))
         logger.error(
             "serving: evicting world rank %d after verdict '%s'; "
             "re-meshing %d survivors", dead_world, verdict,
@@ -365,6 +375,10 @@ class ServingCoordinator:
             return
         self._swap_target = step
         self._all_staged = False
+        from ..common import events as events_mod
+
+        events_mod.emit(events_mod.SERVING_SWAP_PREPARE,
+                        rank=self.rs.rank, ckpt_step=step)
         logger.info("serving: new weights at checkpoint step %d; "
                     "preparing hot-swap", step)
 

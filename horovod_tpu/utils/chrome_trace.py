@@ -50,6 +50,28 @@ def load_profiler_events(profile_dir: str) -> Optional[List[dict]]:
     return trace_events(read_trace_file(paths[-1]))
 
 
+def instant(name: str, ts_us: float, pid: int = 0, tid: int = 0,
+            scope: str = "p", cat: Optional[str] = None,
+            args: Optional[dict] = None) -> dict:
+    """One Chrome-trace instant event ("ph": "i") — the vertical marker
+    lane-annotation form the lifecycle events plane (docs/events.md)
+    uses to land re-mesh/drain/swap markers inline with spans. `scope`:
+    "g" draws the line across the whole trace, "p" across the process
+    lane, "t" on one thread."""
+    ev = {"ph": "i", "name": name, "pid": pid, "tid": tid,
+          "ts": ts_us, "s": scope}
+    if cat:
+        ev["cat"] = cat
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def instant_events(doc) -> List[dict]:
+    """Every instant event in a trace document (tests + analyzers)."""
+    return [e for e in trace_events(doc) if e.get("ph") == "i"]
+
+
 def write_trace(path: str, events: List[dict], metadata: Optional[dict] = None):
     """Write events as a ``{"traceEvents": [...]}`` document (the object
     form — Perfetto accepts extra top-level keys, so tool metadata rides

@@ -389,6 +389,24 @@ SERVING_SLO_P99_MS = "HOROVOD_SERVING_SLO_P99_MS"
 DEFAULT_METRICS_SAMPLE_SECONDS = 10.0
 DEFAULT_METRICS_HISTORY_SAMPLES = 360
 
+# -- events plane knobs (docs/events.md) -------------------------------
+# Capacity of the per-process lifecycle event ring (common/events.py).
+# The ring overwrites oldest events (counted in
+# horovod_events_dropped_total); 0 disables the events plane entirely
+# (emit becomes a no-op, no spool thread).
+EVENTS_BUFFER = "HOROVOD_EVENTS_BUFFER"
+# Directory for the durable per-rank JSONL event journal
+# (events_rank<r>.jsonl + an atomically written clock-anchor sidecar).
+# Unset (the default) = ring only, no files, no writer thread.
+EVENTS_DIR = "HOROVOD_EVENTS_DIR"
+# Flush cadence of the journal writer thread. Events are queued off the
+# hot path and appended+flushed every this-many seconds; lower = less
+# loss on a hard kill, higher = fewer write() calls.
+EVENTS_SPOOL_SECONDS = "HOROVOD_EVENTS_SPOOL_SECONDS"
+
+DEFAULT_EVENTS_BUFFER = 4096
+DEFAULT_EVENTS_SPOOL_SECONDS = 1.0
+
 # -- telemetry knobs (docs/metrics.md) ---------------------------------
 # Serve Prometheus text at /metrics and live job state at /status from a
 # daemon thread on rank 0. Unset/empty = disabled; 0 = ephemeral port.
@@ -692,6 +710,31 @@ def trace_dir() -> str:
 
 def trace_dump_on_error() -> bool:
     return get_bool(TRACE_DUMP_ON_ERROR, True)
+
+
+def events_buffer() -> int:
+    """Lifecycle-event ring capacity; 0 disables the events plane.
+    A bogus value falls back to the default (the plane must never be
+    silently disabled by a typo)."""
+    try:
+        return max(int(_get(EVENTS_BUFFER) or DEFAULT_EVENTS_BUFFER), 0)
+    except ValueError:
+        return DEFAULT_EVENTS_BUFFER
+
+
+def events_dir() -> str:
+    """Durable JSONL journal directory; empty = ring only."""
+    return get_str(EVENTS_DIR, "")
+
+
+def events_spool_seconds() -> float:
+    """Journal writer flush cadence; floor 0.05 s (a zero/bogus value
+    must not spin the writer thread)."""
+    try:
+        v = float(_get(EVENTS_SPOOL_SECONDS) or DEFAULT_EVENTS_SPOOL_SECONDS)
+    except ValueError:
+        return DEFAULT_EVENTS_SPOOL_SECONDS
+    return max(v, 0.05)
 
 
 def checkpoint_dir() -> str:

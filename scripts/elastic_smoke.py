@@ -18,6 +18,7 @@ seconds.
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import os
 import pickle
 import sys
@@ -27,6 +28,26 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _incident_report():
+    spec = importlib.util.spec_from_file_location(
+        "incident_report",
+        os.path.join(REPO, "scripts", "incident_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ordered(kinds, *want) -> bool:
+    """True when `want` appears as an ordered subsequence of kinds."""
+    i = 0
+    for w in want:
+        try:
+            i = kinds.index(w, i) + 1
+        except ValueError:
+            return False
+    return True
 
 WORKER = textwrap.dedent("""
     import os, pickle, sys, time
@@ -86,6 +107,7 @@ def main() -> int:
                     help="HOROVOD_ELASTIC_READY_TIMEOUT for the driver")
     args = ap.parse_args()
 
+    from horovod_tpu.common import events as events_mod
     from horovod_tpu.runner.elastic.discovery import FixedHosts
     from horovod_tpu.runner.elastic.driver import ElasticDriver
     from horovod_tpu.runner.launch import slot_env, spawn_worker
@@ -93,6 +115,11 @@ def main() -> int:
 
     os.environ["HVDRUN_FORCE_LOCAL"] = "1"
     os.environ["HOROVOD_ELASTIC_READY_TIMEOUT"] = str(args.ready_timeout)
+    events_dir = tempfile.mkdtemp(prefix="hvd_events_")
+    # The driver journals lifecycle events as rank -1
+    # (events_driver.jsonl); workers get the dir via env below.
+    events_mod.set_current(events_mod.EventRecorder(
+        rank=-1, spool_dir=events_dir, spool_seconds=0.1))
     server = RendezvousServer()
     port = server.start()
     driver = ElasticDriver(server, FixedHosts({h: 1 for h in HOSTS}),
@@ -113,6 +140,8 @@ def main() -> int:
             env["HOROVOD_HEARTBEAT_INTERVAL_SECONDS"] = str(args.hb_interval)
             env["HOROVOD_HEARTBEAT_MISS_LIMIT"] = str(args.hb_miss)
             env["SMOKE_TOTAL_BATCHES"] = str(args.batches)
+            env["HOROVOD_EVENTS_DIR"] = events_dir
+            env["HOROVOD_EVENTS_SPOOL_SECONDS"] = "0.1"
             env.pop("HOROVOD_FAULT_INJECT", None)
             if slot.hostname == args.wedge_host:
                 env["HOROVOD_FAULT_INJECT"] = f"wedge:step={args.wedge_step}"
@@ -164,6 +193,27 @@ def main() -> int:
                 print(f"FAIL: wedged host {args.wedge_host} was never "
                       "blacklisted", flush=True)
                 ok = False
+            # The lifecycle chronicle (docs/events.md): merging every
+            # journal must read the wedge as one causal narrative.
+            events_mod.active().flush_spool()
+            report = _incident_report().build_report([events_dir])
+            kinds = [d["kind"] for d in report["events"]]
+            print(f"chronicle: {len(kinds)} events from ranks "
+                  f"{report['summary']['ranks']}", flush=True)
+            # Survivors restore/reset under the OLD epoch (the failed
+            # collective) before the driver's new-epoch remesh — the
+            # causal sort orders the wedge exactly that way.
+            if not _ordered(kinds, "health.verdict", "elastic.evict",
+                            "elastic.restore", "elastic.reset",
+                            "elastic.remesh"):
+                print("FAIL: chronicle lost the wedge narrative "
+                      "(verdict -> evict -> restore -> reset -> "
+                      f"remesh): {kinds}", flush=True)
+                ok = False
+            if not _ordered(kinds, "elastic.evict", "host.blacklist"):
+                print("FAIL: chronicle lost the strike order "
+                      f"(evict -> blacklist): {kinds}", flush=True)
+                ok = False
             print(f"recovered and finished at np=3 in {elapsed:.0f}s "
                   f"(deadline {args.deadline:.0f}s)" if ok else "FAIL",
                   flush=True)
@@ -172,6 +222,9 @@ def main() -> int:
         finally:
             driver.stop()
             server.stop()
+            import shutil
+
+            shutil.rmtree(events_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":

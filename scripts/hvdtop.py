@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""hvdtop: the live fleet operator console (docs/events.md).
+
+One terminal, the whole job: polls a rank's metrics endpoint
+(``/status``, ``/goodput``, ``/alerts``, ``/events``) and — when a
+rendezvous server is reachable — the elastic control plane's KV rows
+(``meta/epoch``, ``controller/last``, ``capacity/grant``, the current
+epoch's drain marker), then renders:
+
+* header — world size, topology epoch, uptime, checkpoint step;
+* per-rank goodput table — steps, goodput ratio, exposed-comm badput
+  (the fleet fold at /goodput names the straggler);
+* firing alerts, fleet-wide (rank-attributed);
+* the elasticity controller's last decision and any capacity grant —
+  the ROADMAP item 5 operator surface for ``controller/last``;
+* an in-flight drain notice for the current epoch;
+* the chronicle tail — the newest causally-ordered lifecycle events
+  from the /events fleet fold (epoch, step cursor, rank, kind).
+
+Usage:
+
+    python scripts/hvdtop.py --metrics 127.0.0.1:9911
+    python scripts/hvdtop.py --metrics :9911 --rendezvous 127.0.0.1:7007
+    python scripts/hvdtop.py --metrics :9911 --once   # one frame, no TUI
+
+``--once`` prints a single frame and exits (CI smokes drive it this
+way); otherwise the console clears and redraws every ``--interval``
+seconds until Ctrl-C. Everything degrades: an endpoint that is down
+renders as "unreachable", never a crash — an operator opens hvdtop
+precisely when the job is misbehaving.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+# -- collection ---------------------------------------------------------
+def fetch_json(host: str, port: int, path: str,
+               timeout: float = 5.0) -> Optional[dict]:
+    """One GET against the metrics endpoint; None when unreachable."""
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+    except Exception:
+        return None
+
+
+def _kv_json(kv, scope: str, key: str) -> Optional[dict]:
+    try:
+        raw = kv.get(scope, key)
+        return json.loads(raw.decode()) if raw else None
+    except Exception:
+        return None
+
+
+def gather(host: str, port: int, kv=None) -> dict:
+    """One polling round: every section the renderer needs, with None
+    for whatever was unreachable. Pure data — tests call this (or feed
+    `render` synthetic snapshots) without a terminal."""
+    snap = {
+        "wall": time.time(),
+        "status": fetch_json(host, port, "/status"),
+        "goodput": fetch_json(host, port, "/goodput"),
+        "alerts": fetch_json(host, port, "/alerts"),
+        "events": fetch_json(host, port, "/events"),
+        "controller": None,
+        "grant": None,
+        "drain": None,
+        "kv_epoch": None,
+    }
+    if kv is not None:
+        snap["controller"] = _kv_json(kv, "controller", "last")
+        try:
+            raw = kv.get("capacity", "grant")
+            snap["grant"] = int(raw.decode()) if raw else None
+        except Exception:
+            pass
+        epoch = None
+        try:
+            raw = kv.get("meta", "epoch")
+            epoch = int(raw.decode()) if raw else None
+        except Exception:
+            pass
+        snap["kv_epoch"] = epoch
+        if epoch is not None:
+            snap["drain"] = _kv_json(kv, f"drain_e{epoch}", "any")
+    return snap
+
+
+# -- rendering ----------------------------------------------------------
+def _age(wall: Optional[float], now: float) -> str:
+    if not wall:
+        return "?"
+    d = max(now - wall, 0.0)
+    return f"{d:.0f}s ago" if d < 120 else f"{d / 60:.0f}m ago"
+
+
+def _fmt_ratio(r) -> str:
+    return f"{r:.3f}" if isinstance(r, (int, float)) else "-"
+
+
+def render(snap: dict, events_tail: int = 12) -> str:
+    """A full frame as text (testable; `main` only adds the ANSI
+    clear)."""
+    now = snap.get("wall", time.time())
+    lines = []
+    st = snap.get("status")
+    if st is None:
+        lines.append("hvdtop — metrics endpoint unreachable")
+    else:
+        ck = st.get("checkpoint") or {}
+        gp = st.get("goodput") or {}
+        epoch = snap.get("kv_epoch")
+        lines.append(
+            "hvdtop — world {w}  epoch {e}  step {s}  "
+            "last commit {c}".format(
+                w=st.get("size", "?"),
+                e="-" if epoch is None else epoch,
+                s=gp.get("steps", "-"),
+                c=ck.get("last_committed_step", "-")))
+    lines.append("=" * 72)
+
+    # Per-rank goodput (the fleet fold names the straggler).
+    gp = snap.get("goodput") or {}
+    fleet = (gp.get("fleet") or {}).get("ranks") or {}
+    if fleet:
+        lines.append("rank  steps  goodput  exposed_comm_s")
+        worst = str((gp.get("fleet") or {}).get("max_exposed_comm_rank"))
+        for r in sorted(fleet, key=lambda x: int(x)):
+            row = fleet[r]
+            mark = "  <- max exposed" if r == worst else ""
+            lines.append(
+                f"{r:>4}  {row.get('steps', '-'):>5}  "
+                f"{_fmt_ratio(row.get('goodput_ratio')):>7}  "
+                f"{row.get('exposed_comm_seconds', 0.0):>14.2f}{mark}")
+    elif gp.get("local"):
+        loc = gp["local"]
+        lines.append(
+            "local goodput: steps {s}  ratio {r}".format(
+                s=(loc.get("steps") or {}).get("total", "-"),
+                r=_fmt_ratio((loc.get("goodput") or {}).get("ratio"))))
+    else:
+        lines.append("goodput: unreachable")
+
+    # Alerts (fleet first; fall back to local).
+    al = snap.get("alerts") or {}
+    firing = []
+    by_rule = (al.get("fleet") or {}).get("firing_by_rule") or {}
+    for rule, ranks in by_rule.items():
+        firing.append(f"{rule} (ranks {ranks})")
+    if not firing:
+        firing = ["local: " + (f.get("rule", "?") if isinstance(f, dict)
+                               else str(f))
+                  for f in (al.get("local") or {}).get("firing") or []]
+    lines.append("-" * 72)
+    if firing:
+        lines.append("ALERTS FIRING: " + "; ".join(sorted(firing)))
+    else:
+        lines.append("alerts: none firing")
+
+    # Controller decision + capacity grant (ROADMAP item 5 surface).
+    ctl = snap.get("controller")
+    if ctl:
+        lines.append(
+            "controller: {a}  np {c} -> {t}  ({reason})  [{age}]".format(
+                a=ctl.get("action", "?"), c=ctl.get("current_np", "?"),
+                t=ctl.get("target_np", "?"),
+                reason=ctl.get("reason", ""),
+                age=_age(ctl.get("wall"), now)))
+    else:
+        lines.append("controller: no decision published")
+    if snap.get("grant") is not None:
+        lines.append(f"capacity grant: {snap['grant']} slots")
+    drain = snap.get("drain")
+    if drain:
+        lines.append(
+            "DRAIN in flight: phase {p}  [{age}]".format(
+                p=drain.get("phase", "?"),
+                age=_age(drain.get("wall"), now)))
+
+    # Chronicle tail: fleet fold when the coordinator serves it,
+    # local ring otherwise.
+    ev = snap.get("events") or {}
+    rows = (ev.get("fleet") or {}).get("events") \
+        or (ev.get("local") or {}).get("events") or []
+    lines.append("-" * 72)
+    lines.append(f"chronicle (newest {min(len(rows), events_tail)} of "
+                 f"{len(rows)} lifecycle events):")
+    for d in rows[-events_tail:]:
+        attrs = d.get("attrs") or {}
+        extras = " ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+        lines.append(
+            "  e{epoch:<3} step {step:<6} r{rank:<3} {sev:<5} "
+            "{kind:<22} {extras}".format(
+                epoch=d.get("epoch", -1), step=d.get("step", 0),
+                rank=d.get("rank", "?"), sev=d.get("sev", ""),
+                kind=d.get("kind", "?"), extras=extras).rstrip())
+    if not rows:
+        lines.append("  (events plane disabled or empty)")
+    return "\n".join(lines)
+
+
+# -- entry point --------------------------------------------------------
+def _parse_hostport(s: str, default_host: str = "127.0.0.1"):
+    host, _, port = s.rpartition(":")
+    return host or default_host, int(port)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--metrics", required=True,
+                   help="host:port of a rank's metrics endpoint "
+                        "(HOROVOD_METRICS_PORT); ':9911' = localhost")
+    p.add_argument("--rendezvous", default=None,
+                   help="host:port of the rendezvous server (defaults "
+                        "to HOROVOD_RENDEZVOUS_ADDR/PORT when set)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--events", type=int, default=12,
+                   help="chronicle tail length")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (no screen clearing)")
+    args = p.parse_args(argv)
+
+    host, port = _parse_hostport(args.metrics)
+    kv = None
+    rdv = args.rendezvous
+    if rdv is None:
+        from horovod_tpu.utils import env as env_cfg
+
+        addr = env_cfg.get_str(env_cfg.RENDEZVOUS_ADDR)
+        kv_port = env_cfg.get_int(env_cfg.RENDEZVOUS_PORT, 0)
+        if addr and kv_port:
+            rdv = f"{addr}:{kv_port}"
+    if rdv:
+        from horovod_tpu.backend.rendezvous import RendezvousClient
+
+        rhost, rport = _parse_hostport(rdv)
+        kv = RendezvousClient(rhost, rport)
+
+    while True:
+        frame = render(gather(host, port, kv), events_tail=args.events)
+        if args.once:
+            print(frame)
+            return 0
+        # Home + clear-to-end: redraw in place without scrollback spam.
+        sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Incident reports that reconstruct themselves (docs/events.md).
+
+Point this at the directory an incident left behind — per-rank JSONL
+event journals (``HOROVOD_EVENTS_DIR``), flight-recorder dumps and the
+stitched ``postmortem.json`` (``HOROVOD_TRACE_DIR``); one directory or
+two — and it merges every source into a single causally-ordered
+chronicle:
+
+* events are deduped by ``(rank, seq)`` across sources (the same event
+  can appear in a journal AND in a flight dump's lifecycle tail);
+* per-rank wall-clock skew comes from ``postmortem.json``'s
+  ``per_rank.skew_ns`` (the health plane's RTT-estimated offsets,
+  already applied to the stitched trace lanes) when present;
+* ordering is ``(epoch, step, skew-adjusted wall, rank, seq)`` — epoch
+  and step cursor are collectively agreed, so a PR 16 preemption drill
+  reads as one narrative regardless of whose clock was fast:
+  notice -> commit barrier -> drained -> quarantine -> re-mesh ->
+  restore -> replay.
+
+Usage:
+
+    python scripts/incident_report.py /path/to/dir [more dirs...]
+    python scripts/incident_report.py DIR --json        # machine form
+    python scripts/incident_report.py DIR --limit 200
+
+Text output is the chronicle plus a header summarizing the verdict,
+sources and per-rank journal health (events, drops, skew). ``--json``
+emits ``{"summary": ..., "events": [...]}`` for tooling.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from horovod_tpu.common import events as events_mod  # noqa: E402
+
+POSTMORTEM = "postmortem.json"
+FLIGHT_GLOB = "flight_rank*.json"
+
+
+# -- sources ------------------------------------------------------------
+def load_journals(directory: str) -> Dict[int, List[dict]]:
+    """Every ``events_rank*.jsonl`` / ``events_driver.jsonl`` journal
+    under `directory`, keyed by the rank recorded IN each event (a
+    journal written before an elastic renumber can carry several)."""
+    by_rank: Dict[int, List[dict]] = {}
+    pattern = os.path.join(directory,
+                           events_mod.JOURNAL_PREFIX + "*.jsonl")
+    paths = sorted(glob.glob(pattern))
+    driver = os.path.join(directory, events_mod.DRIVER_JOURNAL)
+    if os.path.exists(driver):
+        paths.append(driver)
+    for path in paths:
+        for d in events_mod.read_journal(path):
+            by_rank.setdefault(int(d.get("rank", -1)), []).append(d)
+    return by_rank
+
+
+def load_flight_lifecycles(directory: str) -> Dict[int, List[dict]]:
+    """The ``lifecycle`` tail each flight dump carries — the only event
+    source when no spool dir was configured."""
+    by_rank: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(directory, FLIGHT_GLOB))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        r = int(doc.get("rank", -1))
+        for d in doc.get("lifecycle") or []:
+            if isinstance(d, dict) and "kind" in d:
+                by_rank.setdefault(int(d.get("rank", r)), []).append(d)
+    return by_rank
+
+
+def load_postmortem(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, POSTMORTEM)
+    try:
+        with open(path) as f:
+            meta = json.load(f).get("horovod_postmortem")
+            return meta if isinstance(meta, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def skews_from_postmortem(pm: Optional[dict]) -> Dict[int, int]:
+    """rank -> wall-skew ns, as the stitcher computed it (RTT-estimated
+    where the health plane had a sample; 0 = trust the wall clock)."""
+    out: Dict[int, int] = {}
+    for r, d in ((pm or {}).get("per_rank") or {}).items():
+        try:
+            out[int(r)] = int(d.get("skew_ns", 0))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+# -- the merge ----------------------------------------------------------
+def merge_chronicle(sources: List[Dict[int, List[dict]]],
+                    skews: Optional[Dict[int, int]] = None
+                    ) -> List[dict]:
+    """Merge event dicts from several sources into one causally-ordered
+    chronicle. Dedup by (rank, seq) — first source wins (pass journals
+    before flight tails: journals carry the complete history). The sort
+    is FleetEvents.merged's (events.causal_order): collectively-agreed
+    epoch and step cursor first, skew-adjusted wall only breaks ties
+    inside a cell, with step-less control-plane events interleaved at
+    their wall position."""
+    skews = skews or {}
+    seen: set = set()
+    out: List[dict] = []
+    for src in sources:
+        for r, evs in src.items():
+            for d in evs:
+                key: Tuple[int, int] = (r, int(d.get("seq", -1)))
+                if key in seen:
+                    continue
+                seen.add(key)
+                d = dict(d)
+                d["rank"] = r
+                d["adj_wall_ns"] = (int(d.get("wall_ns", 0))
+                                    - skews.get(r, 0))
+                out.append(d)
+    return events_mod.causal_order(out)
+
+
+def build_report(directories: List[str]) -> dict:
+    """Everything the renderers need, from one or more incident dirs."""
+    journals: Dict[int, List[dict]] = {}
+    flights: Dict[int, List[dict]] = {}
+    pm = None
+    for directory in directories:
+        for r, evs in load_journals(directory).items():
+            journals.setdefault(r, []).extend(evs)
+        for r, evs in load_flight_lifecycles(directory).items():
+            flights.setdefault(r, []).extend(evs)
+        if pm is None:
+            pm = load_postmortem(directory)
+    skews = skews_from_postmortem(pm)
+    chron = merge_chronicle([journals, flights], skews)
+    summary = {
+        "directories": list(directories),
+        "events": len(chron),
+        "ranks": sorted({d["rank"] for d in chron}),
+        "journal_ranks": sorted(journals),
+        "flight_ranks": sorted(flights),
+        "skew_ns": {str(r): s for r, s in sorted(skews.items())},
+        "verdict": (pm or {}).get("verdict", ""),
+    }
+    return {"summary": summary, "events": chron}
+
+
+# -- rendering ----------------------------------------------------------
+def render_text(report: dict, limit: Optional[int] = None) -> str:
+    s = report["summary"]
+    chron = report["events"]
+    if limit is not None:
+        chron = chron[-limit:]
+    lines = ["incident report — " + ", ".join(s["directories"]),
+             f"events: {s['events']}  ranks: {s['ranks']}  "
+             f"(journals: {s['journal_ranks']}, "
+             f"flight dumps: {s['flight_ranks']})"]
+    if s["verdict"]:
+        lines.append(f"verdict: {s['verdict']}")
+    if any(s["skew_ns"].values()):
+        lines.append("clock skew applied (ns): " + ", ".join(
+            f"r{r}={v}" for r, v in s["skew_ns"].items() if v))
+    lines.append("=" * 72)
+    t0 = chron[0]["adj_wall_ns"] if chron else 0
+    for d in chron:
+        attrs = d.get("attrs") or {}
+        extras = " ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+        lines.append(
+            "+{t:9.3f}s  e{epoch:<3} step {step:<6} r{rank:<3} "
+            "{sev:<5} {kind:<22} {extras}".format(
+                t=(d["adj_wall_ns"] - t0) / 1e9,
+                epoch=d.get("epoch", -1), step=d.get("step", 0),
+                rank=d["rank"], sev=d.get("sev", ""),
+                kind=d.get("kind", "?"), extras=extras).rstrip())
+    if not chron:
+        lines.append("(no lifecycle events found — was "
+                     "HOROVOD_EVENTS_DIR or HOROVOD_TRACE_DIR set?)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("directories", nargs="+",
+                   help="incident dirs: HOROVOD_EVENTS_DIR and/or "
+                        "HOROVOD_TRACE_DIR (may be the same)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the merged chronicle as JSON")
+    p.add_argument("--limit", type=int, default=None,
+                   help="show only the newest N events (text mode)")
+    args = p.parse_args(argv)
+    report = build_report(args.directories)
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=1)
+        print()
+    else:
+        print(render_text(report, limit=args.limit))
+    return 0 if report["events"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

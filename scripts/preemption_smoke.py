@@ -27,6 +27,7 @@ the two goodput ratios; graceful must beat timeout.
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import os
 import pickle
@@ -37,6 +38,26 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _incident_report():
+    spec = importlib.util.spec_from_file_location(
+        "incident_report",
+        os.path.join(REPO, "scripts", "incident_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ordered(kinds, *want) -> bool:
+    """True when `want` appears as an ordered subsequence of kinds."""
+    i = 0
+    for w in want:
+        try:
+            i = kinds.index(w, i) + 1
+        except ValueError:
+            return False
+    return True
 
 WORKER = textwrap.dedent("""
     import os, pickle, sys, time
@@ -79,14 +100,21 @@ WORKER = textwrap.dedent("""
 HOSTS = ["hostA", "hostB", "hostC", "hostD"]
 
 
-def run_phase(args, fault_spec: str, ckpt_dir: str | None):
+def run_phase(args, fault_spec: str, ckpt_dir: str | None,
+              events_dir: str | None = None):
     """One driver+4 workers run; returns (exit_code, results_by_host,
     driver) with the driver already stopped."""
+    from horovod_tpu.common import events as events_mod
     from horovod_tpu.runner.elastic.discovery import FixedHosts
     from horovod_tpu.runner.elastic.driver import ElasticDriver
     from horovod_tpu.runner.launch import slot_env, spawn_worker
     from horovod_tpu.runner.rendezvous_server import RendezvousServer
 
+    if events_dir is not None:
+        # The driver journals lifecycle events as rank -1
+        # (events_driver.jsonl); workers get the dir via env below.
+        events_mod.set_current(events_mod.EventRecorder(
+            rank=-1, spool_dir=events_dir, spool_seconds=0.1))
     server = RendezvousServer()
     port = server.start()
     driver = ElasticDriver(server, FixedHosts({h: 1 for h in HOSTS}),
@@ -113,6 +141,9 @@ def run_phase(args, fault_spec: str, ckpt_dir: str | None):
                 # Interval >> batches: the only way a manifest appears
                 # is the drain's forced save_now.
                 env["HOROVOD_CHECKPOINT_INTERVAL_STEPS"] = "1000"
+            if events_dir is not None:
+                env["HOROVOD_EVENTS_DIR"] = events_dir
+                env["HOROVOD_EVENTS_SPOOL_SECONDS"] = "0.1"
             if slot.hostname == args.preempt_host:
                 env["HOROVOD_FAULT_INJECT"] = fault_spec
             handle = spawn_worker(slot, [sys.executable, script], env,
@@ -131,6 +162,9 @@ def run_phase(args, fault_spec: str, ckpt_dir: str | None):
         finally:
             driver.stop()
             server.stop()
+            rec = events_mod.active()
+            if events_dir is not None and rec is not None:
+                rec.flush_spool()
 
 
 def main() -> int:
@@ -156,11 +190,59 @@ def main() -> int:
 
     # -- phase 1: announced preemption, graceful drain -----------------
     print("=== phase 1: graceful (announced preemption) ===", flush=True)
-    with tempfile.TemporaryDirectory() as ckpt_dir:
+    with tempfile.TemporaryDirectory() as ckpt_dir, \
+            tempfile.TemporaryDirectory() as events_dir:
         t0 = time.monotonic()
         code, results, driver = run_phase(
-            args, f"preempt:step={args.preempt_step}", ckpt_dir)
+            args, f"preempt:step={args.preempt_step}", ckpt_dir,
+            events_dir=events_dir)
         graceful_s = time.monotonic() - t0
+        # The lifecycle chronicle (docs/events.md): merging every
+        # journal must reconstruct the drill as one causal narrative.
+        report = _incident_report().build_report([events_dir])
+        kinds = [d["kind"] for d in report["events"]]
+        print(f"chronicle: {len(kinds)} events from ranks "
+              f"{report['summary']['ranks']}", flush=True)
+        if not _ordered(kinds, "drain.notice", "drain.commit_barrier",
+                        "drain.drained"):
+            print("FAIL: chronicle lost the drain protocol order "
+                  "(notice -> commit barrier -> drained): "
+                  f"{kinds}", flush=True)
+            ok = False
+        # The manifest finalize (rank 0) races the drained rank's exit
+        # — it only needs that rank's shard, not its liveness — so the
+        # durability claim is barrier -> commit, not drained -> commit.
+        if not _ordered(kinds, "drain.notice", "drain.commit_barrier",
+                        "ckpt.commit"):
+            print("FAIL: chronicle lost the durability order "
+                  "(notice -> commit barrier -> ckpt.commit): "
+                  f"{kinds}", flush=True)
+            ok = False
+        # Driver reaction: quarantine on the notice, then the shrunk
+        # re-mesh. (No elastic.evict here: on a clean drain exit the
+        # worker-exit activation re-meshes before the grace window
+        # ends, and survivors restore/reset under the OLD epoch before
+        # the new epoch's remesh — exactly what the sort shows.)
+        if not _ordered(kinds, "drain.notice", "host.quarantine",
+                        "elastic.remesh"):
+            print("FAIL: chronicle lost the driver reaction order "
+                  f"(notice -> quarantine -> remesh): {kinds}", flush=True)
+            ok = False
+        if not _ordered(kinds, "elastic.restore", "elastic.reset",
+                        "elastic.remesh"):
+            print("FAIL: chronicle lost the recovery order "
+                  f"(restore -> reset -> remesh): {kinds}", flush=True)
+            ok = False
+        restores = [d for d in report["events"]
+                    if d["kind"] == "elastic.restore"]
+        if not any((d.get("attrs") or {}).get("peer_drained")
+                   for d in restores):
+            print("FAIL: no survivor's elastic.restore was attributed "
+                  f"to a draining peer: {restores}", flush=True)
+            ok = False
+        if "drain.peer" not in kinds:
+            print("FAIL: no survivor journaled drain.peer", flush=True)
+            ok = False
         if code != 0:
             print(f"FAIL: graceful phase driver exit {code}", flush=True)
             ok = False

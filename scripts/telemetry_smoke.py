@@ -188,6 +188,23 @@ def worker_straggler():
     if r == 0:
         assert phase == 2, (
             "straggler alert never completed fire->resolve", checks)
+        # Lifecycle journal (docs/events.md): the fire->resolve cycle
+        # must have landed in the events plane too, fire before clear,
+        # both attributed to the rule — and the /events view serves it.
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/events")
+        events_view = json.loads(conn.getresponse().read())
+        assert events_view["local"]["enabled"], events_view
+        evs = [d for d in events_view["local"]["events"]
+               if (d.get("attrs") or {}).get("rule")
+               == "persistent_straggler"]
+        kinds = [d["kind"] for d in evs]
+        assert "alert.fire" in kinds and "alert.clear" in kinds, kinds
+        assert kinds.index("alert.fire") < kinds.index("alert.clear"), \
+            kinds
+        fire = evs[kinds.index("alert.fire")]
+        assert fire["sev"] == "warn" and fire["rank"] == 0, fire
+        checks["alert_events"] = kinds
     hvd.shutdown()
     return checks
 
