@@ -36,11 +36,13 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..cc import native
 from ..common import tracing
 from ..common.exceptions import HorovodInternalError
 from ..common.types import ReduceOp
 from ..utils import clock
 from .base import (
+    _NATIVE_OP,
     _reduce,
     channel_scope,
     current_channel,
@@ -87,8 +89,19 @@ _INPLACE_UFUNC = {
 }
 
 
-def _reduce_into(op: ReduceOp, tgt: np.ndarray, incoming: np.ndarray):
-    """tgt = tgt ⊕ incoming without allocating."""
+def _reduce_into(op: ReduceOp, tgt: np.ndarray, incoming: np.ndarray,
+                 hint_bytes: int = 0):
+    """tgt = tgt ⊕ incoming without allocating.
+
+    Native first (cc/core.cc hvd_reduce_into — ctypes releases the
+    GIL, so segment k's reduce overlaps segment k+1's recv on the
+    engine's worker threads), bitwise-identical ufunc fallback.
+    ``hint_bytes`` carries the full-message size when ``tgt`` is a ring
+    segment, so the native size floor judges the real working set."""
+    name = _NATIVE_OP.get(op)
+    if name is not None and native.reduce_into(name, tgt, incoming,
+                                               hint_bytes=hint_bytes):
+        return
     ufunc = _INPLACE_UFUNC.get(op)
     if ufunc is None:  # pragma: no cover - _RING_OPS gates dispatch
         tgt[:] = _reduce(op, [tgt, incoming])
@@ -693,7 +706,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
                                 t0 = time.perf_counter()
                                 dec = codec.decode(half, b - a)
                                 dec_secs.append(time.perf_counter() - t0)
-                                _reduce_into(red, tgt[a:b], dec)
+                                _reduce_into(red, tgt[a:b], dec,
+                                             hint_bytes=tgt.nbytes)
                                 if tr.enabled:
                                     tr.emit("ring.reduce", "compute",
                                             t_ns, clock.mono_ns() - t_ns,
@@ -721,12 +735,14 @@ class RingCollectivesMixin(StarCollectivesMixin):
                         if b > a:
                             with tr.span("ring.reduce", cat="compute"):
                                 if codec is None:
-                                    _reduce_into(red, tgt[a:b], half)
+                                    _reduce_into(red, tgt[a:b], half,
+                                                 hint_bytes=tgt.nbytes)
                                 else:
                                     t0 = time.perf_counter()
                                     dec = codec.decode(half, b - a)
                                     dec_s += time.perf_counter() - t0
-                                    _reduce_into(red, tgt[a:b], dec)
+                                    _reduce_into(red, tgt[a:b], dec,
+                                                 hint_bytes=tgt.nbytes)
                     if stats is not None and dec_s:
                         stats.observe("decode", dec_s)
                 with tr.span("ring.send_wait", cat="xfer",
@@ -1039,7 +1055,8 @@ class RingCollectivesMixin(StarCollectivesMixin):
                     out=out, codec=codec,
                     stats=wire_codec_stats() if codec is not None
                     else None,
-                    first_hop=self._take_first_hop(flat))
+                    first_hop=self._take_first_hop(flat),
+                    op_name=_NATIVE_OP.get(red))
         except (OSError, TimeoutError) as exc:
             from ..common.exceptions import TransportError
 
@@ -1243,7 +1260,7 @@ class RingCollectivesMixin(StarCollectivesMixin):
                          args={"bytes": int(flat.nbytes)}):
                 arena.reduce_to_member(
                     flat, lambda dst, src: ufunc(dst, src, out=dst),
-                    root=0, out=out)
+                    root=0, out=out, op_name=_NATIVE_OP.get(red))
             # Overlapped bcast: the leader deposits each element range
             # into the arena THE MOMENT the inter-host allgather
             # finishes it (on_chunk fires per ring SEGMENT), so the

@@ -685,7 +685,7 @@ class ShmArena:
 
     # -- collectives ---------------------------------------------------
     def allreduce_into(self, flat, reduce_fn, out=None, codec=None,
-                       stats=None, first_hop=None) -> None:
+                       stats=None, first_hop=None, op_name=None) -> None:
         """Allreduce of a contiguous 1-D numpy array: reads ``flat``,
         writes ``out`` (defaults to ``flat`` — in place). Separate
         src/dst is what lets the caller skip the ring path's defensive
@@ -716,8 +716,18 @@ class ShmArena:
         given, deposits slice it instead of re-encoding — the arena IS
         the op's first hop, so the encode the grid projection already
         paid is the only one. Byte savings still count; no encode
-        latency is observed because no encode runs."""
+        latency is observed because no encode runs.
+
+        ``op_name`` ("sum"/"min"/"max"/"prod") engages the native fused
+        gather-reduce (cc/core.cc hvd_reduce_strided) on the full-width
+        leg: one GIL-free pass reading every peer's slot subslice and
+        writing the result once, instead of per-peer numpy adds that
+        re-read and re-write the accumulator each peer. Rank order is
+        preserved, so results stay bitwise identical to ``reduce_fn``
+        loops (and to fallback-only hosts)."""
         import numpy as np
+
+        from ..cc import native
 
         if out is None:
             out = flat
@@ -765,12 +775,17 @@ class ShmArena:
                     self._result[lo * itemsize:hi * itemsize],
                     dtype=flat.dtype)
                 if codec is None:
-                    span = slice(lo * itemsize, hi * itemsize)
-                    res[:] = np.frombuffer(
-                        self._slot(0)[span], dtype=flat.dtype)
-                    for r in range(1, self.size):
-                        reduce_fn(res, np.frombuffer(
-                            self._slot(r)[span], dtype=flat.dtype))
+                    fused = op_name is not None and native.reduce_strided(
+                        op_name, self._u8,
+                        self._hdr + lo * itemsize, self.slot_bytes,
+                        self.size, -1, res, init=True)
+                    if not fused:
+                        span = slice(lo * itemsize, hi * itemsize)
+                        res[:] = np.frombuffer(
+                            self._slot(0)[span], dtype=flat.dtype)
+                        for r in range(1, self.size):
+                            reduce_fn(res, np.frombuffer(
+                                self._slot(r)[span], dtype=flat.dtype))
                 else:
                     span = slice(lo * wis, hi * wis)
                     t0 = time.perf_counter()
@@ -814,7 +829,7 @@ class ShmArena:
                        _ARENA_LEG_CHUNK_BYTES) // itemsize, 1)
 
     def reduce_to_member(self, flat, reduce_fn, root: int = 0,
-                         out=None) -> None:
+                         out=None, op_name=None) -> None:
         """Fused intra-host gather-reduce to one member: every OTHER
         member deposits its vector chunk-by-chunk into its slot, and
         the member at group position ``root`` accumulates each chunk
@@ -836,8 +851,17 @@ class ShmArena:
         passes on shm memcpy as pure cost; docs/running.md). Byte
         accounting: member deposits count ``sent``, the root's reads of
         member slots count ``recv`` — the leg's two private<->shared
-        moves, conserved per host."""
+        moves, conserved per host.
+
+        ``op_name`` engages the native fused strided accumulate on the
+        root's per-chunk reduce (cc/core.cc hvd_reduce_strided with
+        ``init=0``): the root's critical path — pure aggregate
+        memcpy+reduce, per PR 14's analysis — folds every member slot
+        into its private chunk in one GIL-free pass, member order
+        preserved (bitwise identical to the ``reduce_fn`` loop)."""
         import numpy as np
+
+        from ..cc import native
 
         if out is None:
             out = flat
@@ -858,12 +882,18 @@ class ShmArena:
                 ochunk = out[start:start + n]
                 if out is not flat and n:
                     ochunk[:] = flat[start:start + n]
-                for r in range(self.size):
-                    if r == root or n == 0:
-                        continue
-                    reduce_fn(ochunk, np.frombuffer(
-                        self._slot(r)[off:off + nbytes],
-                        dtype=flat.dtype))
+                fused = n and op_name is not None and \
+                    native.reduce_strided(
+                        op_name, self._u8, self._hdr + off,
+                        self.slot_bytes, self.size, root, ochunk,
+                        init=False)
+                if not fused:
+                    for r in range(self.size):
+                        if r == root or n == 0:
+                            continue
+                        reduce_fn(ochunk, np.frombuffer(
+                            self._slot(r)[off:off + nbytes],
+                            dtype=flat.dtype))
                 self._publish(v)
                 if self.m_recv is not None:
                     self.m_recv.inc((self.size - 1) * nbytes)

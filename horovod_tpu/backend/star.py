@@ -16,9 +16,15 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..common.types import ReduceOp
-from .base import Backend, _reduce, current_wire_codec, wire_codec_stats
+from .base import (Backend, _NATIVE_OP, _reduce, current_wire_codec,
+                   wire_codec_stats)
 
 _LEN = struct.Struct("<Q")
+
+# Elementwise fold ufuncs for the streaming compressed reduce — the
+# numpy mirror of the native reduce_into kernels (docs/native.md).
+_FOLD_UFUNC = {"sum": np.add, "min": np.minimum,
+               "max": np.maximum, "prod": np.multiply}
 
 
 def pack_array(arr: np.ndarray) -> list:
@@ -186,13 +192,49 @@ class StarCollectivesMixin(Backend):
                      args={"bytes": int(enc.nbytes), "codec": codec.name}):
             gathered = self.gather_bytes(pack_wire(flat, codec, enc))
         if self.rank == 0:
+            fold = _NATIVE_OP.get(op)
             with tr.span("star.reduce", cat="compute"):
-                t0 = time.perf_counter()
-                arrays = [unpack_wire(b) for b in gathered]
-                if stats is not None:
-                    stats.observe("decode", time.perf_counter() - t0)
-                nonempty = [a for a in arrays if a.size > 0]
-                out = _reduce(op, nonempty) if nonempty else arrays[0]
+                if fold is None:
+                    t0 = time.perf_counter()
+                    arrays = [unpack_wire(b) for b in gathered]
+                    if stats is not None:
+                        stats.observe("decode", time.perf_counter() - t0)
+                    nonempty = [a for a in arrays if a.size > 0]
+                    out = _reduce(op, nonempty) if nonempty else arrays[0]
+                else:
+                    # Streaming decode+fold (docs/native.md): decode one
+                    # frame at a time and reduce it straight into the
+                    # running accumulator — native reduce_into when the
+                    # .so is loaded, the matching ufunc otherwise — so
+                    # peak memory is two full-width arrays instead of
+                    # world_size + 1.  Rank order is preserved, keeping
+                    # the result bitwise identical to decode-all+_reduce.
+                    from ..cc import native
+
+                    dec = 0.0
+                    out = None
+                    first = None
+                    n_contrib = 0
+                    for b in gathered:
+                        t0 = time.perf_counter()
+                        a = unpack_wire(b)
+                        dec += time.perf_counter() - t0
+                        if first is None:
+                            first = a
+                        if a.size == 0:
+                            # Joined ranks contribute empty == zeros.
+                            continue
+                        n_contrib += 1
+                        if out is None:
+                            out = own_array(np.ascontiguousarray(a))
+                        elif not native.reduce_into(fold, out, a):
+                            _FOLD_UFUNC[fold](out, a, out=out)
+                    if stats is not None:
+                        stats.observe("decode", dec)
+                    if out is None:
+                        out = first
+                    elif op == ReduceOp.AVERAGE:
+                        out = out / n_contrib
             out_flat = np.ascontiguousarray(out).reshape(-1)
             t0 = time.perf_counter()
             enc_out = codec.encode(out_flat)

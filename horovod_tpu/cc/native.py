@@ -5,7 +5,18 @@ load, ref: horovod/common/basics.py:22-233 + check_extension,
 horovod/common/util.py:50): build lazily with make on first use, cache
 the handle, and fail soft — every caller has a NumPy fallback, so an
 unbuildable environment degrades to pure Python instead of erroring.
-Disable explicitly with HOROVOD_DISABLE_NATIVE=1.
+
+Every exported symbol gets ``argtypes``/``restype`` declared up front
+(the ABI table below); a missing or re-typed symbol fails the load
+loudly instead of corrupting buffers, and an ABI version mismatch
+triggers one forced rebuild before giving up. ctypes releases the GIL
+for the duration of each call, which is the whole point: segment k's
+reduce overlaps segment k+1's recv on the engine's worker threads.
+
+``HOROVOD_DISABLE_NATIVE=1`` is honoured per *call*, not per process:
+the handle stays cached but every wrapper reports unavailable while
+the variable is set, so tests and perf A/B stages can flip the ladder
+live.
 """
 from __future__ import annotations
 
@@ -13,28 +24,89 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libhvdtpu.so")
 
+ABI_VERSION = 2
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+# dtype codes shared with core.cc's HVD_DISPATCH_DTYPE. f16/bf16 are
+# carried as their uint16 storage; the kernels compute in f32 with a
+# round-to-storage per op (numpy's ufunc semantics for reduced floats).
 _DTYPES = {
     np.dtype(np.float32): 0,
     np.dtype(np.float64): 1,
     np.dtype(np.int32): 2,
     np.dtype(np.int64): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.float16): 5,
 }
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _DTYPES[np.dtype(_ml_dtypes.bfloat16)] = 6
+except ImportError:  # pragma: no cover - jax images ship ml_dtypes
+    _ml_dtypes = None
+
 _OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
 
+# The full C ABI, declared for every export so drift fails at load
+# time. name -> (restype, argtypes).
+_P = ctypes.c_void_p
+_I64 = ctypes.c_int64
+_INT = ctypes.c_int
+_SYMBOLS = {
+    "hvd_abi_version": (_INT, []),
+    "hvd_threads": (_INT, []),
+    "hvd_reduce": (_INT, [_P, _INT, _I64, _P, _INT, _INT]),
+    "hvd_reduce_into": (_INT, [_P, _P, _I64, _INT, _INT]),
+    "hvd_reduce_strided": (_INT, [_P, _I64, _INT, _INT, _I64, _P, _INT,
+                                  _INT, _INT]),
+    "hvd_pack": (_INT, [_P, _P, _INT, _P]),
+    "hvd_unpack": (_INT, [_P, _P, _INT, _P]),
+    "hvd_bf16_encode": (_INT, [_P, _I64, _P]),
+    "hvd_bf16_decode": (_INT, [_P, _I64, _P]),
+    "hvd_fp16_encode": (_INT, [_P, _I64, _P]),
+    "hvd_fp16_decode": (_INT, [_P, _I64, _P]),
+    "hvd_int8_encode": (_INT, [_P, _I64, _P]),
+    "hvd_int8_decode": (_INT, [_P, _I64, _P]),
+    "hvd_ef_update": (_INT, [_P, _P, _P, _I64]),
+    "hvd_adasum": (_INT, [_P, _INT, _I64]),
+    "hvd_words_op": (None, [_P, _P, _INT, _INT]),
+}
 
-def _build() -> bool:
+# Kernel inventory for /status: wrapper-level feature -> C symbols it
+# needs. Everything ships in one .so, but reporting per kernel keeps
+# the operator story honest if the table ever splits.
+_KERNELS = {
+    "reduce": ["hvd_reduce"],
+    "reduce_into": ["hvd_reduce_into"],
+    "reduce_strided": ["hvd_reduce_strided"],
+    "pack": ["hvd_pack", "hvd_unpack"],
+    "bf16": ["hvd_bf16_encode", "hvd_bf16_decode"],
+    "fp16": ["hvd_fp16_encode", "hvd_fp16_decode"],
+    "int8": ["hvd_int8_encode", "hvd_int8_decode"],
+    "ef_update": ["hvd_ef_update"],
+    "adasum": ["hvd_adasum"],
+    "words_op": ["hvd_words_op"],
+}
+
+
+def _disabled() -> bool:
+    return bool(os.environ.get("HOROVOD_DISABLE_NATIVE"))
+
+
+def _build(force: bool = False) -> bool:
     try:
+        if force and os.path.exists(_LIB_PATH):
+            os.remove(_LIB_PATH)
         subprocess.run(
             ["make", "-C", _DIR, "-s"],
             check=True, capture_output=True, timeout=120,
@@ -44,29 +116,53 @@ def _build() -> bool:
         return False
 
 
+def _declare(lib: ctypes.CDLL) -> bool:
+    """Declare the whole ABI table; False if any symbol is missing."""
+    try:
+        for name, (restype, argtypes) in _SYMBOLS.items():
+            fn = getattr(lib, name)
+            fn.restype = restype
+            fn.argtypes = argtypes
+        return True
+    except AttributeError:
+        return False
+
+
+def _open() -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    try:
+        lib.hvd_abi_version.restype = ctypes.c_int
+        if lib.hvd_abi_version() != ABI_VERSION:
+            return None
+        return lib if _declare(lib) else None
+    except AttributeError:
+        return None
+
+
 def load() -> Optional[ctypes.CDLL]:
-    """The lib handle, building it if needed; None if unavailable."""
+    """The lib handle, building it if needed; None if unavailable or
+    HOROVOD_DISABLE_NATIVE is set right now."""
     global _lib, _tried
+    if _disabled():
+        return None
     if _lib is not None:
         return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("HOROVOD_DISABLE_NATIVE"):
-            return None
         if not os.path.exists(_LIB_PATH) and not _build():
             return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-            lib.hvd_abi_version.restype = ctypes.c_int
-            if lib.hvd_abi_version() != 1:
-                return None
-            lib.hvd_reduce.restype = ctypes.c_int
-            lib.hvd_adasum.restype = ctypes.c_int
-            _lib = lib
-        except OSError:
-            return None
+        lib = _open()
+        if lib is None:
+            # Stale .so (e.g. a checkout from an older ABI): one
+            # forced rebuild before degrading to the numpy ladder.
+            if _build(force=True):
+                lib = _open()
+        _lib = lib
     return _lib
 
 
@@ -77,6 +173,64 @@ def available() -> bool:
 def native_built() -> bool:
     """Introspection à la mpi_built()/gloo_built()."""
     return available()
+
+
+def abi_version() -> Optional[int]:
+    lib = load()
+    return ABI_VERSION if lib is not None else None
+
+
+def kernel_inventory() -> Dict[str, bool]:
+    """Kernel name -> active (native) vs False (numpy fallback)."""
+    lib = load()
+    up = lib is not None
+    return {k: up for k in _KERNELS}
+
+
+def status() -> dict:
+    """Native-core status for /status and the hvdtop badge."""
+    loaded = available()
+    return {
+        "built": os.path.exists(_LIB_PATH),
+        "loaded": loaded,
+        "disabled": _disabled(),
+        "abi": ABI_VERSION if loaded else None,
+        "threads": threads() if loaded else None,
+        "kernels": kernel_inventory(),
+    }
+
+
+def threads() -> Optional[int]:
+    lib = load()
+    return int(lib.hvd_threads()) if lib is not None else None
+
+
+def _ptr(a: np.ndarray) -> int:
+    return a.ctypes.data
+
+
+# Below this size the in-place reduce stays on numpy: its in-cache
+# ufunc kernels beat the ctypes round-trip + native loop on a
+# single-core host (measured crossover ~8MB; docs/native.md), and
+# with no pool workers the GIL-free property buys no overlap either.
+# With workers the kernel parallelizes and the call is GIL-free, so
+# every size is worth taking. HOROVOD_NATIVE_REDUCE_MIN_BYTES
+# overrides (0 = always native).
+_REDUCE_INTO_MIN_BYTES = 8 << 20
+_pool_floor: Optional[int] = None
+
+
+def _reduce_into_floor() -> int:
+    env = os.environ.get("HOROVOD_NATIVE_REDUCE_MIN_BYTES")
+    if env is not None:
+        try:
+            return max(int(env), 0)
+        except ValueError:
+            pass
+    global _pool_floor
+    if _pool_floor is None:
+        _pool_floor = 0 if (threads() or 1) > 1 else _REDUCE_INTO_MIN_BYTES
+    return _pool_floor
 
 
 # ---------------------------------------------------------------------------
@@ -90,14 +244,56 @@ def reduce_arrays(op: str, arrays: Sequence[np.ndarray]) -> Optional[np.ndarray]
         return None
     arrays = [np.ascontiguousarray(a) for a in arrays]
     out = np.empty_like(arrays[0])
-    ptrs = (ctypes.c_void_p * len(arrays))(
-        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
-    )
-    rc = lib.hvd_reduce(
-        ptrs, len(arrays), arrays[0].size,
-        out.ctypes.data_as(ctypes.c_void_p), dt, _OPS[op],
-    )
+    ptrs = (ctypes.c_void_p * len(arrays))(*[_ptr(a) for a in arrays])
+    rc = lib.hvd_reduce(ptrs, len(arrays), arrays[0].size, _ptr(out), dt,
+                        _OPS[op])
     return out if rc == 0 else None
+
+
+def reduce_into(op: str, tgt: np.ndarray, src: np.ndarray,
+                hint_bytes: int = 0) -> bool:
+    """In-place ``tgt op= src`` (the ring's recv+reduce step), GIL-free.
+    False → caller runs the ufunc fallback.
+
+    ``hint_bytes`` is the caller's working-set size when ``tgt`` is one
+    segment of a larger message (the segmented ring): the cache-hot
+    crossover is governed by the whole message, not the segment, and a
+    DRAM-bound pipeline also wants the GIL released so segment k's
+    reduce overlaps segment k+1's recv."""
+    lib = load()
+    if lib is None or op not in _OPS:
+        return False
+    dt = _DTYPES.get(tgt.dtype)
+    if (dt is None or tgt.dtype != src.dtype or tgt.size != src.size
+            or not tgt.flags.c_contiguous or not src.flags.c_contiguous
+            or not tgt.flags.writeable
+            or max(tgt.nbytes, hint_bytes) < _reduce_into_floor()):
+        return False
+    rc = lib.hvd_reduce_into(_ptr(tgt), _ptr(src), tgt.size, dt, _OPS[op])
+    return rc == 0
+
+
+def reduce_strided(op: str, buf: np.ndarray, offset: int, stride: int,
+                   nsrc: int, skip: int, out: np.ndarray,
+                   init: bool) -> bool:
+    """Fused gather-reduce over ``nsrc`` peer slices living at byte
+    ``offset + r*stride`` inside the arena byte buffer ``buf``; reduces
+    straight into ``out`` (seeding it when ``init``, else accumulating),
+    skipping peer ``skip`` (< 0: none). False → caller loops in numpy."""
+    lib = load()
+    if lib is None or op not in _OPS or nsrc <= 0:
+        return False
+    dt = _DTYPES.get(out.dtype)
+    if (dt is None or not out.flags.c_contiguous
+            or not out.flags.writeable or offset < 0 or stride < 0):
+        return False
+    n = out.size
+    if offset + (nsrc - 1) * stride + n * out.itemsize > buf.nbytes:
+        return False
+    rc = lib.hvd_reduce_strided(_ptr(buf) + int(offset), int(stride),
+                                int(nsrc), int(skip), n, _ptr(out), dt,
+                                _OPS[op], 1 if init else 0)
+    return rc == 0
 
 
 def pack(arrays: Sequence[np.ndarray]) -> Optional[np.ndarray]:
@@ -109,12 +305,9 @@ def pack(arrays: Sequence[np.ndarray]) -> Optional[np.ndarray]:
     sizes = (ctypes.c_int64 * len(arrays))(*[a.nbytes for a in arrays])
     total = sum(a.nbytes for a in arrays)
     dst = np.empty(total, np.uint8)
-    ptrs = (ctypes.c_void_p * len(arrays))(
-        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
-    )
-    lib.hvd_pack(ptrs, sizes, len(arrays),
-                 dst.ctypes.data_as(ctypes.c_void_p))
-    return dst
+    ptrs = (ctypes.c_void_p * len(arrays))(*[_ptr(a) for a in arrays])
+    rc = lib.hvd_pack(ptrs, sizes, len(arrays), _ptr(dst))
+    return dst if rc == 0 else None
 
 
 def unpack(buf: np.ndarray, shapes: List[tuple], dtype) -> Optional[List[np.ndarray]]:
@@ -124,13 +317,102 @@ def unpack(buf: np.ndarray, shapes: List[tuple], dtype) -> Optional[List[np.ndar
     buf = np.ascontiguousarray(buf.view(np.uint8).ravel())
     outs = [np.empty(s, dtype) for s in shapes]
     sizes = (ctypes.c_int64 * len(outs))(*[o.nbytes for o in outs])
-    ptrs = (ctypes.c_void_p * len(outs))(
-        *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs]
-    )
-    lib.hvd_unpack(buf.ctypes.data_as(ctypes.c_void_p), sizes, len(outs), ptrs)
-    return outs
+    ptrs = (ctypes.c_void_p * len(outs))(*[_ptr(o) for o in outs])
+    rc = lib.hvd_unpack(_ptr(buf), sizes, len(outs), ptrs)
+    return outs if rc == 0 else None
 
 
+# ---------------------------------------------------------------------------
+# wire codec passes (bit-identical to common/compression.py fallbacks)
+
+def _as_f32_1d(a: np.ndarray) -> Optional[np.ndarray]:
+    if a.dtype != np.float32 or not a.flags.c_contiguous or a.ndim != 1:
+        return None
+    return a
+
+
+def bf16_encode(a: np.ndarray) -> Optional[np.ndarray]:
+    lib = load()
+    a = _as_f32_1d(a) if lib is not None else None
+    if a is None:
+        return None
+    out = np.empty(a.size, np.uint16)
+    rc = lib.hvd_bf16_encode(_ptr(a), a.size, _ptr(out))
+    return out.view(np.uint8) if rc == 0 else None
+
+
+def bf16_decode(buf, count: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    src = np.frombuffer(buf, dtype=np.uint8, count=2 * count)
+    out = np.empty(count, np.float32)
+    rc = lib.hvd_bf16_decode(_ptr(src), count, _ptr(out))
+    return out if rc == 0 else None
+
+
+def fp16_encode(a: np.ndarray) -> Optional[np.ndarray]:
+    lib = load()
+    a = _as_f32_1d(a) if lib is not None else None
+    if a is None:
+        return None
+    out = np.empty(a.size, np.uint16)
+    rc = lib.hvd_fp16_encode(_ptr(a), a.size, _ptr(out))
+    return out.view(np.uint8) if rc == 0 else None
+
+
+def fp16_decode(buf, count: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    src = np.frombuffer(buf, dtype=np.uint8, count=2 * count)
+    out = np.empty(count, np.float32)
+    rc = lib.hvd_fp16_decode(_ptr(src), count, _ptr(out))
+    return out if rc == 0 else None
+
+
+def int8_encode(a: np.ndarray) -> Optional[np.ndarray]:
+    """Scale header (4B LE f32) + quantized bytes, like Int8Codec."""
+    lib = load()
+    a = _as_f32_1d(a) if lib is not None else None
+    if a is None:
+        return None
+    out = np.empty(4 + a.size, np.uint8)
+    rc = lib.hvd_int8_encode(_ptr(a), a.size, _ptr(out))
+    return out if rc == 0 else None
+
+
+def int8_decode(buf, count: int) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    src = np.frombuffer(buf, dtype=np.uint8, count=4 + count)
+    out = np.empty(count, np.float32)
+    rc = lib.hvd_int8_decode(_ptr(src), count, _ptr(out))
+    return out if rc == 0 else None
+
+
+def ef_update(residual: np.ndarray, pre: np.ndarray,
+              wire: np.ndarray) -> bool:
+    """residual = pre - wire with non-finite lanes zeroed, in place."""
+    lib = load()
+    if lib is None:
+        return False
+    if not (residual.dtype == pre.dtype == wire.dtype == np.float32):
+        return False
+    if not (residual.size == pre.size == wire.size):
+        return False
+    for a in (residual, pre, wire):
+        if not a.flags.c_contiguous:
+            return False
+    if not residual.flags.writeable:
+        return False
+    rc = lib.hvd_ef_update(_ptr(residual), _ptr(pre), _ptr(wire),
+                           residual.size)
+    return rc == 0
+
+
+# ---------------------------------------------------------------------------
 def adasum(arrays: Sequence[np.ndarray]) -> Optional[List[np.ndarray]]:
     """In-place VHDD Adasum over a power-of-2 list; returns the combined
     result per input slot (all identical), original dtypes preserved."""

@@ -48,6 +48,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..cc import native as _native
+
 # ---------------------------------------------------------------------------
 # framework Compression API (interface + identity; adapters live in the
 # framework modules)
@@ -156,6 +158,9 @@ class Bf16Codec(WireCodec):
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
         a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        out = _native.bf16_encode(a)  # GIL-free, bit-identical
+        if out is not None:
+            return out
         if _BF16_DTYPE is not None:
             return a.astype(_BF16_DTYPE).view(np.uint8)
         u = a.view(np.uint32)
@@ -173,6 +178,9 @@ class Bf16Codec(WireCodec):
         return out.view(np.uint8)
 
     def decode(self, buf, count: int) -> np.ndarray:
+        out = _native.bf16_decode(buf, count)
+        if out is not None:
+            return out
         if _BF16_DTYPE is not None:
             return np.frombuffer(
                 buf, dtype=_BF16_DTYPE, count=count).astype(np.float32)
@@ -194,10 +202,16 @@ class Fp16Codec(WireCodec):
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
         a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        out = _native.fp16_encode(a)  # GIL-free, bit-identical
+        if out is not None:
+            return out
         with np.errstate(over="ignore"):  # >65504 saturates to inf
             return a.astype(np.float16).view(np.uint8)
 
     def decode(self, buf, count: int) -> np.ndarray:
+        out = _native.fp16_decode(buf, count)
+        if out is not None:
+            return out
         return np.frombuffer(
             buf, dtype=np.float16, count=count).astype(np.float32)
 
@@ -220,6 +234,9 @@ class Int8Codec(WireCodec):
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
         a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        out = _native.int8_encode(a)  # GIL-free, bit-identical
+        if out is not None:
+            return out
         scale = 0.0
         if a.size:
             finite = a[np.isfinite(a)]
@@ -238,6 +255,9 @@ class Int8Codec(WireCodec):
         return out
 
     def decode(self, buf, count: int) -> np.ndarray:
+        out = _native.int8_decode(buf, count)
+        if out is not None:
+            return out
         view = memoryview(buf)
         (scale,) = _SCALE.unpack(bytes(view[:_SCALE.size]))
         q = np.frombuffer(view, dtype=np.int8, count=count,
@@ -341,10 +361,13 @@ class ErrorFeedback:
             old = self._store.get(key)
         if old is not None and old.size == pre.size \
                 and old.dtype == pre.dtype:
-            np.subtract(pre, wire, out=old)
-            if not np.isfinite(old).all():  # see put()
-                np.nan_to_num(old, copy=False, nan=0.0, posinf=0.0,
-                              neginf=0.0)
+            # Native pass fuses subtract + saturation defense in one
+            # GIL-free sweep (cc/core.cc hvd_ef_update).
+            if not _native.ef_update(old, pre, wire):
+                np.subtract(pre, wire, out=old)
+                if not np.isfinite(old).all():  # see put()
+                    np.nan_to_num(old, copy=False, nan=0.0, posinf=0.0,
+                                  neginf=0.0)
             with self._lock:
                 self._store[key] = old
                 self._store.move_to_end(key)

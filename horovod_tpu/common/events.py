@@ -66,6 +66,10 @@ ERROR = "error"
 # Engine lifecycle (engine/engine.py)
 ENGINE_INIT = "engine.init"
 ENGINE_SHUTDOWN = "engine.shutdown"
+# Native data-plane core (cc/native.py, docs/native.md): which ladder
+# rung this rank's engine actually runs — emitted once at engine init.
+NATIVE_LOADED = "native.loaded"
+NATIVE_FALLBACK = "native.fallback"
 # Elastic run loop (elastic/run.py) + driver (runner/elastic/driver.py)
 ELASTIC_RESET = "elastic.reset"
 ELASTIC_RESTORE = "elastic.restore"

@@ -442,6 +442,12 @@ class Engine:
             "residual_bytes": self._error_feedback.nbytes(),
             "bytes_saved": self._comp_stats.saved_snapshot(),
         }
+        # Native core (docs/native.md): built / loaded / ABI / which
+        # kernels run native vs numpy fallback — "is the data plane
+        # actually GIL-free" at a glance.
+        from ..cc import native as native_mod
+
+        st["native"] = native_mod.status()
         # Tracing plane: recorder depth / drop count / last dump — the
         # "is the flight recorder actually capturing" view.
         trace = self.tracer.status()
@@ -716,6 +722,18 @@ class Engine:
                     exp.add_view("events", self._events_view)
             events_mod.emit(events_mod.ENGINE_INIT, rank=self.rank,
                             size=self.size)
+            # Journal the native-core verdict once per engine: which
+            # data plane this rank actually runs (docs/native.md).
+            from ..cc import native as native_mod
+
+            nst = native_mod.status()
+            if nst["loaded"]:
+                events_mod.emit(events_mod.NATIVE_LOADED, rank=self.rank,
+                                abi=nst["abi"], threads=nst["threads"])
+            else:
+                events_mod.emit(
+                    events_mod.NATIVE_FALLBACK, rank=self.rank,
+                    built=nst["built"], disabled=nst["disabled"])
 
     def _background_loop(self):
         try:

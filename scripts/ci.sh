@@ -5,6 +5,26 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "=== native core build (cc/libhvdtpu.so — docs/native.md) ==="
+# Build up front so every stage below exercises the C++ kernels; a
+# build failure is a CI failure, not a silent numpy fallback.
+make -C horovod_tpu/cc -s
+python - <<'EOF'
+from horovod_tpu.cc import native
+st = native.status()
+assert st["loaded"], f"native core built but failed to load: {st}"
+print(f"native core loaded: abi {st['abi']}, {st['threads']} threads, "
+      f"{sum(st['kernels'].values())}/{len(st['kernels'])} kernels")
+EOF
+
+echo "=== engine/transport subset, native kernels ON ==="
+ENGINE_SUBSET="tests/test_native.py tests/test_engine.py tests/test_ring.py \
+  tests/test_transport.py tests/test_hierarchical.py tests/test_compression.py"
+python -m pytest $ENGINE_SUBSET -q -m 'not slow'
+
+echo "=== engine/transport subset, HOROVOD_DISABLE_NATIVE=1 (numpy fallback parity) ==="
+HOROVOD_DISABLE_NATIVE=1 python -m pytest $ENGINE_SUBSET -q -m 'not slow'
+
 echo "=== unit + integration tests (fast tier — FULLY GREEN tier-1) ==="
 # The 7 known jax<0.5 failures (gpipe x2 + pipelined-lm, flash-GSPMD x2,
 # bert-ring-mask, elastic-gspmd-traced) were fixed by the

@@ -11,6 +11,8 @@ epoch's drain marker), then renders:
 * per-rank goodput table — steps, goodput ratio, exposed-comm badput
   (the fleet fold at /goodput names the straggler);
 * firing alerts, fleet-wide (rank-attributed);
+* a native-core badge — whether this rank's data plane runs the
+  GIL-free C++ kernels or the numpy fallback (docs/native.md);
 * the elasticity controller's last decision and any capacity grant —
   the ROADMAP item 5 operator surface for ``controller/last``;
 * an in-flight drain notice for the current epoch;
@@ -172,6 +174,24 @@ def render(snap: dict, events_tail: int = 12) -> str:
         lines.append("ALERTS FIRING: " + "; ".join(sorted(firing)))
     else:
         lines.append("alerts: none firing")
+
+    # Native-core badge (docs/native.md): one line, operator truth
+    # about which data plane the rank runs.
+    nat = (st or {}).get("native")
+    if nat:
+        ks = nat.get("kernels") or {}
+        active = sum(1 for v in ks.values() if v)
+        if nat.get("loaded"):
+            lines.append(
+                "native: on  abi {abi}  threads {th}  kernels "
+                "{a}/{n} active".format(
+                    abi=nat.get("abi", "?"), th=nat.get("threads", "?"),
+                    a=active, n=len(ks)))
+        else:
+            why = ("disabled" if nat.get("disabled")
+                   else ("built, load failed" if nat.get("built")
+                         else "not built"))
+            lines.append(f"native: fallback (numpy) — {why}")
 
     # Controller decision + capacity grant (ROADMAP item 5 surface).
     ctl = snap.get("controller")

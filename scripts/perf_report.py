@@ -164,12 +164,38 @@ def _engine_worker():
         os.environ["HOROVOD_WIRE_COMPRESSION"] = "none"
         return {"none": none, "bf16": bf16}
 
+    def stage_native(tag):
+        """native-vs-fallback paired at 16MB over the segmented ring
+        (order alternates with the round parity): the C++ kernel-port
+        A/B (docs/native.md). HOROVOD_DISABLE_NATIVE is honored per
+        call by cc/native.py, so flipping the env between arms flips
+        the data plane live — no reload dance."""
+        set_algo(True, 1 << 18)
+
+        def arm(disabled):
+            if disabled:
+                os.environ["HOROVOD_DISABLE_NATIVE"] = "1"
+            else:
+                os.environ.pop("HOROVOD_DISABLE_NATIVE", None)
+            name = "pr.nat.off" if disabled else "pr.nat.on"
+            return _timed_allreduce(cmp_x, name, tr_iters)
+
+        if tag % 2 == 0:
+            on = arm(False)
+            off = arm(True)
+        else:
+            off = arm(True)
+            on = arm(False)
+        os.environ.pop("HOROVOD_DISABLE_NATIVE", None)
+        return {"on": on, "off": off}
+
     stages = [
         ("latency_small_p50_s", stage_latency),
         ("ring_1mb_s", stage_ring),
         ("segring_1mb_s", stage_segring),
         ("transport_4mb_s", stage_transport),
         ("compression_16mb_s", stage_compression),
+        ("native_ring_16mb_s", stage_native),
     ]
     out = {name: [] for name, _ in stages}
     # Warmup round (negotiation, cache fill, shm establishment) —
@@ -398,6 +424,19 @@ def measure(rounds: int, quick: bool) -> dict:
     for arm, name in (("bf16", "compression_16mb_ms"),
                       ("none", "compression_none_16mb_ms")):
         vals = [d[arm] for d in cmp]
+        stages[name] = {
+            "unit": "ms",
+            "rounds": [round(v * 1e3, 4) for v in vals],
+            "value": round(_median(vals) * 1e3, 4),
+        }
+    # Native kernel A/B (docs/native.md): `native_ring_16mb_ms` is the
+    # tracked arm (kernels on — what production runs); the numpy
+    # fallback arm rides along so every report shows the port's win on
+    # THIS box.
+    nat = raw["native_ring_16mb_s"]
+    for arm, name in (("on", "native_ring_16mb_ms"),
+                      ("off", "native_off_ring_16mb_ms")):
+        vals = [d[arm] for d in nat]
         stages[name] = {
             "unit": "ms",
             "rounds": [round(v * 1e3, 4) for v in vals],

@@ -129,7 +129,8 @@ def test_committed_baseline_is_loadable_and_complete():
     expected = {
         "latency_small_p50_ms", "ring_1mb_ms", "segring_1mb_ms",
         "transport_tcp_4mb_ms", "transport_shm_4mb_ms", "hier_1mb_ms",
-        "serving_rtt_p50_ms",
+        "serving_rtt_p50_ms", "native_ring_16mb_ms",
+        "native_off_ring_16mb_ms",
     }
     assert expected <= set(base["stages"]), sorted(base["stages"])
     for name, st in base["stages"].items():
