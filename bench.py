@@ -97,7 +97,7 @@ def _force_cpu(n_devices: int):
 
 
 def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
-           donate=True, model_kw=None, seq_len=None):
+           donate=True, model_kw=None, seq_len=None, zero=False):
     import jax
     import numpy as np
     import optax
@@ -143,7 +143,7 @@ def _build(model_name, n_chips, batch_per_chip, image_size=224, mesh=None,
 
     build = make_train_step(
         model, tx, loss_fn, mesh=mesh, has_batch_stats=has_bn,
-        donate=donate,
+        donate=donate, zero=zero,
     )
     init_fn, step_fn, _ = build(jax.random.PRNGKey(0), inputs, labels)
     state = init_fn(jax.random.PRNGKey(0))
@@ -476,6 +476,9 @@ def main():
                    help="skip the long-sequence GPT-2 flash/dense MFU")
     p.add_argument("--gpt2-seq", type=int, default=2048)
     p.add_argument("--gpt2-batch", type=int, default=4)
+    p.add_argument("--zero", action="store_true",
+                   help="shard optimizer state over dp (GSPMD ZeRO; "
+                        "docs/running.md 'ZeRO sharded optimizer state')")
     p.add_argument("--scaling-reps", type=int, default=5)
     p.add_argument("--scaling-probe", type=int, default=0,
                    help="internal: run the N-device CPU scaling probe")
@@ -506,7 +509,7 @@ def main():
     for bs in candidates:
         try:
             state, step_fn, images, labels, global_batch, mesh = _build(
-                args.model, n_chips, bs, args.image_size
+                args.model, n_chips, bs, args.image_size, zero=args.zero
             )
             scan_fn = _make_scan_step(step_fn, mesh, chunk)
             # Short probe decides the sweep; two chunks, not one — a
@@ -597,6 +600,8 @@ def main():
         "batch_per_chip": bs,
         "n_chips": n_chips,
     }
+    if args.zero:
+        result["zero"] = True
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
     if fused_bn_ms is not None:

@@ -555,6 +555,113 @@ def _bench_traced(hvd, np, args):
     }
 
 
+def _bench_reducescatter(hvd, np, args):
+    """hvd.reducescatter timing (the ZeRO gradient leg): steady-state
+    names so the engine's response cache engages, the same regime the
+    `reducescatter_16mb_ms` perf_report stage gates."""
+    count = args.rs_count
+    n = hvd.size()
+    x = np.ones(count, np.float32) * (hvd.rank() + 1)
+    for i in range(args.warmup):
+        hvd.reducescatter(x, op=hvd.Sum, name=f"warm.rs.{i}")
+    hvd.barrier()
+    t0 = time.perf_counter()
+    for i in range(args.rs_iters):
+        out = hvd.reducescatter(x, op=hvd.Sum, name=f"rs.{i}")
+    dt = (time.perf_counter() - t0) / args.rs_iters
+    assert out.shape[0] == count // n, out.shape
+    # Reduce-scatter moves half an allreduce: (n-1)/n of the buffer
+    # per link (the NCCL-tests convention).
+    busbw = x.nbytes * (n - 1) / n / dt
+    return {"bytes": x.nbytes, "iters": args.rs_iters,
+            "lat_us": round(dt * 1e6, 1),
+            "busbw_GBps": round(busbw / 1e9, 3)}
+
+
+def _bench_zero(hvd, np, args):
+    """ZeRO acceptance measurement (docs/running.md "ZeRO sharded
+    optimizer state"): order-alternated paired rounds of the SAME
+    gradient pytree through (a) a replicated update — grouped allreduce
+    then a full-tree Adam update on every rank — and (b) the ZeRO path
+    — grouped allreduce, owned-shard update, updated-segment allgather
+    (`DistributedOptimizer(zero=1)`). Both arms ride the same engine
+    grouped collectives with steady names; the delta is the update math
+    plus the update allgather. The JSON carries MEASURED per-rank
+    optimizer-state bytes for both arms — the (n-1)/n memory claim is
+    reported from live buffers, not arithmetic."""
+    import jax
+    import optax
+
+    n = hvd.size()
+    tree = _make_grad_tree(np, scale=1e-2)
+    keys = list(tree.keys())
+    leaves = list(tree.values())
+    params = {k: np.zeros_like(v) for k, v in tree.items()}
+    inner = optax.adam(1e-3)
+
+    tx_zero = hvd.DistributedOptimizer(inner, zero=1)
+    s_zero = tx_zero.init(params)
+    s_rep = inner.init(params)
+    state_sharded = int(sum(v.nbytes for v in
+                            jax.tree.leaves(s_zero.inner)))
+    state_replicated = int(sum(
+        np.asarray(v).nbytes for v in jax.tree.leaves(s_rep)))
+
+    def rep_once():
+        red = hvd.grouped_allreduce(leaves, name="zero.rep",
+                                    op=hvd.Average)
+        upd, s = inner.update(dict(zip(keys, red)), rep_box[0], params)
+        rep_box[0] = s
+        jax.block_until_ready(jax.tree.leaves(upd))
+
+    def zero_once():
+        upd, s = tx_zero.update(tree, zero_box[0], params)
+        zero_box[0] = s
+        jax.block_until_ready(jax.tree.leaves(upd))
+
+    rep_box, zero_box = [s_rep], [s_zero]
+
+    def timed(fn):
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(args.zero_iters):
+            fn()
+        dt = (time.perf_counter() - t0) / args.zero_iters
+        hvd.barrier()
+        return dt
+
+    timed(rep_once)  # warmup: negotiate the steady names
+    timed(zero_once)
+    pairs = []
+    for rd in range(args.zero_rounds):
+        if rd % 2 == 0:
+            a = timed(rep_once)
+            b = timed(zero_once)
+        else:
+            b = timed(zero_once)
+            a = timed(rep_once)
+        pairs.append((a, b))
+    if hvd.rank() != 0:
+        return None
+    return {
+        "param_count": int(sum(v.size for v in leaves)),
+        "tensors": len(leaves),
+        "bytes": int(sum(v.nbytes for v in leaves)),
+        "iters": args.zero_iters,
+        "state_bytes_replicated": state_replicated,
+        "state_bytes_sharded": state_sharded,
+        "state_saving": round(state_replicated / state_sharded, 3),
+        "pairs_ms": [[round(a * 1e3, 2), round(b * 1e3, 2)]
+                     for a, b in pairs],
+        "replicated_ms_median": round(_percentile(
+            sorted(a for a, _ in pairs), 0.5) * 1e3, 2),
+        "zero_ms_median": round(_percentile(
+            sorted(b for _, b in pairs), 0.5) * 1e3, 2),
+        "zero_overhead": round(_percentile(
+            sorted(b / a for a, b in pairs), 0.5), 3),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="16384,262144,4194304",
@@ -573,7 +680,8 @@ def main():
                         "ring (default: the library default)")
     p.add_argument("--mode",
                    choices=["bw", "latency", "pipeline", "transport",
-                            "compression", "hier", "traced"],
+                            "compression", "hier", "traced", "zero",
+                            "reducescatter"],
                    default="bw",
                    help="bw: the throughput sweep (default); latency: "
                         "small-op p50/p99 enqueue-to-complete, 1-vs-N "
@@ -591,7 +699,11 @@ def main():
                         "traced: eager-engine vs traced-jit gradient "
                         "exchange on the same >=1M-param pytree, "
                         "order-alternated paired rounds (launch with "
-                        "hvdrun -np 2)")
+                        "hvdrun -np 2); zero: replicated-update vs "
+                        "ZeRO reduce/update/allgather on the same "
+                        "pytree with measured per-rank state bytes "
+                        "(intended np=4); reducescatter: "
+                        "hvd.reducescatter timing at --rs-count")
     p.add_argument("--channels", type=int, default=2,
                    help="the N in the 1-vs-N channel comparisons")
     p.add_argument("--lat-count", type=int, default=16384,
@@ -623,6 +735,15 @@ def main():
                    help="exchanges per timed arm in traced mode")
     p.add_argument("--traced-rounds", type=int, default=5,
                    help="eager/traced paired rounds in traced mode")
+    p.add_argument("--zero-iters", type=int, default=5,
+                   help="updates per timed arm in zero mode")
+    p.add_argument("--zero-rounds", type=int, default=5,
+                   help="replicated/zero paired rounds in zero mode")
+    p.add_argument("--rs-count", type=int, default=4194304,
+                   help="reducescatter-mode element count (default "
+                        "16MB)")
+    p.add_argument("--rs-iters", type=int, default=10,
+                   help="reducescatters per timed run")
     args = p.parse_args()
 
     if args.mode == "traced":
@@ -748,6 +869,32 @@ def main():
             print(json.dumps(dict(
                 {"metric": "allreduce_traced_vs_eager", "np": n},
                 **summary)))
+        return
+
+    if args.mode == "zero":
+        summary = _bench_zero(hvd, np, args)
+        if r == 0:
+            print(f"zero paired rounds (ms, replicated vs zero): "
+                  f"{summary['pairs_ms']}")
+            print(f"state bytes/rank: replicated "
+                  f"{summary['state_bytes_replicated']} -> sharded "
+                  f"{summary['state_bytes_sharded']} "
+                  f"({summary['state_saving']}x saving at np={n}); "
+                  f"step {summary['replicated_ms_median']}ms -> "
+                  f"{summary['zero_ms_median']}ms "
+                  f"({summary['zero_overhead']}x)")
+            print(json.dumps(dict(
+                {"metric": "zero_optimizer", "np": n}, **summary)))
+        return
+
+    if args.mode == "reducescatter":
+        summary = _bench_reducescatter(hvd, np, args)
+        if r == 0:
+            print(f"reducescatter {summary['bytes']} B: "
+                  f"{summary['lat_us']}us "
+                  f"({summary['busbw_GBps']} GB/s busbw)")
+            print(json.dumps(dict(
+                {"metric": "eager_reducescatter", "np": n}, **summary)))
         return
 
     if args.mode == "pipeline":

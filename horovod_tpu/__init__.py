@@ -82,6 +82,13 @@ from .optim.distributed import (
     DistributedGradientTape,
     distributed_value_and_grad,
 )
+from .optim.zero import (
+    ZeroState,
+    zero_init,
+)
+from .optim.zero import state_specs as zero_state_specs
+from .optim.zero import recut_state as zero_recut_state
+from . import optim
 from .parallel import mesh as mesh_utils
 from .parallel.step import wrap_step
 

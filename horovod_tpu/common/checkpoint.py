@@ -277,7 +277,10 @@ def shard_ranges(leaf_bytes: List[int], nshards: int
     computes the same cut from its replicated snapshot, so no cut needs
     to travel. Ranges may be empty when there are more ranks than
     leaves (the empty shard still gets written and acked: the commit
-    barrier stays uniform)."""
+    barrier stays uniform). Also the ownership rule for ZeRO optimizer
+    state (optim/zero.py): the eager plane feeds it equal-weight
+    512-element blocks of the flat state buffer, so checkpoint shards
+    and optimizer shards are cut by one deterministic function."""
     total = sum(leaf_bytes)
     n = len(leaf_bytes)
     cuts = [0]

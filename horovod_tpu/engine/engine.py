@@ -495,6 +495,15 @@ class Engine:
         ckpt_mgr = _ckpt.current()
         if ckpt_mgr is not None:
             st["checkpoint"] = ckpt_mgr.status()
+        # ZeRO plane (docs/running.md "ZeRO sharded optimizer state"):
+        # live once a sharded/EF DistributedOptimizer initializes in
+        # this process — owned like `checkpoint` above by its own
+        # module, merely surfaced here.
+        from ..optim import zero as _zero
+
+        zero_st = _zero.status_snapshot()
+        if zero_st:
+            st["zero"] = zero_st
         # Serving plane (docs/serving.md): role, rounds, weight step,
         # eviction verdicts — live while serve() runs in this process,
         # like `checkpoint` above. The replica set is process-global,

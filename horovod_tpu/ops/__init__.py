@@ -330,7 +330,13 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
 # ---------------------------------------------------------------------------
 # reducescatter (TPU-native addition; the hierarchical building block)
 def reducescatter(tensor, op: Optional[ReduceOp] = None,
-                  axis_name: Optional[str] = None):
+                  axis_name: Optional[str] = None,
+                  name: Optional[str] = None):
+    """Reduce across ranks, leaving each rank its 1/n slice of dim 0 —
+    the ZeRO gradient leg (docs/running.md "ZeRO sharded optimizer
+    state"). `name` keys the engine's response cache like any
+    collective, so steady-state loops skip renegotiation (and the
+    `reducescatter_16mb_ms` perf stage measures the cached path)."""
     rop = op or ReduceOp.SUM
     if _use_traced(tensor, axis_name):
         _count_traced("reducescatter")
@@ -338,7 +344,8 @@ def reducescatter(tensor, op: Optional[ReduceOp] = None,
     if basics.mode() == "process":
         # Allreduce then take this rank's slice.
         full = allreduce(tensor, op=rop if rop != ReduceOp.SUM else None,
-                         average=None if rop != ReduceOp.SUM else False)
+                         average=None if rop != ReduceOp.SUM else False,
+                         name=name)
         n = basics.size()
         r = basics.rank()
         per = full.shape[0] // n

@@ -1,0 +1,18 @@
+"""Optimizer wrappers: the allreduce-before-update transformation and
+its ZeRO-sharded / error-feedback variant (docs/running.md)."""
+from . import distributed, zero
+from .distributed import (
+    DistributedGradientTape,
+    DistributedOptimizer,
+    distributed_value_and_grad,
+)
+from .zero import (
+    ZeroEagerState,
+    ZeroState,
+    eager_state_from_global,
+    eager_state_to_global,
+    recut_state,
+    state_specs,
+    zero_init,
+    zero_optimizer,
+)

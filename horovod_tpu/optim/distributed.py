@@ -118,10 +118,48 @@ def DistributedOptimizer(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     fuse: bool = False,
+    zero: Optional[int] = None,
+    error_feedback: Optional[bool] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are allreduced before the
     update (ref: horovod/torch/optimizer.py:337-414 DistributedOptimizer
-    factory; horovod/tensorflow/__init__.py:289-332)."""
+    factory; horovod/tensorflow/__init__.py:289-332).
+
+    `zero` shards the inner optimizer's state over the resolved data
+    axis ZeRO-style (docs/running.md "ZeRO sharded optimizer state"):
+    traced updates lower to reduce-scatter → owned-shard update →
+    allgather, eager updates cut leaf ownership with the checkpoint
+    writer's `shard_ranges` tiling. `None` defers to
+    HOROVOD_ZERO_SHARDING (default off); True means stage 1. Stages 1
+    and 2 share the state layout — under jit the reduce-scatter
+    lowering already never materializes the full reduced gradient, so
+    the traced plane is effectively stage 2 either way.
+
+    `error_feedback` carries the traced wire-cast quantization residual
+    (bf16/fp16/int8 lanes) across steps as optimizer state — sharded
+    with the moments under ZeRO — restoring the eager codec's accuracy
+    story for jitted loops. With both off this wrapper is byte-for-byte
+    the pre-ZeRO transformation (disabled mode pays nothing)."""
+    if zero is None:
+        from ..utils import env as env_cfg
+
+        zero = env_cfg.zero_sharding_default()
+    zero = int(zero)
+    if error_feedback is None:
+        error_feedback = False
+    if zero or error_feedback:
+        from .zero import zero_optimizer
+
+        tx = zero_optimizer(
+            optimizer, op=op, axis_name=axis_name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            stage=zero, error_feedback=bool(error_feedback),
+        )
+        if backward_passes_per_step > 1:
+            tx = optax.MultiSteps(
+                tx, every_k_schedule=backward_passes_per_step)
+        return tx
 
     def init_fn(params):
         return optimizer.init(params)

@@ -83,6 +83,7 @@ def make_train_step(
     donate: bool = True,
     dropout: bool = False,
     dropout_seed: int = 0,
+    zero: bool = False,
 ):
     """Returns (init_state_fn, train_step_fn), both jitted with explicit
     in/out shardings over `mesh`.
@@ -94,9 +95,22 @@ def make_train_step(
     per-step dropout rng (folded from `dropout_seed` and the step
     counter). Leave False for models without dropout — with it False,
     any configured dropout_rate is inactive during training.
+
+    `zero=True` is the GSPMD spelling of ZeRO (docs/running.md "ZeRO
+    sharded optimizer state"): optimizer-state moments are given a
+    NamedSharding over the dp axis (dim 0, when divisible) instead of
+    mirroring their param's sharding, and XLA derives the
+    reduce-scatter → sharded update → allgather schedule from the
+    sharding constraint alone — no optimizer wrapper, and it composes
+    with tp/sp rules because only the DATA axis is re-used.
     """
     rules = filter_rules(rules, mesh)
     repl = NamedSharding(mesh, P())
+    zero_axis = "dp" if "dp" in mesh.axis_names else None
+    if zero and zero_axis is None:
+        raise ValueError(
+            "make_train_step(zero=True) needs a 'dp' axis in the mesh "
+            "to shard optimizer state over")
 
     def _batch_sharding(arg) -> NamedSharding:
         # Leading dim over dp; dim 1 over sp for rank≥2 inputs when
@@ -158,11 +172,32 @@ def make_train_step(
         # "['mlp']['wi']['kernel']".
         by_len = sorted(pmap_by_path.items(), key=lambda kv: -len(kv[0]))
 
+        ndp = mesh.shape.get("dp", 1) if zero else 1
+
         def opt_shard(path, leaf):
             ks = jax.tree_util.keystr(path)
             # optax wraps param trees: strip prefixes like .0.mu / .1 etc.
             for ppath, s in by_len:
                 if ks.endswith(ppath):
+                    if (zero and leaf.ndim >= 1
+                            and leaf.shape[0] % ndp == 0
+                            and leaf.shape[0] >= ndp):
+                        # ZeRO: moments shard over dp on dim 0, stacked
+                        # in front of the param's own (tp/...) spec —
+                        # the reduce-scatter/allgather is derived by
+                        # XLA from this constraint.
+                        spec = s.spec if hasattr(s, "spec") else P()
+                        rest = tuple(spec)[1:] if len(spec) else ()
+                        dim0 = tuple(spec)[0] if len(spec) else None
+                        if dim0 is None:
+                            return NamedSharding(
+                                mesh, P(zero_axis, *rest))
+                        if (isinstance(dim0, str) and dim0 != zero_axis
+                                and leaf.shape[0] % (
+                                    ndp * mesh.shape[dim0]) == 0):
+                            return NamedSharding(
+                                mesh, P((dim0, zero_axis), *rest))
+                        return s
                     return s
             return repl
 

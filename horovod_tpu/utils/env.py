@@ -248,6 +248,14 @@ WIRE_COMPRESSION_INT8 = "HOROVOD_WIRE_COMPRESSION_INT8"
 # wire bytes and results are bitwise identical either way, so the knob
 # is a purely local A/B switch.
 RING_CODEC_OVERLAP = "HOROVOD_RING_CODEC_OVERLAP"
+# ZeRO sharded optimizer state (docs/running.md "ZeRO sharded optimizer
+# state"): the default stage `DistributedOptimizer(zero=None)` resolves
+# to. 0 = replicated moments on every data rank (off), 1/2 = shard the
+# optimizer state over the resolved data axis (stage 2 additionally
+# documents the reduce-scatter gradient lowering; the state layout is
+# identical). Read at wrapper-construction time — launcher-propagated,
+# so collectively consistent.
+ZERO_SHARDING = "HOROVOD_ZERO_SHARDING"
 
 DEFAULT_WIRE_COMPRESSION_MIN_BYTES = 65536
 
@@ -693,6 +701,17 @@ def ring_codec_overlap() -> bool:
     """Pipelined codec/wire overlap in the segmented ring (default on).
     Purely local: flipping it never changes wire bytes or results."""
     return get_bool(RING_CODEC_OVERLAP, True)
+
+
+def zero_sharding_default() -> int:
+    """HOROVOD_ZERO_SHARDING normalized to 0|1|2 (bogus values fall
+    back to 0 — a typo must never silently change the optimizer-state
+    layout)."""
+    try:
+        v = get_int(ZERO_SHARDING, 0)
+    except ValueError:
+        return 0
+    return v if v in (1, 2) else 0
 
 
 def trace_buffer_events() -> int:

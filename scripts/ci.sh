@@ -48,6 +48,9 @@ python scripts/trace_smoke.py
 echo "=== data-plane perf smoke (tcp + shm + hierarchical, exact byte accounting per transport) ==="
 python scripts/perf_smoke.py
 
+echo "=== ZeRO perf smoke (np=4 sharded optimizer: exact gradient-allreduce + segment-allgather byte accounting, bitwise parity vs replicated) ==="
+python scripts/perf_smoke.py zero
+
 echo "=== chaos smoke over shared memory (wedge detection while data rides shm) ==="
 python scripts/chaos_smoke.py --transport shm --wedge
 

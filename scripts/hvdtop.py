@@ -193,6 +193,22 @@ def render(snap: dict, events_tail: int = 12) -> str:
                          else "not built"))
             lines.append(f"native: fallback (numpy) — {why}")
 
+    # ZeRO badge (docs/running.md "ZeRO sharded optimizer state"):
+    # how much optimizer-state memory this rank actually holds vs a
+    # full replica — the number the mode exists to shrink.
+    zr = (st or {}).get("zero")
+    if zr and zr.get("enabled"):
+        sh = zr.get("sharded_state_bytes")
+        rp = zr.get("replicated_state_bytes")
+        saving = (f"  ({rp / sh:.1f}x saving)"
+                  if sh and rp and sh > 0 else "")
+        ef = "  ef on" if zr.get("error_feedback") else ""
+        lines.append(
+            "zero: stage {s} {pl}  world {w}  state {sh}/{rp} B{sv}{ef}"
+            .format(s=zr.get("stage", "?"), pl=zr.get("plane", "?"),
+                    w=zr.get("world", "?"), sh=sh, rp=rp, sv=saving,
+                    ef=ef))
+
     # Controller decision + capacity grant (ROADMAP item 5 surface).
     ctl = snap.get("controller")
     if ctl:

@@ -3,7 +3,9 @@
 
 One harness that runs the repo's microbench stages — small-op latency,
 ring / segmented-ring bandwidth, the tcp-vs-shm transport pair, the
-two-level hierarchical allreduce, and a serving round-trip — and emits
+two-level hierarchical allreduce, the 16MB reduce-scatter leg, the
+np=4 ZeRO-1 optimizer step (plus its measured per-rank state bytes),
+and a serving round-trip — and emits
 a BENCH-style JSON: medians over order-alternated rounds (the house
 methodology from the PR 3/4/8 acceptance measurements: on a shared box,
 sequential arms measure load drift, so stage order alternates per
@@ -189,6 +191,22 @@ def _engine_worker():
         os.environ.pop("HOROVOD_DISABLE_NATIVE", None)
         return {"on": on, "off": off}
 
+    def stage_reducescatter(tag):
+        """16MB reduce-scatter over the segmented ring: each rank
+        leaves with its 1/n slice of the summed dim 0 — the ZeRO
+        gradient leg (docs/running.md "ZeRO sharded optimizer state").
+        The steady `pr.rs` name keeps the inner reduction on the
+        response cache, so this tracks the cached-path cost
+        head-to-head with the 16MB allreduce stages above."""
+        set_algo(True, 1 << 18)
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(tr_iters):
+            hvd.reducescatter(cmp_x, op=hvd.Sum, name="pr.rs")
+        dt = (time.perf_counter() - t0) / tr_iters
+        hvd.barrier()
+        return dt
+
     stages = [
         ("latency_small_p50_s", stage_latency),
         ("ring_1mb_s", stage_ring),
@@ -196,6 +214,7 @@ def _engine_worker():
         ("transport_4mb_s", stage_transport),
         ("compression_16mb_s", stage_compression),
         ("native_ring_16mb_s", stage_native),
+        ("reducescatter_16mb_s", stage_reducescatter),
     ]
     out = {name: [] for name, _ in stages}
     # Warmup round (negotiation, cache fill, shm establishment) —
@@ -331,6 +350,60 @@ def _traced_worker():
             "traced_eager_step_s": eager_vals}
 
 
+def _zero_worker():
+    """np=4 ZeRO-1 optimizer step (docs/running.md "ZeRO sharded
+    optimizer state"): the eager ``DistributedOptimizer(zero=1)`` path
+    on the canonical ~2.4M-param microbench pytree — grouped gradient
+    allreduce, owned-segment adam update, updated-segment allgather —
+    with steady collective names (``zero.grads`` / ``zero.updates``)
+    so the response cache engages. Besides the timing rounds it
+    reports the MEASURED per-rank optimizer-state bytes (max across
+    ranks; the element-block cut keeps ranks within one block of each
+    other) and the replicated equivalent — the (n-1)/n memory number
+    the mode exists for."""
+    import numpy as np
+
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rounds = int(os.environ["PERF_ROUNDS"])
+    iters = int(os.environ["PERF_TR_ITERS"])
+
+    from examples.microbench_allreduce import _make_grad_tree
+
+    grads = _make_grad_tree(np)
+    params = {k: np.zeros_like(v) for k, v in grads.items()}
+    inner = optax.adam(1e-3)
+    tx = hvd.DistributedOptimizer(inner, zero=1)
+    state_box = [tx.init(params)]
+    sharded = int(sum(np.asarray(l).nbytes
+                      for l in jax.tree.leaves(state_box[0].inner)))
+    sharded = max(hvd.allgather_object(sharded))
+    replicated = int(sum(
+        int(np.prod(s.shape, dtype=np.int64)) * np.dtype(s.dtype).itemsize
+        for s in jax.tree.leaves(jax.eval_shape(inner.init, params))))
+
+    def timed():
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _, state_box[0] = tx.update(grads, state_box[0], params)
+        dt = (time.perf_counter() - t0) / iters
+        hvd.barrier()
+        return dt
+
+    timed()  # warmup: negotiate the steady names, fill the caches
+    vals = [timed() for _ in range(rounds)]
+    rank = hvd.rank()
+    hvd.shutdown()
+    return {"rank": rank, "zero_step_s": vals,
+            "zero_state_bytes": sharded,
+            "zero_state_replicated_bytes": replicated}
+
+
 def _serving_worker():
     """np=2 serving round-trip: echo model over the SPMD round
     protocol, p50 of programmatic submit -> reply."""
@@ -401,7 +474,8 @@ def measure(rounds: int, quick: bool) -> dict:
               extra_env=dict(env, HOROVOD_TRANSPORT="auto"))
     r0 = next(r for r in res if r["rank"] == 0)
     raw = r0["stages"]
-    for name in ("latency_small_p50_s", "ring_1mb_s", "segring_1mb_s"):
+    for name in ("latency_small_p50_s", "ring_1mb_s", "segring_1mb_s",
+                 "reducescatter_16mb_s"):
         vals = raw[name]
         stages[name[:-2] + "_ms"] = {
             "unit": "ms",
@@ -472,6 +546,26 @@ def measure(rounds: int, quick: bool) -> dict:
             "rounds": [round(v * 1e3, 4) for v in vals],
             "value": round(_median(vals) * 1e3, 4),
         }
+
+    res = run(_zero_worker, np=4,
+              extra_env=dict(env, HOROVOD_TRANSPORT="auto"))
+    z0 = next(r for r in res if r.get("rank") == 0)
+    vals = z0["zero_step_s"]
+    stages["zero_step_ms"] = {
+        "unit": "ms",
+        "rounds": [round(v * 1e3, 4) for v in vals],
+        "value": round(_median(vals) * 1e3, 4),
+    }
+    # State bytes are a memory measurement, not a timing: exact
+    # integers, one round. Lower-is-better still holds — an
+    # ownership-cut regression that grows a rank's shard trips the
+    # gate like any slowdown.
+    stages["zero_state_bytes"] = {
+        "unit": "bytes",
+        "rounds": [z0["zero_state_bytes"]],
+        "value": z0["zero_state_bytes"],
+        "replicated_bytes": z0["zero_state_replicated_bytes"],
+    }
 
     res = run(_serving_worker, np=2, extra_env=env)
     vals = next(r for r in res if r.get("rank") == 0)["serving_rtt_p50_s"]

@@ -25,7 +25,8 @@ What it pins down:
 
 Run by scripts/ci.sh; also a manual repro tool:
 
-    python scripts/perf_smoke.py
+    python scripts/perf_smoke.py        # the data-plane legs
+    python scripts/perf_smoke.py zero   # np=4 ZeRO two-leg accounting
 """
 import os
 import sys
@@ -481,6 +482,144 @@ def worker_hier():
     return checks
 
 
+def worker_zero():
+    """ZeRO-mode smoke (docs/running.md "ZeRO sharded optimizer
+    state"): np=4 eager ``DistributedOptimizer(zero=1)`` steps with
+    EXACT per-rank byte accounting on BOTH collective legs:
+
+    * gradient leg: one grouped allreduce of the raw leaves per step,
+      so `horovod_allreduce_bytes_total` grows by exactly
+      ITERS x sum(leaf nbytes) per rank;
+    * update leg: one allgather of this rank's updated segment plus
+      the 1-element sentinel (empty shards must still gather), so
+      `horovod_allgather_bytes_total` grows by exactly
+      ITERS x (hi - lo + 1) x itemsize — (lo, hi) from the SAME
+      element-block cut the optimizer uses (`_eager_cut`), so the
+      assert pins the ownership math, not a re-derivation.
+
+    Integer-valued gradients make the reduction exact, so the updates
+    must be BITWISE equal to a local replicated adam control, and the
+    `horovod_optimizer_state_bytes` gauges must show the measured
+    sharded footprint at ~1/n of the replicated one."""
+    import functools
+
+    import numpy as np
+
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.optim.zero import _eager_cut
+
+    hvd.init()
+    n = hvd.size()
+    rank = hvd.rank()
+
+    rng = np.random.RandomState(7)
+    params = {
+        "w": rng.randn(311, 17).astype(np.float32),
+        "b": rng.randn(63).astype(np.float32),
+        "emb": rng.randn(5000).astype(np.float32),
+    }
+    total = sum(v.size for v in params.values())
+    lo, hi = _eager_cut(total, 4, n)[rank]
+
+    inner = optax.adam(1e-3)
+    tx = hvd.DistributedOptimizer(inner, zero=1)
+    state = tx.init(params)
+    ctl_state = inner.init(params)
+    ctl_params = {k: v.copy() for k, v in params.items()}
+
+    def snap():
+        return hvd.metrics()["metrics"]
+
+    before = snap()
+    for i in range(ITERS):
+        # rank-dependent INTEGER grads: the ring sum is exact in fp32
+        # and /n is dyadic, so the zero path must match the local
+        # replicated control bitwise — no tolerance.
+        grads = {k: (np.int32(1) + np.arange(v.size, dtype=np.int32)
+                     % 7 + rank + i).astype(np.float32).reshape(v.shape)
+                 for k, v in params.items()}
+        upd, state = tx.update(grads, state, params)
+        mean = {k: functools.reduce(
+            np.add, [(grads[k] - rank) + r for r in range(n)]) / n
+            for k in grads}
+        ctl_upd, ctl_state = inner.update(mean, ctl_state, ctl_params)
+        for k in upd:
+            assert np.array_equal(np.asarray(upd[k]),
+                                  np.asarray(ctl_upd[k])), (
+                f"zero update diverged from replicated control on {k!r}")
+    hvd.barrier()
+    after = snap()
+
+    itemsize = 4  # fp32 accumulator — every param leaf is fp32
+    want_ar = ITERS * total * itemsize
+    got_ar = (after.get("horovod_allreduce_bytes_total", 0)
+              - before.get("horovod_allreduce_bytes_total", 0))
+    assert got_ar == want_ar, (
+        f"zero gradient-leg accounting drifted: allreduce moved "
+        f"{got_ar} bytes, closed form says exactly {want_ar}")
+    want_ag = ITERS * (hi - lo + 1) * itemsize
+    got_ag = (after.get("horovod_allgather_bytes_total", 0)
+              - before.get("horovod_allgather_bytes_total", 0))
+    assert got_ag == want_ag, (
+        f"zero update-leg accounting drifted: allgather moved "
+        f"{got_ag} bytes, closed form (segment {hi - lo} elems + "
+        f"sentinel) says exactly {want_ag}")
+
+    sharded = after.get(
+        'horovod_optimizer_state_bytes{mode="sharded"}', 0)
+    replicated = after.get(
+        'horovod_optimizer_state_bytes{mode="replicated"}', 0)
+    measured = sum(np.asarray(l).nbytes
+                   for l in jax.tree.leaves(state.inner))
+    assert sharded == measured, (sharded, measured)
+    assert replicated > 0 and sharded < replicated / (n - 1), (
+        f"sharded state {sharded} B is not ~1/{n} of the replicated "
+        f"{replicated} B")
+    checks = {"rank": rank, "allreduce_bytes": got_ar,
+              "allgather_bytes": got_ag, "segment": [int(lo), int(hi)],
+              "state_sharded": int(sharded),
+              "state_replicated": int(replicated)}
+    hvd.shutdown()
+    return checks
+
+
+def main_zero():
+    """The ci.sh `perf_smoke zero` leg: np=4 eager ZeRO with exact
+    two-leg byte accounting (its own leg so a zero-path regression
+    names itself in CI output)."""
+    import json
+
+    from horovod_tpu.runner import run
+
+    results = run(worker_zero, np=4, extra_env={
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_TCP_TIMEOUT_SECONDS": "120",
+        "HOROVOD_TRANSPORT": "auto",
+    })
+    assert len(results) == 4, results
+    # Every rank saw the same gradient-leg bytes; segments tile [0,
+    # total) without overlap.
+    assert all(r["allreduce_bytes"] == results[0]["allreduce_bytes"]
+               for r in results), results
+    segs = sorted(r["segment"] for r in results)
+    assert segs[0][0] == 0, segs
+    assert all(segs[i][1] == segs[i + 1][0]
+               for i in range(len(segs) - 1)), segs
+    total_state = sum(r["state_sharded"] for r in results)
+    print("perf smoke OK (zero):", results)
+    print(json.dumps({
+        "metric": "perf_smoke_zero",
+        "allreduce_bytes": results[0]["allreduce_bytes"],
+        "allgather_bytes": [r["allgather_bytes"] for r in results],
+        "state_sharded_total": total_state,
+        "state_replicated": results[0]["state_replicated"],
+    }))
+
+
 def main():
     import json
 
@@ -585,4 +724,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "zero":
+        main_zero()
+    else:
+        main()
