@@ -90,10 +90,13 @@ ALERT_FIRE = "alert.fire"
 ALERT_CLEAR = "alert.clear"
 # Elasticity controller (runner/elastic/controller.py)
 CONTROLLER_DECISION = "controller.decision"
-# Serving plane (serving/replicas.py)
+# Serving plane (serving/replicas.py, serving/doors.py,
+# serving/autoscaler.py)
 SERVING_SWAP_PREPARE = "serving.swap_prepare"
 SERVING_SWAP = "serving.swap"
 SERVING_EVICT = "serving.evict"
+SERVING_DOOR_ELECTED = "serving.door_elected"
+SERVING_SCALE = "serving.scale"
 # Liveness plane (common/health.py)
 HEALTH_VERDICT = "health.verdict"
 # Host bookkeeping (runner/elastic/driver.py + discovery)

@@ -113,6 +113,12 @@ class InjectedDiskFault(OSError):
 _NET_ACTIONS = ("kill", "sever", "drop", "delay", "wedge", "hang",
                 "preempt")
 _DISK_ACTIONS = ("diskfail", "diskslow")
+# Serving-plane actions hook the front-door admission path instead of
+# transport I/O (docs/serving.md "Failure drills"). killdoor kills the
+# CURRENT active door after `after=N` accepted requests — the
+# front-door analogue of `kill`, drivable mid-traffic so the failover
+# election (serving/doors.py) is exercised deterministically.
+_SERVING_ACTIONS = ("killdoor",)
 
 
 @dataclass
@@ -140,7 +146,7 @@ def parse_spec(spec: str) -> List[Rule]:
             continue
         fields = part.split(":")
         action = fields[0].strip().lower()
-        if action not in _NET_ACTIONS + _DISK_ACTIONS:
+        if action not in _NET_ACTIONS + _DISK_ACTIONS + _SERVING_ACTIONS:
             raise ValueError(f"unknown fault action {action!r} in {part!r}")
         kw: Dict[str, str] = {}
         for f in fields[1:]:
@@ -159,6 +165,9 @@ def parse_spec(spec: str) -> List[Rule]:
                     f"path= applies to disk rules only (got {part!r})")
             rule.path = kw["path"]
         if "op" in kw:
+            if action in _SERVING_ACTIONS:
+                raise ValueError(
+                    f"op= does not apply to {action} rules (got {part!r})")
             valid = (("read", "write") if action in _DISK_ACTIONS
                      else ("connect", "send", "recv"))
             if kw["op"] not in valid:
@@ -185,6 +194,8 @@ def parse_spec(spec: str) -> List[Rule]:
                 f"preempt rule needs step=N or secs=T: {part!r}")
         if rule.action in ("delay", "diskslow") and rule.secs <= 0:
             raise ValueError(f"{rule.action} rule needs secs=S: {part!r}")
+        if rule.action == "killdoor" and rule.after < 0:
+            raise ValueError(f"killdoor needs after=N >= 0: {part!r}")
         rules.append(rule)
     return rules
 
@@ -369,7 +380,8 @@ class FaultInjector:
             verdict = PASS
             for r in self._rules:
                 if r.action in ("kill", "wedge", "preempt") \
-                        or r.action in _DISK_ACTIONS:
+                        or r.action in _DISK_ACTIONS \
+                        or r.action in _SERVING_ACTIONS:
                     continue
                 if r.rank is not None and r.rank != rank:
                     continue
@@ -412,6 +424,36 @@ class FaultInjector:
                          rank, op, peer)
             self._park_forever()
         return verdict
+
+    def check_door_admit(self, active: bool):
+        """Hook the serving frontend calls once per ACCEPTED request
+        (after the admission-queue offer succeeded). ``active`` says
+        whether this process is currently the ACTIVE front door — a
+        killdoor rule only counts (and only kills) the active door, so
+        standby-door traffic never trips it. ``after=N`` means N
+        requests are accepted and land; the N+1th admission brings the
+        door down mid-flight, exactly the failover drill
+        (scripts/serving_smoke.py phase 4)."""
+        if not self.active or not active:
+            return
+        with self._lock:
+            self._load_env()
+            own_rank = env_cfg.get_int(env_cfg.RANK, -1)
+            for r in self._rules:
+                if r.action != "killdoor":
+                    continue
+                if r.rank is not None and r.rank != own_rank:
+                    continue
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                logger.error(
+                    "fault injection: killing front door after %d "
+                    "accepted requests", r.after)
+                _fault_counter("killdoor").inc()
+                # os._exit like `kill`: sockets reset, no cleanup — the
+                # survivors' liveness verdict does the rest.
+                os._exit(1)
 
     def check_disk(self, op: str, path: str):
         """Hook for a disk writer/reader about to do `op`

@@ -45,6 +45,28 @@ SCALE_UP = "scale_up"
 SCALE_DOWN = "scale_down"
 HOLD = "hold"
 
+
+class CooldownGate:
+    """The shared rate limit on mesh-changing decisions: after any
+    non-HOLD action fires, further actions are vetoed (forced to HOLD)
+    until `cooldown` seconds pass. One bad sample must not flap the
+    mesh — both this controller and the serving autoscaler
+    (serving/autoscaler.py) gate through it."""
+
+    def __init__(self, cooldown: float):
+        self.cooldown = max(float(cooldown), 0.0)
+        self._last_action_mono: Optional[float] = None
+
+    def veto(self, now: Optional[float] = None) -> bool:
+        if self._last_action_mono is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return now - self._last_action_mono < self.cooldown
+
+    def fired(self, now: Optional[float] = None):
+        self._last_action_mono = (time.monotonic()
+                                  if now is None else now)
+
 # A straggler eviction needs evidence, not one noisy tick: the same
 # rank must be named by the alert mirror on this many consecutive
 # controller ticks before it is drained out.
@@ -95,10 +117,10 @@ class ElasticityController:
         self.interval = (env_cfg.controller_interval_seconds()
                          if interval is None else interval)
         self.cooldown = self.interval * 3.0
+        self._gate = CooldownGate(self.cooldown)
         self._ns = env_cfg.job_kv_prefix()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._last_action_mono: Optional[float] = None
         # Last (action, target, reason) journaled — decisions are
         # events only when they CHANGE (docs/events.md).
         self._last_published: Optional[tuple] = None
@@ -162,8 +184,7 @@ class ElasticityController:
             available_slots=available, grant=grant,
             straggler_rank=straggler, fleet_draining=draining)
         now = time.monotonic()
-        if action != HOLD and self._last_action_mono is not None \
-                and now - self._last_action_mono < self.cooldown:
+        if action != HOLD and self._gate.veto(now):
             action, target, reason = (
                 HOLD, current_np,
                 f"cooldown ({self.cooldown:.0f}s) after the last action")
@@ -171,7 +192,7 @@ class ElasticityController:
         self._publish(action, target, current_np, reason)
         if action == HOLD:
             return action, target, reason
-        self._last_action_mono = now
+        self._gate.fired(now)
         logger.warning("elasticity controller: %s %d -> %d (%s)",
                        action, current_np, target, reason)
         if action == SCALE_UP:

@@ -30,11 +30,15 @@ from typing import Callable, Optional
 from ..common import basics
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
+from . import doors as doors_mod
+from .autoscaler import ServingAutoscaler  # noqa: F401
 from .batcher import (AdmissionQueue, ContinuousBatcher,  # noqa: F401
                       InferenceRequest)
+from .doors import DoorGuard, DoorManager  # noqa: F401
 from .frontend import InferenceFrontend  # noqa: F401
 from .replicas import (ReplicaSet, ServingCoordinator,  # noqa: F401
-                       current, failed_rank_from_error, follower_loop)
+                       current, failed_rank_from_error, follower_loop,
+                       parked_loop)
 from .weights import (BackgroundLoader, CheckpointWeightSource,  # noqa: F401
                       StaticWeightSource, WeightSource)
 
@@ -82,41 +86,113 @@ def serve(model_fn: Callable, weights=None,
     rs = ReplicaSet(model_fn, weights=weights,
                     weight_source=weight_source, registry=registry)
     _set_current(rs)
+    # The first HOROVOD_SERVING_DOORS live ranks open front doors; the
+    # lowest (communicator rank 0) is the ACTIVE one driving rounds,
+    # the rest are standby doors forwarding admissions (doors.py).
+    rs.doors = rs.members[:min(env_cfg.serving_doors(), len(rs.members))]
+    fe = frontend
     own_frontend = False
     try:
-        if basics.rank() == 0:
-            if frontend is None:
+        if rs.my_world in rs.doors:
+            if fe is None:
                 own_frontend = True
-                frontend = InferenceFrontend(
+                fe = InferenceFrontend(
                     port=port, registry=rs.registry,
                     status_fn=rs.status).start()
-            _register_view(rs, frontend)
-            _wire_alert_rules(frontend)
+            rs.guard = doors_mod.DoorGuard(
+                rendezvous, epoch=0, active=(basics.rank() == 0))
+            fe.door_guard = rs.guard
+            rs.door_queue = fe.queue
+            _register_view(rs, fe)
+            _wire_alert_rules(fe)
             if max_requests is not None:
-                _arm_request_cap(frontend, rs, max_requests)
-            def on_remesh():
-                # An eviction re-inits the engine (new exporters, a
+                _arm_request_cap(fe, rs, max_requests)
+
+            def on_reinit():
+                # A re-mesh re-inits the engine (new exporters, a
                 # fresh AlertEngine built from defaults+env): the
                 # /serving view must follow onto the new endpoint AND
                 # the serving rules must be re-wired with the live
                 # frontend config, or the new engine alerts against
                 # the env defaults instead of the actual queue bound.
-                _register_view(rs, frontend)
-                _wire_alert_rules(frontend)
+                _register_view(rs, fe)
+                _wire_alert_rules(fe)
 
-            coord = ServingCoordinator(
-                rs, frontend, tick_seconds=tick_seconds,
-                rendezvous=rendezvous,
-                on_remesh=on_remesh)
-            report = coord.run()
-            report["port"] = frontend.port
-            return report
-        return follower_loop(rs)
+            rs.on_reinit = on_reinit
+        rs._update_lease()
+        # -- role loop: the same rank may be follower, then parked,
+        # then follower again — or win an election and end up the
+        # coordinator. Every path exits through a terminal status.
+        while True:
+            if basics.rank() == 0:
+                rs.door = None  # the active door forwards to nobody
+                doors_mod.publish_door_row(
+                    rendezvous, epoch=rs.door_epoch, door=rs.my_world,
+                    doors=[d for d in rs.doors if d in rs.members],
+                    members=rs.members)
+                from ..common import events as events_mod
+
+                events_mod.emit(
+                    events_mod.SERVING_DOOR_ELECTED, rank=rs.rank,
+                    door=rs.my_world, epoch=rs.door_epoch,
+                    doors=[d for d in rs.doors if d in rs.members])
+                autoscaler = ServingAutoscaler(
+                    rendezvous,
+                    interval=env_cfg.serving_autoscale_interval_seconds(),
+                    min_replicas=max(
+                        len([d for d in rs.doors if d in rs.members]), 1),
+                    registry=rs.registry)
+                coord = ServingCoordinator(
+                    rs, fe, tick_seconds=tick_seconds,
+                    rendezvous=rendezvous,
+                    on_remesh=rs.on_reinit,
+                    autoscaler=autoscaler)
+                report = coord.run()
+                report["port"] = fe.port
+                return report
+            if rs.door is None and rs.my_world in rs.doors:
+                rs.door = doors_mod.DoorManager(fe, rs.my_world)
+            outcome = follower_loop(rs)
+            if outcome == "stop":
+                return rs.status()
+            if outcome == "parked":
+                if parked_loop(rs, rendezvous) == "stop":
+                    return rs.status()
+                continue  # re-admitted: back to a serving role
+            # outcome == "promote": this rank won the election.
+            rs.note_election()
+            if fe is None:
+                # A non-door replica can inherit the fleet when every
+                # door before it died; it opens a door now.
+                own_frontend = True
+                fe = InferenceFrontend(
+                    port=port, registry=rs.registry,
+                    status_fn=rs.status).start()
+                rs.guard = doors_mod.DoorGuard(
+                    rendezvous, epoch=rs.door_epoch, active=True)
+                fe.door_guard = rs.guard
+                rs.door_queue = fe.queue
+                rs.on_reinit = lambda: (_register_view(rs, fe),
+                                        _wire_alert_rules(fe))
+            if rs.door is not None:
+                # Pending forwarded work this door admitted: head of
+                # the queue (oldest admitted); half-streamed responses
+                # were already error-terminated by promote().
+                pending = rs.door.promote()
+                if pending:
+                    fe.queue.requeue_front(pending)
+                rs.door = None
+            rs._update_lease()
+            _register_view(rs, fe)
+            _wire_alert_rules(fe)
+            logger.warning(
+                "serving: world rank %d won the door election at epoch "
+                "%d; taking over the rounds", rs.my_world, rs.door_epoch)
     finally:
         _set_current(None)
         _unregister_view()
-        if own_frontend and frontend is not None:
-            frontend.stop()
+        if own_frontend and fe is not None:
+            fe.stop()
 
 
 def _register_view(rs: ReplicaSet, frontend: InferenceFrontend):
@@ -165,7 +241,12 @@ def _unregister_view():
     """Detach `/serving` when serve() exits — a stale view would pin
     the dead replica set (staged weights included) for process lifetime
     and keep answering with frozen state instead of 404."""
-    eng = basics.engine()
+    try:
+        eng = basics.engine()
+    except Exception:
+        # A parked rank already shut the communicator down: nothing to
+        # detach, the exporters died with it.
+        return
     if eng is None:
         return
     from ..common.metrics_export import MetricsHTTPServer

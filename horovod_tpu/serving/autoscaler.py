@@ -1,0 +1,167 @@
+"""Closed-loop serving autoscaler (docs/serving.md "Serving
+autoscaler").
+
+The consumer the ``serving/load`` KV row was published for (a recorded
+gap since the serving plane landed): every
+``HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS`` the coordinator reads
+the row back and decides — in the elasticity controller's shape
+(runner/elastic/controller.py): a pure ``decide()``, a shared
+``CooldownGate`` (cooldown = 3x the interval), decisions counted per
+kind, journaled as ``serving.scale`` lifecycle events on CHANGE only,
+and mirrored to the KV at ``serving``/``scale`` for operators
+(scripts/hvdtop.py's serving section).
+
+Acting is the coordinator's job (serving/replicas.py): a non-HOLD
+decision turns into a ``remesh`` round — scale-down parks the highest
+non-door ranks (they poll the door row and rejoin on a later
+scale-up), scale-up re-admits parked ranks through the same subset
+re-mesh + rendezvous machinery evictions already use. Doors are never
+parked: the floor of the mesh is its door set.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional, Tuple
+
+from ..common import telemetry
+from ..runner.elastic.controller import (CooldownGate, HOLD, SCALE_DOWN,
+                                         SCALE_UP)
+from ..utils.logging import get_logger
+from .doors import DOOR_SCOPE, SCALE_KEY
+
+logger = get_logger()
+
+# Backlog watermarks, in admitted-but-unanswered requests PER REPLICA.
+# Above HIGH the mesh grows (one replica per decision — conservative,
+# cooldown-paced); at or below LOW it shrinks toward the door floor.
+BACKLOG_HIGH = 2.0
+BACKLOG_LOW = 0.25
+
+
+def decide(*, backlog: float, replicas: int, min_replicas: int,
+           max_replicas: int, high: float = BACKLOG_HIGH,
+           low: float = BACKLOG_LOW) -> Tuple[str, int, str]:
+    """Pure policy: (action, target_replicas, reason). One step per
+    decision; the cooldown gate owns the pacing, this owns only the
+    direction."""
+    replicas = max(int(replicas), 1)
+    per = float(backlog) / replicas
+    if per >= high and replicas < max_replicas:
+        return (SCALE_UP, replicas + 1,
+                f"backlog {backlog:.0f} over {replicas} replicas "
+                f"(>= {high:g}/replica)")
+    if per <= low and replicas > min_replicas:
+        return (SCALE_DOWN, replicas - 1,
+                f"backlog {backlog:.0f} over {replicas} replicas "
+                f"(<= {low:g}/replica)")
+    return HOLD, replicas, "steady state"
+
+
+def read_load(kv) -> Optional[dict]:
+    """The ``serving/load`` row (published by the coordinator at 1 Hz:
+    queue depth, inflight, replicas, weight step) — this module is its
+    consumer."""
+    if kv is None:
+        return None
+    try:
+        raw = kv.get("serving", "load")
+        return json.loads(raw.decode()) if raw else None
+    except Exception:
+        return None
+
+
+class ServingAutoscaler:
+    """Cadenced decide loop; the coordinator calls ``maybe()`` between
+    rounds and executes any non-None plan as a remesh round."""
+
+    def __init__(self, kv, *, interval: float, min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 registry: Optional[telemetry.MetricsRegistry] = None,
+                 high: float = BACKLOG_HIGH, low: float = BACKLOG_LOW):
+        self.kv = kv
+        self.interval = max(float(interval), 0.0)
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max_replicas
+        self.high = high
+        self.low = low
+        self._gate = CooldownGate(self.interval * 3.0)
+        self._next = 0.0
+        self._last_published: Optional[tuple] = None
+        registry = registry or telemetry.default_registry()
+        self._m = {
+            d: registry.counter(
+                "horovod_serving_scale_decisions_total",
+                "Serving autoscaler decisions by kind",
+                labels={"decision": d})
+            for d in (SCALE_UP, SCALE_DOWN, HOLD)
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval > 0 and self.kv is not None
+
+    def maybe(self, *, replicas: int, parked: int,
+              fallback_backlog: float = 0.0,
+              now: Optional[float] = None
+              ) -> Optional[Tuple[str, int, str]]:
+        """One cadenced observe→decide pass. Returns (action, target,
+        reason) only when the mesh should actually change; None on
+        hold, cooldown, off-cadence, or disabled."""
+        if not self.enabled:
+            return None
+        now = time.monotonic() if now is None else now
+        if now < self._next:
+            return None
+        self._next = now + self.interval
+        row = read_load(self.kv)
+        if row is not None:
+            backlog = max(float(row.get("queue_depth", 0)),
+                          float(row.get("inflight", 0)))
+        else:
+            backlog = float(fallback_backlog)
+        # Growth is bounded by the ranks that actually exist: the
+        # current mesh plus whoever is parked waiting for re-admission.
+        cap = replicas + max(int(parked), 0)
+        if self.max_replicas is not None:
+            cap = min(cap, self.max_replicas)
+        action, target, reason = decide(
+            backlog=backlog, replicas=replicas,
+            min_replicas=self.min_replicas, max_replicas=cap,
+            high=self.high, low=self.low)
+        if action != HOLD and self._gate.veto(now):
+            action, target, reason = (
+                HOLD, replicas,
+                f"cooldown ({self._gate.cooldown:.0f}s) after the "
+                "last scale")
+        self._m[action].inc()
+        self._publish(action, target, replicas, reason, backlog)
+        if action == HOLD:
+            return None
+        self._gate.fired(now)
+        logger.warning("serving autoscaler: %s %d -> %d (%s)",
+                       action, replicas, target, reason)
+        return action, target, reason
+
+    def _publish(self, action: str, target: int, replicas: int,
+                 reason: str, backlog: float):
+        # Journal on CHANGE only (docs/events.md): a steady HOLD is
+        # one fact, not a stream.
+        if (action, target, reason) != self._last_published:
+            self._last_published = (action, target, reason)
+            from ..common import events as events_mod
+
+            events_mod.emit(events_mod.SERVING_SCALE,
+                            severity=(events_mod.INFO if action == HOLD
+                                      else events_mod.WARN),
+                            rank=-1, action=action, replicas=replicas,
+                            target=target, backlog=backlog,
+                            reason=reason)
+        try:
+            self.kv.put(DOOR_SCOPE, SCALE_KEY, json.dumps({
+                "wall": time.time(), "action": action,
+                "replicas": replicas, "target": target,
+                "backlog": backlog, "reason": reason,
+            }, separators=(",", ":")).encode())
+        except Exception:  # pragma: no cover - observability only
+            pass

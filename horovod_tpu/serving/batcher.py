@@ -46,13 +46,25 @@ _req_ids = itertools.count(1)
 
 class InferenceRequest:
     """One admitted request: payload + token estimate + deadline + the
-    future the HTTP handler thread parks on."""
+    future the HTTP handler thread parks on.
+
+    A STREAMING request ({"stream": true}) additionally carries a
+    bounded in-order frame queue: the coordinator pushes one data frame
+    per serving round (``push_chunk``), the HTTP handler drains them as
+    ndjson lines (``next_chunk``), and ``complete()`` — whatever path
+    reaches it first: final chunk, deadline, eviction, shutdown —
+    always appends a TERMINAL frame, so an interrupted stream ends with
+    an error frame on the wire, never a silent hang (docs/serving.md
+    "Streaming responses")."""
 
     __slots__ = ("id", "payload", "tokens", "enqueued", "deadline",
-                 "result", "status", "error", "dispatched", "_done")
+                 "result", "status", "error", "dispatched", "_done",
+                 "stream", "n_chunks", "chunk_seq", "_frames",
+                 "_frame_cond")
 
     def __init__(self, payload, tokens: int = 1,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, stream: bool = False,
+                 chunks: int = 1):
         self.id = next(_req_ids)
         self.payload = payload
         self.tokens = max(int(tokens), 1)
@@ -66,6 +78,14 @@ class InferenceRequest:
         # vs in-flight (grace for the reply) requests.
         self.dispatched = False
         self._done = threading.Event()
+        self.stream = bool(stream)
+        self.n_chunks = max(int(chunks), 1)
+        # Next expected data-frame seq; push_chunk only accepts frames
+        # in order, so a retransmitted round after an eviction can never
+        # duplicate a chunk the client already saw.
+        self.chunk_seq = 0
+        self._frames: deque = deque()
+        self._frame_cond = threading.Condition()
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now if now is not None else time.monotonic()) >= self.deadline
@@ -76,14 +96,55 @@ class InferenceRequest:
         must not flip an already-answered request). Returns whether
         THIS call settled the request — callers count terminal statuses
         only on a True return, so racing completers never double-count
-        one request."""
+        one request. For a streaming request the settling call also
+        appends the terminal frame."""
         if self._done.is_set():
             return False
         self.result = result
         self.status = status
         self.error = error
         self._done.set()
+        if self.stream:
+            frame = {"final": True, "status": status,
+                     "chunks": self.chunk_seq}
+            if isinstance(result, dict) and "weight_step" in result:
+                frame["weight_step"] = result["weight_step"]
+            if error:
+                frame["error"] = error
+            with self._frame_cond:
+                self._frames.append(frame)
+                self._frame_cond.notify_all()
         return True
+
+    def push_chunk(self, frame: dict) -> bool:
+        """Append one data frame; in-order only (frame["seq"] must equal
+        the next expected seq) and never after completion. Returns
+        whether the frame was accepted — duplicates after a rerouted
+        round return False and are simply dropped."""
+        if not self.stream or self._done.is_set():
+            return False
+        if int(frame.get("seq", -1)) != self.chunk_seq:
+            return False
+        self.chunk_seq += 1
+        with self._frame_cond:
+            self._frames.append(frame)
+            self._frame_cond.notify_all()
+        return True
+
+    def next_chunk(self, timeout: Optional[float] = None
+                   ) -> Optional[dict]:
+        """Pop the next frame (data or terminal), waiting up to
+        `timeout`; None on timeout."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._frame_cond:
+            while not self._frames:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._frame_cond.wait(remaining)
+            return self._frames.popleft()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)

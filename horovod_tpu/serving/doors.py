@@ -1,0 +1,343 @@
+"""Redundant front doors: leases, the election fence, and forwarding.
+
+docs/serving.md "Redundant front doors". With
+``HOROVOD_SERVING_DOORS=N`` the first N live ranks each open the HTTP
+frontend. Exactly ONE — the lowest live rank, which is also
+communicator rank 0 — is the ACTIVE door driving rounds; the others
+are STANDBY doors that admit requests against a bounded-queue lease
+and forward them through the existing round protocol:
+
+* a standby door's round REPLY carries an ``admit`` list — the
+  requests it just pulled from its local batcher (the reply is
+  allgathered, so the coordinator sees it without a new channel);
+* the coordinator's round COMMANDS carry ``complete``/``chunks`` maps
+  keyed by request id — each id is namespaced ``"<origin world
+  rank>:<local id>"``, so every door picks out its own completions
+  from the broadcast and settles its local futures.
+
+The admission budget (``HOROVOD_SERVING_QUEUE_DEPTH``) is split into
+per-door leases over the rendezvous KV's door row — bounded queues,
+never a global lock: admission itself costs zero KV traffic.
+
+**Election.** The door row (``serving``/``door``) carries the
+membership and an EPOCH that increments on every re-mesh. When the
+active door dies, survivors re-mesh (serving/replicas.py) and the new
+communicator rank 0 — the lowest live world rank — promotes itself:
+publishes the row at the bumped epoch, re-registers the ``/serving``
+view, and requeues its pending forwarded work at the head. Every
+participant of the re-mesh bumps its epoch in lockstep; a door that
+did NOT participate (drained, wedged-but-alive) keeps its old lease
+epoch, and ``DoorGuard.stale()`` — checked on every admission —
+rejects its late admissions with 503: the epoch fence that stops a
+stale door from double-admitting against a budget it no longer holds.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .batcher import (InferenceRequest, STATUS_DEADLINE, STATUS_ERROR,
+                      STATUS_OK, STATUS_SHUTDOWN)
+
+logger = get_logger()
+
+# KV scope/keys of the door control rows (next to serving/load).
+DOOR_SCOPE = "serving"
+DOOR_KEY = "door"
+SCALE_KEY = "scale"
+
+_TERMINAL = (STATUS_OK, STATUS_DEADLINE, STATUS_ERROR, STATUS_SHUTDOWN)
+
+
+def lease_slots(total_depth: int, n_doors: int) -> int:
+    """One door's share of the fleet admission budget: the total queue
+    depth split evenly, never below one slot (a door that cannot admit
+    anything is not a door)."""
+    return max(int(total_depth) // max(int(n_doors), 1), 1)
+
+
+def publish_door_row(kv, *, epoch: int, door: int, doors: List[int],
+                     members: List[int], stopped: bool = False):
+    """Publish the door row — the single agreement point for election
+    epoch, active door, door set, and mesh membership. Best-effort: a
+    KV blink degrades freshness, never correctness (the round protocol
+    itself is the ordering authority for participants)."""
+    if kv is None:
+        return
+    try:
+        kv.put(DOOR_SCOPE, DOOR_KEY, json.dumps({
+            "epoch": int(epoch),
+            "door": int(door),
+            "doors": list(doors),
+            "members": list(members),
+            "stopped": bool(stopped),
+            "wall": time.time(),
+        }).encode())
+    except Exception as e:  # pragma: no cover - KV down
+        logger.warning("serving: door row publish failed: %s", e)
+
+
+def read_door_row(kv) -> Optional[dict]:
+    if kv is None:
+        return None
+    try:
+        raw = kv.get(DOOR_SCOPE, DOOR_KEY)
+        return json.loads(raw.decode()) if raw else None
+    except Exception:
+        return None
+
+
+class DoorGuard:
+    """One door's admission lease + the election epoch fence.
+
+    ``stale()`` is consulted on every admission: it compares the lease
+    epoch this door last participated in against the door row's
+    current epoch (read through a rate-limited KV cache). A door whose
+    epoch lost an election it did not participate in sees a newer row
+    and refuses to admit — late requests get 503, not a seat in a
+    budget the fleet already re-leased."""
+
+    def __init__(self, kv, epoch: int = 0, slots: int = 1,
+                 refresh_s: float = 0.5, active: bool = False):
+        self.kv = kv
+        self.epoch = int(epoch)
+        self.slots = max(int(slots), 1)
+        self.active = bool(active)  # is this process the ACTIVE door?
+        self.refresh_s = max(float(refresh_s), 0.0)
+        self._cached_epoch = self.epoch
+        self._next_check = 0.0
+
+    def renew(self, epoch: int, slots: Optional[int] = None,
+              active: Optional[bool] = None):
+        """Called after this door PARTICIPATED in a re-mesh: its lease
+        moves to the new epoch (and possibly a new slot split)."""
+        self.epoch = int(epoch)
+        self._cached_epoch = max(self._cached_epoch, self.epoch)
+        if slots is not None:
+            self.slots = max(int(slots), 1)
+        if active is not None:
+            self.active = bool(active)
+
+    def current_epoch(self) -> int:
+        """The fleet's door epoch as last observed (KV read at most
+        every `refresh_s`; no KV = own epoch, i.e. never stale)."""
+        if self.kv is None:
+            return self.epoch
+        now = time.monotonic()
+        if now >= self._next_check:
+            self._next_check = now + self.refresh_s
+            row = read_door_row(self.kv)
+            if row is not None:
+                self._cached_epoch = max(self._cached_epoch,
+                                         int(row.get("epoch", 0)))
+        return self._cached_epoch
+
+    def stale(self) -> bool:
+        return self.current_epoch() > self.epoch
+
+
+class WorkItem:
+    """One unit of coordinator work: a request admitted at SOME door.
+    ``req`` is the local future when this coordinator's own door
+    admitted it; None for a forwarded request, whose completion routes
+    back to ``origin`` via the next command's ``complete``/``chunks``
+    maps."""
+
+    __slots__ = ("rid", "origin", "payload", "tokens", "deadline",
+                 "stream", "n_chunks", "chunk_seq", "req")
+
+    def __init__(self, rid: str, origin: int, payload, tokens: int,
+                 deadline: float, stream: bool = False,
+                 n_chunks: int = 1,
+                 req: Optional[InferenceRequest] = None):
+        self.rid = rid
+        self.origin = origin
+        self.payload = payload
+        self.tokens = max(int(tokens), 1)
+        self.deadline = deadline
+        self.stream = bool(stream)
+        self.n_chunks = max(int(n_chunks), 1)
+        self.chunk_seq = 0
+        self.req = req
+
+    @classmethod
+    def from_local(cls, req: InferenceRequest, origin: int) -> "WorkItem":
+        w = cls(rid=f"{origin}:{req.id}", origin=origin,
+                payload=req.payload, tokens=req.tokens,
+                deadline=req.deadline, stream=req.stream,
+                n_chunks=req.n_chunks, req=req)
+        w.chunk_seq = req.chunk_seq
+        return w
+
+    @classmethod
+    def from_admit(cls, doc: dict, now: Optional[float] = None
+                   ) -> "WorkItem":
+        """Rebuild a forwarded request from an `admit` wire doc. The
+        deadline travels as REMAINING seconds (monotonic clocks do not
+        compare across processes)."""
+        now = time.monotonic() if now is None else now
+        return cls(rid=str(doc["rid"]), origin=int(doc["origin"]),
+                   payload=doc.get("payload"),
+                   tokens=int(doc.get("tokens", 1)),
+                   deadline=now + float(doc.get("timeout_rem", 0.0)),
+                   stream=bool(doc.get("stream")),
+                   n_chunks=int(doc.get("chunks", 1)))
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (now if now is not None
+                else time.monotonic()) >= self.deadline
+
+
+def admit_doc(req: InferenceRequest, origin: int,
+              now: Optional[float] = None) -> dict:
+    """The wire form of one forwarded admission (a round-reply `admit`
+    entry)."""
+    now = time.monotonic() if now is None else now
+    return {
+        "rid": f"{origin}:{req.id}",
+        "origin": origin,
+        "payload": req.payload,
+        "tokens": req.tokens,
+        "timeout_rem": max(req.deadline - now, 0.001),
+        "stream": req.stream,
+        "chunks": req.n_chunks,
+    }
+
+
+class DoorManager:
+    """A STANDBY door's forwarding bookkeeping, attached to the
+    ReplicaSet as its per-round hook (``rs.door``):
+
+    * ``reply_fields()`` drains the local batcher into this round's
+      reply (``admit`` list) and raises the stop flag when an operator
+      POSTed /admin/stop here;
+    * ``on_command()`` applies the completions/chunks the coordinator
+      routed to this origin;
+    * ``on_recovery()`` re-forwards still-pending work after a re-mesh
+      — and when the ACTIVE door is the one that died, terminates
+      half-streamed responses with an error frame (the old
+      coordinator's stream state died with it; an at-most-once stream
+      ends loudly, it never silently hangs).
+
+    Re-forwarding is idempotent: the coordinator dedups by rid, and
+    the origin's futures are first-completion-wins."""
+
+    def __init__(self, frontend, my_world: int):
+        self.frontend = frontend
+        self.my_world = int(my_world)
+        self.pending: Dict[str, InferenceRequest] = {}
+        self._reforward: List[str] = []
+
+    # -- round hooks -----------------------------------------------------
+    def reply_fields(self) -> dict:
+        now = time.monotonic()
+        admit: List[dict] = []
+        # Re-forwards first (oldest admitted work travels first).
+        for rid in self._reforward:
+            req = self.pending.get(rid)
+            if req is not None and not req.done:
+                admit.append(admit_doc(req, self.my_world, now))
+        self._reforward = []
+        batch = self.frontend.batcher.next_batch(0.0)
+        for req in batch or []:
+            rid = f"{self.my_world}:{req.id}"
+            self.pending[rid] = req
+            admit.append(admit_doc(req, self.my_world, now))
+        self._prune_done()
+        return {"admit": admit,
+                "stop_req": bool(self.frontend.stopping),
+                # Admitted-but-unanswered here: the coordinator must
+                # not stop while any door still owes a client an answer.
+                "door_pending": (len(self.pending)
+                                 + self.frontend.queue.depth())}
+
+    def on_command(self, cmd: dict):
+        """Settle local futures from the routed completion/chunk maps
+        (other origins' entries are skipped by the rid prefix)."""
+        mine = f"{self.my_world}:"
+        for rid, frames in (cmd.get("chunks") or {}).items():
+            req = self.pending.get(rid) if rid.startswith(mine) else None
+            if req is None:
+                continue
+            for frame in frames:
+                req.push_chunk(frame)
+        for rid, doc in (cmd.get("complete") or {}).items():
+            if not rid.startswith(mine):
+                continue
+            req = self.pending.pop(rid, None)
+            if req is None:
+                continue
+            status = doc.get("status", STATUS_ERROR)
+            if status not in _TERMINAL:
+                status = STATUS_ERROR
+            if status == STATUS_OK:
+                settled = req.complete(
+                    {"output": doc.get("output"),
+                     "weight_step": doc.get("weight_step", -1),
+                     **({"chunks": doc["chunks"]}
+                        if "chunks" in doc else {})},
+                    STATUS_OK)
+            else:
+                settled = req.complete(None, status,
+                                       doc.get("error") or status)
+            if settled:
+                self.frontend.batcher.count(status)
+
+    # -- failover --------------------------------------------------------
+    def on_recovery(self, coordinator_died: bool):
+        """After rs.recover(): decide each pending forwarded request's
+        fate. Streams with emitted chunks survive a REPLICA loss (the
+        coordinator still holds their state and re-drives the lost
+        round) but not a COORDINATOR loss — those end with an error
+        frame. Everything else re-forwards; the new (or same)
+        coordinator dedups by rid."""
+        self._reforward = []
+        for rid, req in list(self.pending.items()):
+            if req.done:
+                del self.pending[rid]
+                continue
+            if coordinator_died and req.stream and req.chunk_seq > 0:
+                if req.complete(None, STATUS_ERROR,
+                                "stream interrupted by front-door "
+                                "failover"):
+                    self.frontend.batcher.count(STATUS_ERROR)
+                del self.pending[rid]
+                continue
+            if coordinator_died or not (req.stream and req.chunk_seq > 0):
+                self._reforward.append(rid)
+
+    def promote(self) -> List[InferenceRequest]:
+        """This door just WON the election. Half-streamed forwards end
+        with an error frame (stream state died with the old
+        coordinator); everything else returns — in admission order —
+        for the new coordinator to requeue at the head of its own
+        queue. The manager is spent afterwards."""
+        keep: List[InferenceRequest] = []
+        for rid, req in self.pending.items():
+            if req.done:
+                continue
+            if req.stream and req.chunk_seq > 0:
+                if req.complete(None, STATUS_ERROR,
+                                "stream interrupted by front-door "
+                                "failover"):
+                    self.frontend.batcher.count(STATUS_ERROR)
+                continue
+            keep.append(req)
+        self.pending = {}
+        self._reforward = []
+        return keep
+
+    def fail_pending(self, reason: str):
+        """Terminal shutdown: no coordinator will ever answer these."""
+        for req in self.pending.values():
+            if req.complete(None, STATUS_SHUTDOWN, reason):
+                self.frontend.batcher.count(STATUS_SHUTDOWN)
+        self.pending = {}
+        self._reforward = []
+
+    def _prune_done(self):
+        dead = [rid for rid, req in self.pending.items() if req.done]
+        for rid in dead:
+            del self.pending[rid]

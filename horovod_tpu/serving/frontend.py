@@ -83,6 +83,10 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, OSError) as e:
                 self._send(400, {"error": f"bad request body: {e}"})
                 return
+            if (isinstance(doc, dict) and doc.get("stream")
+                    and env_cfg.serving_stream_enabled()):
+                fe.infer_stream(doc, self)
+                return
             code, out = fe.infer(doc)
             hdrs = (("Retry-After", "1"),) if code == 429 else ()
             self._send(code, out, hdrs)
@@ -121,9 +125,16 @@ class InferenceFrontend:
         self._status_fn = status_fn
         self._stop_fn = stop_fn
         self._stopping = threading.Event()
+        # The election fence (serving/doors.py): serve() attaches a
+        # DoorGuard when redundant doors are on. None = classic single
+        # front door, never stale.
+        self.door_guard = None
         self._m_latency = self.registry.histogram(
             "horovod_serving_request_seconds",
             "End-to-end request latency, admission to reply")
+        self._m_chunks = self.registry.counter(
+            "horovod_serving_streamed_chunks_total",
+            "Streaming data frames written to clients")
         # Admitted-and-not-yet-answered, derived from the request
         # futures themselves (pruned on read): the programmatic
         # `submit()` path has no infer() handler to pair a decrement
@@ -181,25 +192,50 @@ class InferenceFrontend:
         return self._stopping.is_set()
 
     def submit(self, inputs, tokens: int = 1,
-               timeout_s: Optional[float] = None
-               ) -> Optional[InferenceRequest]:
+               timeout_s: Optional[float] = None, stream: bool = False,
+               chunks: int = 1) -> Optional[InferenceRequest]:
         """Programmatic admission (the HTTP route and tests both land
-        here). None = rejected (queue full or stopping)."""
+        here). None = rejected (queue full, stopping, or this door's
+        election epoch went stale — the fence in docs/serving.md
+        "Redundant front doors")."""
         if self._stopping.is_set():
+            return None
+        if self.door_guard is not None and self.door_guard.stale():
+            # A door that lost an election it did not participate in
+            # must not admit against a budget the fleet re-leased.
+            self.batcher.count("rejected")
             return None
         # A client may lower its deadline below the server default,
         # never raise it past it (the server bound is the operator's
         # overload guarantee).
         t = self.default_timeout if timeout_s is None else min(
             max(float(timeout_s), 0.001), self.default_timeout)
-        req = InferenceRequest(inputs, tokens=tokens, timeout_s=t)
+        req = InferenceRequest(inputs, tokens=tokens, timeout_s=t,
+                               stream=stream, chunks=chunks)
         if not self.queue.offer(req):
             self.batcher.count("rejected")
             return None
         with self._inflight_lock:
             self._open[req.id] = req
         self._trace_admit(req)
+        self._chaos_admit_hook()
         return req
+
+    def _chaos_admit_hook(self):
+        """killdoor drill point (common/fault_injection.py): one call
+        per ACCEPTED request, flagged with whether this process is the
+        active door. No guard = the classic single front door, which
+        is by definition active."""
+        try:
+            from ..common import fault_injection
+
+            inj = fault_injection.injector
+            if inj.active:
+                inj.check_door_admit(
+                    self.door_guard.active if self.door_guard is not None
+                    else True)
+        except Exception:  # chaos plumbing must never fail admission
+            pass
 
     def _trace_admit(self, req: InferenceRequest):
         """`serve.admit` instant in the flight recorder — pairs with
@@ -223,24 +259,39 @@ class InferenceFrontend:
                 del self._open[rid]
             return len(self._open)
 
+    @staticmethod
+    def _parse_infer_doc(doc) -> "tuple":
+        """(inputs, tokens, timeout_s, chunks) from a request body —
+        the structured form or any bare JSON document as the inputs."""
+        if isinstance(doc, dict) and ("inputs" in doc or "tokens" in doc
+                                      or "timeout_s" in doc
+                                      or "stream" in doc):
+            return (doc.get("inputs"), doc.get("tokens", 1),
+                    doc.get("timeout_s"), doc.get("chunks", 1))
+        return doc, 1, None, 1
+
+    def _reject(self) -> "tuple[int, dict]":
+        """Why submit() said no, as an HTTP answer."""
+        if self._stopping.is_set():
+            return 503, {"error": "serving is stopping"}
+        guard = self.door_guard
+        if guard is not None and guard.stale():
+            return 503, {"error": (
+                "stale front door: lease epoch "
+                f"{guard.epoch} superseded by epoch "
+                f"{guard.current_epoch()}; retry another door")}
+        return 429, {"error": "admission queue full; retry"}
+
     def infer(self, doc) -> "tuple[int, dict]":
         """Blocking request → (http_code, body). Runs on the handler
         thread; parks on the request future until completion or
         deadline."""
-        if isinstance(doc, dict) and ("inputs" in doc or "tokens" in doc
-                                      or "timeout_s" in doc):
-            inputs = doc.get("inputs")
-            tokens = doc.get("tokens", 1)
-            timeout_s = doc.get("timeout_s")
-        else:
-            inputs, tokens, timeout_s = doc, 1, None
+        inputs, tokens, timeout_s, _ = self._parse_infer_doc(doc)
         if self._stopping.is_set():
             return 503, {"error": "serving is stopping"}
         req = self.submit(inputs, tokens=tokens, timeout_s=timeout_s)
         if req is None:
-            if self._stopping.is_set():
-                return 503, {"error": "serving is stopping"}
-            return 429, {"error": "admission queue full; retry"}
+            return self._reject()
         # Park until the deadline. A request STILL QUEUED at its
         # deadline is answered 504 right here (first-completion-wins
         # settles the race with a batcher take at the same instant);
@@ -263,11 +314,97 @@ class InferenceFrontend:
             body = req.result if isinstance(req.result, dict) else {
                 "output": req.result}
             return 200, body
-        if req.status == STATUS_DEADLINE:
-            return 504, {"error": req.error or "deadline expired"}
-        if req.status == STATUS_SHUTDOWN:
-            return 503, {"error": req.error or "serving stopped"}
-        return 500, {"error": req.error or "replica error"}
+        return self._error_code(req.status), {
+            "error": req.error or req.status or "replica error"}
+
+    @staticmethod
+    def _error_code(status) -> int:
+        if status == STATUS_DEADLINE:
+            return 504
+        if status == STATUS_SHUTDOWN:
+            return 503
+        return 500
+
+    def infer_stream(self, doc, handler):
+        """Streaming request → ndjson frames over a chunked HTTP/1.1
+        response (docs/serving.md "Streaming responses"). The handler
+        thread drains the request's frame queue: one data frame per
+        serving round, each carrying `weight_step`, then a terminal
+        frame. Deadline/504 semantics are preserved: BEFORE the first
+        frame the client gets a plain 504/5xx JSON answer exactly like
+        unary; once bytes have flowed, a deadline or a failover ends
+        the stream with a terminal error frame — never a silent hang
+        (complete() always appends one)."""
+        inputs, tokens, timeout_s, chunks = self._parse_infer_doc(doc)
+        if self._stopping.is_set():
+            handler._send(503, {"error": "serving is stopping"})
+            return
+        req = self.submit(inputs, tokens=tokens, timeout_s=timeout_s,
+                          stream=True, chunks=chunks)
+        if req is None:
+            code, body = self._reject()
+            hdrs = (("Retry-After", "1"),) if code == 429 else ()
+            handler._send(code, body, hdrs)
+            return
+        # Wait for the FIRST frame up to the deadline; the status code
+        # is still ours to choose until bytes hit the wire.
+        first = req.next_chunk(max(req.deadline - time.monotonic(), 0))
+        if first is None:
+            if not req.done and not req.dispatched:
+                if req.complete(None, STATUS_DEADLINE,
+                                "deadline expired before dispatch"):
+                    self.batcher.count(STATUS_DEADLINE)
+            elif not req.done and not req.wait(5.0):
+                if req.complete(None, STATUS_ERROR,
+                                "serving loop stalled"):
+                    self.batcher.count(STATUS_ERROR)
+            first = req.next_chunk(5.0)
+        self._m_latency.observe(time.monotonic() - req.enqueued)
+        if first is None or first.get("final"):
+            status = (first or {}).get("status", STATUS_ERROR)
+            if status == STATUS_OK:
+                # Completed without a data frame (e.g. streaming off
+                # upstream): answer the final result as unary JSON.
+                body = req.result if isinstance(req.result, dict) else {
+                    "output": req.result}
+                handler._send(200, body)
+            else:
+                handler._send(self._error_code(status), {
+                    "error": (first or {}).get("error")
+                    or req.error or str(status)})
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def _write(frame: dict):
+            data = (json.dumps(frame) + "\n").encode("utf-8")
+            handler.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            handler.wfile.flush()
+
+        try:
+            frame = first
+            while True:
+                if not frame.get("final"):
+                    self._m_chunks.inc()
+                _write(frame)
+                if frame.get("final"):
+                    break
+                frame = req.next_chunk(
+                    max(req.deadline - time.monotonic(), 0))
+                if frame is None:
+                    # Deadline passed mid-stream: terminate loudly.
+                    if req.complete(None, STATUS_DEADLINE,
+                                    "deadline expired mid-stream"):
+                        self.batcher.count(STATUS_DEADLINE)
+                    frame = req.next_chunk(5.0) or {
+                        "final": True, "status": STATUS_DEADLINE,
+                        "error": "deadline expired mid-stream"}
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up mid-stream; the future settles alone
 
     # -- introspection ---------------------------------------------------
     def basic_status(self) -> dict:

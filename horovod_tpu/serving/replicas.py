@@ -38,22 +38,37 @@ records the verdict, re-meshes the SURVIVORS as a subset communicator
 (`hvd.init(ranks=...)` — the rendezvous KV outlives any one rank), and
 requeues the interrupted batch at the head of the admission queue — in
 -flight work reroutes to the remaining replicas instead of being
-dropped. If rank 0 (the front door) is the one declared dead, serving
-is over: followers re-raise.
+dropped. Losing the ACTIVE front door is an eviction like any other:
+survivors re-mesh, the new communicator rank 0 — the lowest live world
+rank — wins the election, bumps the epoch on the KV door row
+(serving/doors.py), re-registers the ``/serving`` view and takes over
+the rounds; surviving standby doors re-forward their pending admitted
+work, so every request accepted at a surviving door still answers
+(docs/serving.md "Failover").
+
+**Scaling**: the serving autoscaler (serving/autoscaler.py) turns
+``serving/load`` into ``remesh`` rounds — scale-down victims park in
+``parked_loop`` polling the door row, scale-up re-admits them through
+the same subset init every eviction already uses.
 """
 from __future__ import annotations
 
 import re
 import threading
 import time
-from typing import Callable, List, Optional
+from collections import deque
+from typing import Callable, Dict, List, Optional
 
 from ..common import basics, telemetry
-from ..common.exceptions import HorovodInternalError
+from ..common.exceptions import HorovodInternalError, NotInitializedError
 from ..common.functions import allgather_object, broadcast_object
+from ..runner.elastic.controller import SCALE_UP
 from ..utils import env as env_cfg
 from ..utils.logging import get_logger
-from .batcher import STATUS_ERROR, STATUS_OK, STATUS_SHUTDOWN
+from . import doors as doors_mod
+from .batcher import (STATUS_DEADLINE, STATUS_ERROR, STATUS_OK,
+                      STATUS_SHUTDOWN)
+from .doors import WorkItem
 from .weights import BackgroundLoader, StaticWeightSource, WeightSource
 
 logger = get_logger()
@@ -94,7 +109,19 @@ def failed_rank_from_error(exc: BaseException) -> Optional[int]:
     peer = getattr(exc, "peer", None)
     if isinstance(peer, int):
         return peer
-    m = re.search(r"rank (\d+)", str(exc))
+    text = str(exc)
+    # Liveness verdict: "rank 2 (host x) declared dead by rank 0: ...".
+    m = re.search(r"rank (\d+)[^:]*declared dead", text)
+    if m:
+        return int(m.group(1))
+    # Transport death finalized through the engine loses the structured
+    # .peer (handles fail with the stringified status): "rank 1: recv
+    # from peer 0 failed: ..." — the PEER is the dead one; the leading
+    # rank is the reporter.
+    m = re.search(r"peer (\d+)", text)
+    if m:
+        return int(m.group(1))
+    m = re.search(r"rank (\d+)", text)
     return int(m.group(1)) if m else None
 
 
@@ -121,6 +148,27 @@ class ReplicaSet:
         self.batches = 0
         self.forwarded = 0
         self.stopped = False
+        # -- door state (serving/doors.py) -----------------------------
+        # World ranks running an HTTP front door. The ACTIVE door is
+        # always members[0]: `members` stays sorted ascending, doors
+        # are never parked by the autoscaler, so the lowest live world
+        # rank IS communicator rank 0 after every re-mesh.
+        self.doors: List[int] = self.members[:1]
+        self.door_epoch = 0
+        # Ranks a scale-down parked out of the mesh; they wait in
+        # parked_loop and rejoin on a later scale-up.
+        self.parked: List[int] = []
+        self.last_cmd: Optional[dict] = None
+        # Hooks serve() attaches on door ranks: the forwarding manager
+        # (standby doors only), the lease/epoch guard, and this door's
+        # admission queue (re-leased on every epoch bump).
+        self.door: Optional[doors_mod.DoorManager] = None
+        self.guard: Optional[doors_mod.DoorGuard] = None
+        self.door_queue = None
+        # serve() hook re-run after every re-init (the engine is new:
+        # views and alert rules must re-attach to the new exporters).
+        self.on_reinit: Optional[Callable[[], None]] = None
+        self._lease_total = env_cfg.serving_queue_depth()
         eng = basics.engine()
         if registry is not None:
             self.registry = registry
@@ -149,11 +197,23 @@ class ReplicaSet:
         self._m_replicas = self.registry.gauge(
             "horovod_serving_replicas", "Live replicas in the serving mesh")
         self._m_replicas.set(len(self.members))
+        self._m_doors = self.registry.gauge(
+            "horovod_serving_doors",
+            "Live HTTP front doors in the serving fleet")
+        self._m_doors.set(len(self.doors))
+        self._m_elections = self.registry.counter(
+            "horovod_serving_door_elections_total",
+            "Door failover elections won by this process")
 
     # -- helpers ---------------------------------------------------------
     @property
     def rank(self) -> int:
-        return basics.rank()
+        try:
+            return basics.rank()
+        except NotInitializedError:
+            # A parked rank shut its communicator down; status() must
+            # still answer (the stop path returns it as the report).
+            return -1
 
     @property
     def world(self) -> int:
@@ -184,8 +244,15 @@ class ReplicaSet:
         staged steps — symmetric information keeps recovery decisions
         consistent)."""
         cmd = broadcast_object(cmd, 0, name="serve.cmd")
+        self.last_cmd = cmd
         kind = cmd["kind"]
         results, errors = {}, {}
+        # Forwarded-work routing (serving/doors.py): a standby door
+        # settles the completions/chunks the coordinator addressed to
+        # it FIRST — a terminal answer must never wait on this round's
+        # forward.
+        if self.door is not None:
+            self.door.on_command(cmd)
         # Hot-swap verbs ride every round (module doc): commit flips
         # BEFORE this round's forward so the whole batch is answered by
         # the new weights on every replica; prepare just arms the
@@ -203,6 +270,13 @@ class ReplicaSet:
             self.batches += 1
         elif kind == "stop":
             self.stopped = True
+            if self.door is not None:
+                # The routed completions above were the last; nothing
+                # will ever answer what is still pending here.
+                self.door.fail_pending("serving stopped")
+        # A "remesh" round carries no work: the membership change it
+        # announces happens AFTER the reply gather (the caller acts on
+        # rs.last_cmd), so the round itself stays a plain barrier.
         reply = {
             "world_rank": self.my_world,
             "staged": self.loader.staged(),
@@ -211,6 +285,8 @@ class ReplicaSet:
             "results": results,
             "errors": errors,
         }
+        if self.door is not None and kind != "stop":
+            reply.update(self.door.reply_fields())
         self.rounds += 1
         self._m_rounds.inc()
         return allgather_object(reply, name="serve.reply")
@@ -271,23 +347,25 @@ class ReplicaSet:
                     step)
 
     # -- eviction / re-mesh ---------------------------------------------
-    def recover(self, exc: HorovodInternalError) -> int:
-        """Re-mesh the survivors after a liveness verdict. Returns the
-        evicted WORLD rank; raises the original error when recovery is
-        impossible (unattributed failure, front door dead, or we are
-        the one declared dead)."""
+    def recover(self, exc: HorovodInternalError) -> "tuple[int, bool]":
+        """Re-mesh the survivors after a liveness verdict. Returns
+        ``(evicted world rank, coordinator_died)``; raises the original
+        error when recovery is impossible (unattributed failure, nobody
+        left, or we are the one declared dead). Losing the ACTIVE front
+        door no longer ends serving (docs/serving.md "Failover"): the
+        survivors re-mesh exactly as for any replica, and the new
+        communicator rank 0 — the lowest live world rank — wins the
+        election the epoch bump below fences."""
         dead_idx = failed_rank_from_error(exc)
         if dead_idx is None or not (0 <= dead_idx < len(self.members)):
             raise exc
         dead_world = self.members[dead_idx]
-        if dead_idx == 0:
-            # The front door holds every request future; nobody can
-            # take over the HTTP socket. Degradation semantics
-            # (docs/serving.md): rank-0 loss ends serving.
-            raise exc
+        coordinator_died = dead_idx == 0
         if dead_world == self.my_world:
             raise exc  # we were declared dead; do not fight the verdict
         survivors = [m for m in self.members if m != dead_world]
+        if not survivors:
+            raise exc
         verdict = str(exc)
         self.verdicts.append(verdict)
         self._m_evictions.inc()
@@ -301,14 +379,61 @@ class ReplicaSet:
             "serving: evicting world rank %d after verdict '%s'; "
             "re-meshing %d survivors", dead_world, verdict,
             len(survivors))
+        # Election bookkeeping BEFORE the re-init: the dead rank leaves
+        # the door set, the survivors' head joins it (a fleet must
+        # always have its active door), and the epoch bumps — any door
+        # that did NOT participate in this re-mesh keeps its old lease
+        # and goes stale (doors.DoorGuard).
+        self.doors = [d for d in self.doors if d != dead_world]
+        if survivors[0] not in self.doors:
+            self.doors.append(survivors[0])
+            self.doors.sort()
+        self._remesh(survivors, self.door_epoch + 1)
+        return dead_world, coordinator_died
+
+    def remesh(self, members: List[int], epoch: int):
+        """Autoscaler-driven membership change (a ``remesh`` round):
+        every participant re-inits the subset communicator at the new
+        epoch. Ascending order is the election invariant — the active
+        door must come out as communicator rank 0."""
+        self._remesh(sorted(int(m) for m in members), int(epoch))
+
+    def _remesh(self, members: List[int], epoch: int):
         basics.shutdown()
         # Subset re-init under the launcher's still-alive rendezvous
-        # KV. Every survivor derives the SAME subset from the SAME
-        # verdict, so the generation-scoped rendezvous keys line up.
-        basics.init(ranks=survivors)
-        self.members = survivors
+        # KV. Every participant derives the SAME subset from the SAME
+        # verdict/command, so the generation-scoped rendezvous keys
+        # line up.
+        basics.init(ranks=members)
+        self.members = members
+        self.door_epoch = epoch
         self._m_replicas.set(len(self.members))
-        return dead_world
+        self._update_lease()
+        if self.on_reinit is not None:
+            try:
+                self.on_reinit()
+            except Exception as e:  # observability must not kill rounds
+                logger.warning("serving: on_reinit hook failed: %s", e)
+
+    def _update_lease(self):
+        """Re-derive this rank's admission lease from the deterministic
+        split of the fleet budget over the live doors — every
+        participant computes the same split from the same membership,
+        so admission itself costs zero KV traffic."""
+        live_doors = [d for d in self.doors if d in self.members]
+        self._m_doors.set(len(live_doors))
+        slots = doors_mod.lease_slots(self._lease_total,
+                                      len(live_doors) or 1)
+        if self.guard is not None:
+            self.guard.renew(
+                self.door_epoch, slots=slots,
+                active=bool(self.members
+                            and self.members[0] == self.my_world))
+        if self.door_queue is not None:
+            self.door_queue.maxsize = max(slots, 1)
+
+    def note_election(self):
+        self._m_elections.inc()
 
     # -- introspection ---------------------------------------------------
     def status(self) -> dict:
@@ -316,6 +441,11 @@ class ReplicaSet:
             "role": "coordinator" if self.rank == 0 else "replica",
             "world": self.world,
             "members": list(self.members),
+            "door": self.members[0] if self.members else -1,
+            "doors": [d for d in self.doors if d in self.members],
+            "door_epoch": self.door_epoch,
+            "is_door": self.my_world in self.doors,
+            "parked": list(self.parked),
             "rounds": self.rounds,
             "batches": self.batches,
             "forwarded": self.forwarded,
@@ -329,19 +459,23 @@ class ReplicaSet:
 
 
 class ServingCoordinator:
-    """Rank 0's driver: pulls batches from the frontend's batcher,
-    chooses each round's command, completes request futures from the
-    reply gather, and runs the hot-swap + eviction protocols."""
+    """The ACTIVE door's driver: pulls work from its own batcher AND
+    from the standby doors' forwarded admissions, chooses each round's
+    command, completes request futures (local) or routes completions
+    back to their origin door (forwarded), and runs the hot-swap,
+    eviction and autoscale protocols."""
 
     def __init__(self, replica_set: ReplicaSet, frontend,
                  tick_seconds: float = 0.25,
                  rendezvous=None,
-                 on_remesh: Optional[Callable[[], None]] = None):
+                 on_remesh: Optional[Callable[[], None]] = None,
+                 autoscaler=None):
         self.rs = replica_set
         self.frontend = frontend
         self.tick = max(tick_seconds, 0.01)
         self.rendezvous = rendezvous
         self.on_remesh = on_remesh
+        self.autoscaler = autoscaler
         self.refresh_s = env_cfg.serving_weight_refresh_seconds()
         self._next_poll = 0.0
         self._next_load_pub = 0.0
@@ -354,6 +488,21 @@ class ServingCoordinator:
         # Batch rotation seed; carried in each batch command so every
         # rank (however recently re-meshed) splits identically.
         self._seq = 0
+        # Forwarded-work state (docs/serving.md "Redundant front
+        # doors"): the in-flight round's WorkItems, forwarded
+        # admissions not yet dispatched, stream continuations awaiting
+        # their next chunk, and the outbox of completions/chunks the
+        # next command routes back to origin doors. `_remote_live`
+        # dedups re-forwards by rid.
+        self._dispatching: List[WorkItem] = []
+        self._remote_q: "deque[WorkItem]" = deque()
+        self._continuations: "deque[WorkItem]" = deque()
+        self._remote_live: set = set()
+        self._out_complete: Dict[str, dict] = {}
+        self._out_chunks: Dict[str, List[dict]] = {}
+        # Sum of the doors' admitted-but-unanswered counts, off the
+        # last reply gather: the stop gate.
+        self._door_pending = 0
 
     # -- weight watch ----------------------------------------------------
     def _poll_weights(self):
@@ -383,10 +532,11 @@ class ServingCoordinator:
                     "preparing hot-swap", step)
 
     def _publish_load(self):
-        """Load signal for the elastic driver (docs/serving.md
-        "Scaling"): queue depth + replica count on the rendezvous KV,
-        rate-limited to once a second. Consumers (a scale controller, a
-        dashboard) read `serving/load`."""
+        """Load signal on the rendezvous KV (docs/serving.md
+        "Scaling"): queue depth, fleet-wide in-flight work, replica and
+        door counts, rate-limited to once a second. The serving
+        autoscaler (serving/autoscaler.py) is the closed-loop consumer;
+        hvdtop reads it too."""
         if self.rendezvous is None:
             return
         now = time.monotonic()
@@ -398,7 +548,19 @@ class ServingCoordinator:
 
             self.rendezvous.put("serving", "load", _json.dumps({
                 "queue_depth": self.frontend.queue.depth(),
+                # The sum below is sampled between rounds, where the
+                # queue and dispatch set are transiently empty even
+                # under sustained traffic; the frontend's open-request
+                # count is the quiescence-proof floor (an admitted
+                # request stays open until its response is delivered).
+                "inflight": max(len(self._dispatching)
+                                + len(self._remote_q)
+                                + len(self._continuations)
+                                + self.frontend.queue.depth(),
+                                self.frontend._inflight_count()),
                 "replicas": self.rs.world,
+                "doors": len([d for d in self.rs.doors
+                              if d in self.rs.members]),
                 "weight_step": self.rs.weight_step,
                 "time": time.time(),
             }).encode())
@@ -410,18 +572,36 @@ class ServingCoordinator:
         """Decide this round's command: one batch of work (or a tick /
         the drain-complete stop), plus the piggybacked swap verb — a
         busy mesh must never starve the swap, and the swap must never
-        delay traffic already coalesced."""
+        delay traffic already coalesced. Stream continuations and
+        forwarded admissions dispatch FIRST (they are the oldest
+        admitted work); the local batcher tops the batch up."""
+        items: List[WorkItem] = []
+        cap = self.frontend.batcher.max_batch
+        while self._continuations and len(items) < cap:
+            items.append(self._continuations.popleft())
+        now = time.monotonic()
+        while self._remote_q and len(items) < cap:
+            w = self._remote_q.popleft()
+            if w.expired(now):
+                self._finish(w, STATUS_DEADLINE,
+                             error="deadline expired before dispatch")
+                continue
+            items.append(w)
         with self.rs._span("serve.batch"):
-            batch = self.frontend.batcher.next_batch(self.tick)
-        if batch:
-            self._dispatching = batch
+            batch = self.frontend.batcher.next_batch(
+                0.0 if items else self.tick)
+        for req in batch or []:
+            items.append(WorkItem.from_local(req, self.rs.my_world))
+        if items:
+            self._dispatching = items
             self._seq += 1
             cmd = {"kind": "batch", "seq": self._seq, "items": [
-                {"id": r.id, "payload": r.payload} for r in batch]}
+                {"id": w.rid, "payload": w.payload} for w in items]}
         else:
             self._dispatching = []
             if (self.frontend.stopping
-                    and self.frontend.queue.depth() == 0):
+                    and self.frontend.queue.depth() == 0
+                    and self._door_pending == 0):
                 cmd = {"kind": "stop"}
             else:
                 cmd = {"kind": "tick"}
@@ -430,10 +610,75 @@ class ServingCoordinator:
                 cmd["commit"] = self._swap_target
             else:
                 cmd["prepare"] = self._swap_target
+        self._attach_outbox(cmd)
         return cmd
+
+    # -- completion routing ----------------------------------------------
+    def _attach_outbox(self, cmd: dict):
+        """Routed completions/chunks ride EVERY command — including the
+        stop round, whose routed answers are the last to travel."""
+        if self._out_complete:
+            cmd["complete"] = self._out_complete
+            self._out_complete = {}
+        if self._out_chunks:
+            cmd["chunks"] = self._out_chunks
+            self._out_chunks = {}
+
+    def _restore_outbox(self, cmd: Optional[dict]):
+        """A round died before its gather proved delivery: put its
+        routed maps back so the next command re-carries them. Safe if
+        the broadcast DID land — origin futures are first-completion-
+        wins and push_chunk dedups by sequence number."""
+        if not cmd:
+            return
+        for rid, doc in (cmd.get("complete") or {}).items():
+            self._out_complete.setdefault(rid, doc)
+        for rid, frames in (cmd.get("chunks") or {}).items():
+            self._out_chunks[rid] = frames + self._out_chunks.get(rid, [])
+
+    def _finish(self, w: WorkItem, status: str, *, output=None,
+                error: Optional[str] = None):
+        """Terminal answer for one WorkItem: a local future settles
+        (and counts) here; a forwarded one goes to the outbox for its
+        origin door to settle and count."""
+        self._remote_live.discard(w.rid)
+        if w.req is not None:
+            if status == STATUS_OK:
+                doc = {"output": output,
+                       "weight_step": self.rs.weight_step}
+                if w.stream:
+                    doc["chunks"] = w.req.chunk_seq
+                settled = w.req.complete(doc, STATUS_OK)
+            else:
+                settled = w.req.complete(None, status, error or status)
+            if settled:
+                self.frontend.batcher.count(status)
+            return
+        doc = {"status": status, "weight_step": self.rs.weight_step}
+        if status == STATUS_OK:
+            doc["output"] = output
+            if w.stream:
+                doc["chunks"] = w.chunk_seq
+        else:
+            doc["error"] = error or status
+        self._out_complete[w.rid] = doc
+
+    def _emit_chunk(self, w: WorkItem, output):
+        """One stream chunk: straight onto the local future, or into
+        the outbox for the origin door. Every frame carries the step of
+        the weights that produced it (docs/serving.md "Streaming")."""
+        frame = {"seq": w.chunk_seq, "output": output,
+                 "weight_step": self.rs.weight_step}
+        if w.req is not None:
+            w.req.push_chunk(frame)
+            w.chunk_seq = w.req.chunk_seq
+        else:
+            self._out_chunks.setdefault(w.rid, []).append(frame)
+            w.chunk_seq += 1
 
     def _complete_batch(self, replies: List[dict]):
         batch = self._dispatching
+        self._dispatching = []
         if not batch:
             return
         results, errors = {}, {}
@@ -441,21 +686,49 @@ class ServingCoordinator:
             results.update(rep.get("results") or {})
             errors.update(rep.get("errors") or {})
         with self.rs._span("serve.reply", n=len(batch)):
-            for req in batch:
-                if req.id in results:
-                    if req.complete({"output": results[req.id],
-                                     "weight_step": self.rs.weight_step},
-                                    STATUS_OK):
-                        self.frontend.batcher.count(STATUS_OK)
-                elif req.id in errors:
-                    if req.complete(None, STATUS_ERROR, errors[req.id]):
-                        self.frontend.batcher.count(STATUS_ERROR)
+            for w in batch:
+                if w.rid in results:
+                    if w.stream:
+                        # One round == one chunk; the item re-enters
+                        # the dispatch queue until its chunk budget is
+                        # spent, then completes with a terminal frame.
+                        self._emit_chunk(w, results[w.rid])
+                        if w.chunk_seq >= w.n_chunks:
+                            self._finish(w, STATUS_OK,
+                                         output=results[w.rid])
+                        else:
+                            self._continuations.append(w)
+                    else:
+                        self._finish(w, STATUS_OK,
+                                     output=results[w.rid])
+                elif w.rid in errors:
+                    self._finish(w, STATUS_ERROR, error=errors[w.rid])
                 else:  # a slice lost to an evicted replica mid-round
-                    if req.complete(None, STATUS_ERROR,
-                                    "no replica answered"):
-                        self.frontend.batcher.count(STATUS_ERROR)
+                    self._finish(w, STATUS_ERROR,
+                                 error="no replica answered")
         self.rs._m_batches.inc()
-        self._dispatching = []
+
+    def _ingest_replies(self, replies: List[dict]):
+        """Forwarded admissions + fleet stop intent, off the reply
+        gather. Re-forwards of work already in flight dedup by rid."""
+        now = time.monotonic()
+        pending = 0
+        for rep in replies:
+            pending += int(rep.get("door_pending", 0))
+            if rep.get("stop_req"):
+                self.frontend.request_stop()
+            for doc in rep.get("admit") or []:
+                rid = str(doc.get("rid"))
+                if rid in self._remote_live:
+                    continue
+                w = WorkItem.from_admit(doc, now)
+                if w.expired(now):
+                    self._finish(w, STATUS_DEADLINE,
+                                 error="deadline expired in transit")
+                    continue
+                self._remote_live.add(rid)
+                self._remote_q.append(w)
+        self._door_pending = pending
 
     def _note_staged(self, replies: List[dict]):
         """Advance the swap state machine off the reply gather — the
@@ -471,39 +744,136 @@ class ServingCoordinator:
         self._all_staged = all(rep.get("staged") == target
                                for rep in replies)
 
+    # -- autoscale -------------------------------------------------------
+    def _maybe_autoscale(self) -> bool:
+        """One autoscaler pass between rounds; returns True when a
+        remesh round ran (the main loop restarts its cycle). Victims
+        are the highest non-door ranks — doors are never parked, so
+        `members` keeps its ascending-head-is-the-active-door
+        invariant; scale-up re-admits the lowest parked ranks."""
+        au = self.autoscaler
+        if au is None or not au.enabled:
+            return False
+        # The floor follows the LIVE door set — a failover that shrank
+        # the doors must not leave the fleet unable to shrink with it.
+        au.min_replicas = max(
+            len([d for d in self.rs.doors if d in self.rs.members]), 1)
+        plan = au.maybe(replicas=self.rs.world,
+                        parked=len(self.rs.parked),
+                        fallback_backlog=self.frontend.queue.depth())
+        if plan is None:
+            return False
+        action, target, _reason = plan
+        members = list(self.rs.members)
+        if action == SCALE_UP:
+            add = sorted(self.rs.parked)[:max(target - len(members), 0)]
+            if not add:
+                return False
+            new_members = sorted(members + add)
+            new_parked = [p for p in self.rs.parked if p not in add]
+        else:
+            victims = [m for m in sorted(members, reverse=True)
+                       if m not in self.rs.doors][
+                           :max(len(members) - target, 0)]
+            if not victims:
+                return False
+            new_members = [m for m in members if m not in victims]
+            new_parked = sorted(self.rs.parked + victims)
+        epoch = self.rs.door_epoch + 1
+        cmd = {"kind": "remesh", "members": new_members, "epoch": epoch}
+        self._attach_outbox(cmd)
+        try:
+            self.rs.run_round(cmd)
+        except HorovodInternalError as e:
+            self._restore_outbox(cmd)
+            self._evict_and_reroute(e)
+            return True
+        # Lease forward BEFORE the row goes out: the row at the bumped
+        # epoch is what makes every door's old lease look stale, and
+        # this door keeps admitting while the re-init below runs.
+        if self.rs.guard is not None:
+            self.rs.guard.renew(epoch)
+        # Row BEFORE the re-init: on a scale-up the parked ranks poll
+        # it and must arrive at the subset init with the same
+        # membership the participants re-init with — the init is the
+        # barrier, the row is the invitation.
+        doors_mod.publish_door_row(
+            self.rendezvous, epoch=epoch, door=self.rs.my_world,
+            doors=[d for d in self.rs.doors if d in new_members],
+            members=new_members)
+        self.rs.parked = new_parked
+        self.rs.remesh(new_members, epoch)
+        if self.on_remesh is not None:
+            self.on_remesh()
+        return True
+
     # -- the loop --------------------------------------------------------
     def run(self) -> dict:
-        self._dispatching: List = []
         while not self.rs.stopped:
             self._poll_weights()
             self._publish_load()
+            if self._maybe_autoscale():
+                continue
             cmd = self._next_command()
             try:
                 replies = self.rs.run_round(cmd)
             except HorovodInternalError as e:
+                self._restore_outbox(cmd)
                 self._evict_and_reroute(e)
                 continue
             if cmd["kind"] == "batch":
                 self._complete_batch(replies)
+            self._ingest_replies(replies)
             self._note_staged(replies)
+        # Parked ranks poll the door row; the stopped flag is their
+        # exit (parked_loop).
+        doors_mod.publish_door_row(
+            self.rendezvous, epoch=self.rs.door_epoch + 1,
+            door=self.rs.my_world,
+            doors=[d for d in self.rs.doors if d in self.rs.members],
+            members=self.rs.members, stopped=True)
         return self.rs.status()
 
     def _evict_and_reroute(self, exc: HorovodInternalError):
-        batch = getattr(self, "_dispatching", [])
+        batch = self._dispatching
+        self._dispatching = []
         try:
             self.rs.recover(exc)
         except BaseException:
-            # Recovery impossible: fail the in-flight batch loudly so
-            # no HTTP handler parks until its deadline.
-            for req in batch:
-                if req.complete(None, STATUS_SHUTDOWN, str(exc)):
-                    self.frontend.batcher.count(STATUS_SHUTDOWN)
+            # Recovery impossible: fail the in-flight work loudly so no
+            # HTTP handler parks until its deadline. Forwarded items
+            # have no route left — their origin doors settle them on
+            # their own recovery path.
+            for w in batch:
+                if w.req is not None:
+                    if w.req.complete(None, STATUS_SHUTDOWN, str(exc)):
+                        self.frontend.batcher.count(STATUS_SHUTDOWN)
             raise
-        # Survivors re-meshed: the interrupted batch reroutes. Head of
-        # the queue — it is the oldest admitted work.
-        if batch:
-            self.frontend.queue.requeue_front(batch)
-            self._dispatching = []
+        # Survivors re-meshed; we are still the active door (a
+        # coordinator that died would not be running this line), so
+        # re-publish the row at the bumped epoch: the election fence
+        # that makes any non-participant door's lease stale.
+        doors_mod.publish_door_row(
+            self.rendezvous, epoch=self.rs.door_epoch,
+            door=self.rs.my_world,
+            doors=[d for d in self.rs.doors if d in self.rs.members],
+            members=self.rs.members)
+        # The interrupted work reroutes. Fresh local requests go back
+        # at the HEAD of the queue (oldest admitted work); items with
+        # emitted chunks re-enter the continuation queue — the failed
+        # round's chunk was never delivered, so the replay cannot
+        # duplicate a frame — and forwarded items re-enter dispatch
+        # directly.
+        requeue: List = []
+        for w in reversed(batch):
+            if w.req is not None and w.chunk_seq == 0:
+                requeue.append(w.req)
+            elif w.chunk_seq > 0:
+                self._continuations.appendleft(w)
+            else:
+                self._remote_q.appendleft(w)
+        if requeue:
+            self.frontend.queue.requeue_front(list(reversed(requeue)))
         # A swap in flight re-arms conservatively: the lost round may
         # have flipped SOME survivors (broadcast landed, gather died),
         # so replies must re-prove staged/committed state on the new
@@ -514,14 +884,72 @@ class ServingCoordinator:
             self.on_remesh()
 
 
-def follower_loop(replica_set: ReplicaSet) -> dict:
-    """Every non-zero rank: execute rounds until STOP, recovering
-    through evictions exactly like the coordinator (each survivor's own
-    latched verdict names the same dead rank)."""
+def follower_loop(replica_set: ReplicaSet) -> str:
+    """Every non-zero rank: execute rounds until one of three exits —
+    ``"stop"`` (drain complete), ``"promote"`` (this rank just won a
+    door election: the caller must take over the rounds), or
+    ``"parked"`` (a scale-down remesh excluded this rank: the caller
+    waits in parked_loop). Evictions recover in lockstep with the
+    coordinator — each survivor's own latched verdict names the same
+    dead rank, so every participant bumps the same epoch."""
     rs = replica_set
     while not rs.stopped:
         try:
             rs.run_round(None)
         except HorovodInternalError as e:
-            rs.recover(e)
-    return rs.status()
+            _dead, coordinator_died = rs.recover(e)
+            if rs.door is not None:
+                rs.door.on_recovery(coordinator_died)
+            if rs.rank == 0:
+                return "promote"
+            continue
+        cmd = rs.last_cmd or {}
+        if cmd.get("kind") == "remesh":
+            members = [int(m) for m in cmd.get("members") or []]
+            gone = [m for m in rs.members if m not in members]
+            rs.parked = sorted({*rs.parked, *gone} - set(members))
+            if rs.my_world not in members:
+                basics.shutdown()
+                return "parked"
+            epoch = int(cmd.get("epoch", rs.door_epoch + 1))
+            if rs.guard is not None:
+                # Renew the lease the moment the cmd names this rank a
+                # participant: the coordinator publishes the bumped row
+                # before the re-init barrier, and a door must not
+                # answer 503-stale for the whole init window.
+                rs.guard.renew(epoch)
+            rs.remesh(members, epoch)
+    return "stop"
+
+
+def parked_loop(rs: ReplicaSet, kv, poll_s: float = 0.2) -> str:
+    """A scale-down victim's wait: poll the door row until a scale-up
+    re-admits this rank (``"rejoin"`` — the caller resumes its serving
+    role) or the fleet stops (``"stop"``). The rejoin is the same
+    subset init every re-mesh uses; the row carries the membership, so
+    the parked rank arrives at the collective with the same view as
+    the participants already blocking in it."""
+    while True:
+        row = doors_mod.read_door_row(kv)
+        if row is not None:
+            if row.get("stopped"):
+                rs.stopped = True
+                return "stop"
+            members = sorted(int(m) for m in row.get("members") or [])
+            epoch = int(row.get("epoch", 0))
+            if rs.my_world in members and epoch > rs.door_epoch:
+                basics.init(ranks=members)
+                rs.members = members
+                rs.door_epoch = epoch
+                rs.doors = sorted(int(d) for d in row.get("doors")
+                                  or rs.doors)
+                rs.parked = [p for p in rs.parked if p not in members]
+                rs._m_replicas.set(len(rs.members))
+                rs._update_lease()
+                if rs.on_reinit is not None:
+                    try:
+                        rs.on_reinit()
+                    except Exception:
+                        pass
+                return "rejoin"
+        time.sleep(poll_s)

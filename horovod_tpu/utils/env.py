@@ -337,6 +337,22 @@ SERVING_REQUEST_TIMEOUT = "HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS"
 # `ckpt/latest` row is best-effort and never gates discovery) for
 # newly-committed weights to hot-swap. 0 disables the watch.
 SERVING_WEIGHT_REFRESH = "HOROVOD_SERVING_WEIGHT_REFRESH_SECONDS"
+# Redundant front doors (docs/serving.md "Redundant front doors"):
+# the first N live ranks each open the HTTP frontend. Exactly one —
+# the lowest live rank — is the ACTIVE door that drives rounds; the
+# others admit against bounded-queue leases and forward through the
+# round protocol. The admission budget (SERVING_QUEUE_DEPTH) is split
+# across doors, agreed via the rendezvous-KV door row, never a lock.
+SERVING_DOORS = "HOROVOD_SERVING_DOORS"
+# Master switch for chunked streaming responses ({"stream": true}
+# requests). Off = streaming requests are answered unary (the final
+# chunk only); unary JSON stays the default either way.
+SERVING_STREAM = "HOROVOD_SERVING_STREAM"
+# Serving-autoscaler decision cadence. 0 (the default) disables the
+# policy loop; >0 makes the coordinator consume the serving/load KV
+# row every interval and grow/shrink the mesh via subset re-mesh
+# (cooldown = 3x the interval, PR 16 controller shape).
+SERVING_AUTOSCALE_INTERVAL = "HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS"
 
 DEFAULT_SERVING_MAX_BATCH = 32
 DEFAULT_SERVING_MAX_BATCH_TOKENS = 16384
@@ -344,6 +360,7 @@ DEFAULT_SERVING_MAX_DELAY_MS = 5.0
 DEFAULT_SERVING_QUEUE_DEPTH = 256
 DEFAULT_SERVING_REQUEST_TIMEOUT = 30.0
 DEFAULT_SERVING_WEIGHT_REFRESH = 10.0
+DEFAULT_SERVING_DOORS = 1
 
 # -- goodput plane knobs (docs/goodput.md) -----------------------------
 # Master switch for the step-accounting ledger (step demarcation,
@@ -857,6 +874,33 @@ def serving_weight_refresh_seconds() -> float:
     """Manifest-watch poll cadence; 0 disables weight hot-swap."""
     return max(get_float(SERVING_WEIGHT_REFRESH,
                          DEFAULT_SERVING_WEIGHT_REFRESH), 0.0)
+
+
+def serving_doors() -> int:
+    """How many ranks open redundant HTTP front doors; always >= 1
+    (door 0 is the classic single front door). Bogus values fall to
+    the default — a typo must never silently disable redundancy the
+    operator asked for, so it falls to 1 door, the safe baseline."""
+    try:
+        return max(get_int(SERVING_DOORS, DEFAULT_SERVING_DOORS), 1)
+    except ValueError:
+        return DEFAULT_SERVING_DOORS
+
+
+def serving_stream_enabled() -> bool:
+    """Streaming-response master switch; default on. Unary JSON stays
+    the default per request either way — this only gates whether a
+    {"stream": true} request may stream."""
+    return get_bool(SERVING_STREAM, True)
+
+
+def serving_autoscale_interval_seconds() -> float:
+    """Serving-autoscaler cadence in seconds; 0 (default, and the
+    fallback for bogus or negative values) disables the policy loop."""
+    try:
+        return max(get_float(SERVING_AUTOSCALE_INTERVAL, 0.0), 0.0)
+    except ValueError:
+        return 0.0
 
 
 def goodput_enabled() -> bool:

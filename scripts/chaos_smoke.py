@@ -30,6 +30,10 @@ to ``checkpoint_smoke``'s two-phase harness.
 concurrent HTTP load has one replica wedged mid-traffic; the liveness
 verdict evicts it, survivors re-mesh and every accepted request still
 completes. Delegates to ``serving_smoke``'s harness (its phase 3).
+Add ``--killdoor N`` to instead hard-kill the ACTIVE front door of a
+two-door fleet after N admissions (serving_smoke phases 4-5): the
+standby door must win the failover election with zero accepted-request
+loss.
 
     python scripts/chaos_smoke.py                 # 4 workers, kill rank 2 at step 3
     python scripts/chaos_smoke.py --np 8 --kill-rank 5 --kill-step 10
@@ -37,6 +41,7 @@ completes. Delegates to ``serving_smoke``'s harness (its phase 3).
     python scripts/chaos_smoke.py --wedge --hb-interval 0.5 --hb-miss 4
     python scripts/chaos_smoke.py --killall --kill-step 7
     python scripts/chaos_smoke.py --serving       # wedge a serving replica
+    python scripts/chaos_smoke.py --serving --killdoor 5  # kill the active door
 """
 from __future__ import annotations
 
@@ -119,6 +124,13 @@ def main() -> int:
                          "under concurrent HTTP load; the verdict "
                          "evicts it and every accepted request still "
                          "completes (docs/serving.md)")
+    ap.add_argument("--killdoor", type=int, default=None, metavar="N",
+                    help="with --serving: run ONLY the fleet phases — "
+                         "a killdoor:after=N chaos rule hard-kills the "
+                         "ACTIVE front door after N admissions; the "
+                         "standby door must win the election with zero "
+                         "accepted-request loss (docs/serving.md "
+                         "\"Failure drills\")")
     ap.add_argument("--interval", type=int, default=2,
                     help="HOROVOD_CHECKPOINT_INTERVAL_STEPS "
                          "(killall mode)")
@@ -214,7 +226,10 @@ def run_killall(args) -> int:
 
 def run_serving(args) -> int:
     """Serving-plane chaos: delegate to serving_smoke's harness with
-    the same wedge knobs this script uses (docs/serving.md)."""
+    the same wedge knobs this script uses (docs/serving.md). With
+    --killdoor N only the fleet phases run: the active front door is
+    hard-killed after N admissions and the standby door must take over
+    with zero accepted-request loss."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import serving_smoke
 
@@ -223,6 +238,9 @@ def run_serving(args) -> int:
                 "--wedge-rank", str(args.kill_rank),
                 "--hb-interval", str(args.hb_interval),
                 "--hb-miss", str(args.hb_miss)]
+    if args.killdoor is not None:
+        sys.argv += ["--fleet-only", "--killdoor-after",
+                     str(args.killdoor)]
     return serving_smoke.main()
 
 

@@ -16,6 +16,9 @@ epoch's drain marker), then renders:
 * the elasticity controller's last decision and any capacity grant —
   the ROADMAP item 5 operator surface for ``controller/last``;
 * an in-flight drain notice for the current epoch;
+* the serving fleet — the polled rank's ``/serving`` view, the KV door
+  row (active door, election epoch, door set, membership) and the
+  serving autoscaler's last decision (``serving``/``scale``);
 * the chronicle tail — the newest causally-ordered lifecycle events
   from the /events fleet fold (epoch, step cursor, rank, kind).
 
@@ -80,13 +83,20 @@ def gather(host: str, port: int, kv=None) -> dict:
         "goodput": fetch_json(host, port, "/goodput"),
         "alerts": fetch_json(host, port, "/alerts"),
         "events": fetch_json(host, port, "/events"),
+        "serving": fetch_json(host, port, "/serving"),
         "controller": None,
         "grant": None,
         "drain": None,
         "kv_epoch": None,
+        "serving_door": None,
+        "serving_scale": None,
+        "serving_load": None,
     }
     if kv is not None:
         snap["controller"] = _kv_json(kv, "controller", "last")
+        snap["serving_door"] = _kv_json(kv, "serving", "door")
+        snap["serving_scale"] = _kv_json(kv, "serving", "scale")
+        snap["serving_load"] = _kv_json(kv, "serving", "load")
         try:
             raw = kv.get("capacity", "grant")
             snap["grant"] = int(raw.decode()) if raw else None
@@ -228,6 +238,41 @@ def render(snap: dict, events_tail: int = 12) -> str:
             "DRAIN in flight: phase {p}  [{age}]".format(
                 p=drain.get("phase", "?"),
                 age=_age(drain.get("wall"), now)))
+
+    # Serving fleet (docs/serving.md): the polled rank's /serving view,
+    # the KV door row (active door + election epoch) and the serving
+    # autoscaler's last decision — same shape as the controller line.
+    sv = snap.get("serving")
+    door = snap.get("serving_door")
+    if sv or door:
+        lines.append("-" * 72)
+    if sv:
+        fe = sv.get("frontend") or {}
+        lines.append(
+            "serving: {role}  world {w}  weights step {ws}  "
+            "queue {q}  inflight {i}".format(
+                role=sv.get("role", "?"), w=sv.get("world", "?"),
+                ws=sv.get("weight_step", "?"),
+                q=fe.get("queue_depth", "-"),
+                i=fe.get("inflight", "-")))
+    if door:
+        lines.append(
+            "doors: active r{d}  epoch {e}  doors {ds}  members {m}"
+            "{stopped}  [{age}]".format(
+                d=door.get("door", "?"), e=door.get("epoch", "?"),
+                ds=door.get("doors", []), m=door.get("members", []),
+                stopped="  STOPPED" if door.get("stopped") else "",
+                age=_age(door.get("wall"), now)))
+    sc = snap.get("serving_scale")
+    if sc:
+        lines.append(
+            "serving autoscaler: {a}  replicas {c} -> {t}  backlog "
+            "{b:.0f}  ({reason})  [{age}]".format(
+                a=sc.get("action", "?"), c=sc.get("replicas", "?"),
+                t=sc.get("target", "?"),
+                b=float(sc.get("backlog", 0.0)),
+                reason=sc.get("reason", ""),
+                age=_age(sc.get("wall"), now)))
 
     # Chronicle tail: fleet fold when the coordinator serves it,
     # local ring otherwise.

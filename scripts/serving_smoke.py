@@ -21,10 +21,29 @@ continuous job:
    outage still completes (rerouted, never dropped). Every survivor's
    final report must NAME the wedged rank in its eviction verdict.
 
+Then a SECOND fresh mesh exercises the fleet features
+(docs/serving.md "Redundant front doors"):
+
+4. **Kill the ACTIVE front door mid-traffic** — two doors
+   (``HOROVOD_SERVING_DOORS=2``), continuous traffic through the
+   STANDBY door (forwarded over the round protocol; a streamed request
+   proves chunked ndjson end to end first), and a
+   ``killdoor:after=N`` chaos rule hard-kills rank 0 after N
+   admissions. The standby door must win the election (epoch bump,
+   verdict naming rank 0 on its ``/serving``) and EVERY request
+   accepted at the surviving door must answer 200 — zero loss.
+5. **Closed-loop autoscaler** — with
+   ``HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS=1``, idle traffic
+   shrinks the mesh toward the door floor (victims park), a 6-client
+   burst grows it back (parked ranks rejoin), p99 stays under 30s,
+   zero non-200, and ``serving.scale`` + ``serving.door_elected``
+   appear in the lifecycle journal.
+
 Run by scripts/ci.sh; also a manual repro tool:
 
     python scripts/serving_smoke.py
     python scripts/serving_smoke.py --np 4 --clients 8
+    python scripts/serving_smoke.py --fleet-only   # phases 4-5 only
 """
 from __future__ import annotations
 
@@ -70,12 +89,19 @@ WORKER = textwrap.dedent("""
     def to_weights(step, objects, trees):
         return {"w": float(np.asarray(trees["w"][0]))}
 
+    fwd_sleep = float(os.environ.get("SERVE_FORWARD_SLEEP", "0"))
+
     def model_fn(weights, payloads):
+        if fwd_sleep:
+            time.sleep(fwd_sleep * max(len(payloads), 1))
         return [weights["w"] * float(p) for p in payloads]
 
     source = CheckpointWeightSource(os.environ["SERVE_CKPT_DIR"],
                                     to_weights=to_weights)
-    port = int(os.environ["SERVE_PORT"]) if hvd.rank() == 0 else None
+    # Door ranks carry their own SERVE_PORT; non-door ranks never open
+    # a frontend so the value (or its absence) is inert for them.
+    port = (int(os.environ["SERVE_PORT"])
+            if os.environ.get("SERVE_PORT") else None)
     report_file = os.environ["SERVE_REPORT_FILE"]
     try:
         report = hvd.serving.serve(model_fn, weights={"w": 2.0},
@@ -83,12 +109,16 @@ WORKER = textwrap.dedent("""
                                    tick_seconds=0.1)
         with open(report_file, "w") as f:
             json.dump(report, f)
-        hvd.shutdown()
+        try:
+            hvd.shutdown()
+        except Exception:
+            pass  # a parked rank stopped while de-initialized
         sys.exit(0)
     except Exception as e:
         with open(report_file, "w") as f:
             json.dump({"error": str(e)}, f)
-        print(f"rank {hvd.rank()}: serve failed: {e}", flush=True)
+        rank = os.environ.get("HOROVOD_RANK", "?")
+        print(f"rank {rank}: serve failed: {e}", flush=True)
         sys.exit(42)
 """)
 
@@ -113,6 +143,24 @@ def _infer(port: int, value: float, timeout: float = 90.0):
         conn.close()
 
 
+def _infer_stream(port: int, value: float, chunks: int,
+                  timeout: float = 90.0):
+    """One streamed inference; returns (status, content-type, frames).
+    http.client undoes the chunked transfer-encoding; the body is
+    newline-delimited JSON frames (docs/serving.md "Streaming")."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/infer", json.dumps(
+            {"inputs": value, "stream": True, "chunks": chunks}))
+        r = conn.getresponse()
+        body = r.read().decode()
+        frames = [json.loads(ln) for ln in body.splitlines()
+                  if ln.strip()]
+        return r.status, r.getheader("Content-Type", ""), frames
+    finally:
+        conn.close()
+
+
 def _client_burst(port: int, n_clients: int, per_client: int,
                   value: float = 1.0, until=None):
     """N concurrent clients. Fixed work (`per_client` requests each),
@@ -132,6 +180,14 @@ def _client_burst(port: int, n_clients: int, per_client: int,
                 return
             try:
                 lat, status, body = _infer(port, value)
+                err = (body.get("error", "")
+                       if isinstance(body, dict) else "")
+                if status in (429, 503) and "retry" in err:
+                    # Documented-retryable rejection (backpressure or a
+                    # transiently stale door) — NOT an accepted request,
+                    # so it cannot count against zero-loss.
+                    time.sleep(0.05)
+                    continue
                 with lock:
                     lats.append(lat)
                     results.append((status, body))
@@ -156,28 +212,25 @@ def _quantile(sorted_vals, q):
     return sorted_vals[idx]
 
 
-def _get_view(port: int, path: str) -> dict:
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-    try:
-        conn.request("GET", path)
-        return json.loads(conn.getresponse().read())
-    finally:
-        conn.close()
+def _get_view(port: int, path: str, retry_s: float = 45.0) -> dict:
+    # A re-mesh re-inits the engine (metrics server included): a
+    # connection refused mid-poll is a transient, not a verdict.
+    deadline = time.monotonic() + retry_s
+    while True:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("GET", path)
+            return json.loads(conn.getresponse().read())
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.25)
+        finally:
+            conn.close()
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--np", dest="np_", type=int, default=4)
-    ap.add_argument("--clients", type=int, default=6,
-                    help="concurrent client threads (default 6)")
-    ap.add_argument("--per-client", type=int, default=8,
-                    help="requests per client per phase")
-    ap.add_argument("--wedge-rank", type=int, default=2)
-    ap.add_argument("--hb-interval", type=float, default=0.5)
-    ap.add_argument("--hb-miss", type=int, default=4)
-    ap.add_argument("--skip-wedge", action="store_true",
-                    help="phases 1-2 only (no chaos)")
-    args = ap.parse_args()
+def run_base(args) -> bool:
+    """Phases 1-3: one mesh, a single front door."""
     import numpy as np
 
     from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
@@ -416,6 +469,309 @@ def main() -> int:
                 if p.poll() is None:
                     p.kill()
             server.stop()
+    return ok
+
+
+def run_fleet(args) -> bool:
+    """Phases 4-5: redundant doors + killdoor failover + streaming +
+    the closed-loop serving autoscaler, on a FRESH mesh (the base mesh
+    already drained; fleet semantics deserve clean state)."""
+    from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
+    from horovod_tpu.runner.launch import slot_env
+    from horovod_tpu.runner.rendezvous_server import RendezvousServer
+
+    np_ = max(args.np_, 4)
+    door_ports = [_free_port(), _free_port()]
+    metrics_ports = [_free_port(), _free_port()]
+    server = RendezvousServer()
+    rdv_port = server.start()
+    ok = True
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+        ckpt_dir = os.path.join(td, "ckpt")
+        os.makedirs(ckpt_dir)
+        report_files = {}
+        slots = get_host_assignments(
+            parse_hosts(f"localhost:{np_}"), np_)
+        procs = {}
+        try:
+            for slot in slots:
+                env = dict(os.environ)
+                env.update(slot_env(slot, "127.0.0.1", rdv_port))
+                env["PYTHONPATH"] = REPO
+                env["HVDRUN_FORCE_LOCAL"] = "1"
+                env["HOROVOD_CYCLE_TIME"] = "1"
+                env["HOROVOD_TCP_TIMEOUT_SECONDS"] = "0"  # liveness only
+                env["HOROVOD_HEARTBEAT_INTERVAL_SECONDS"] = str(
+                    args.hb_interval)
+                env["HOROVOD_HEARTBEAT_MISS_LIMIT"] = str(args.hb_miss)
+                env["HOROVOD_SERVING_MAX_DELAY_MS"] = "5"
+                env["HOROVOD_SERVING_DOORS"] = "2"
+                env["HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS"] = "1.0"
+                # A touch of model latency so concurrent clients build
+                # real backlog — the autoscaler's input signal.
+                env["SERVE_FORWARD_SLEEP"] = "0.02"
+                env["SERVE_CKPT_DIR"] = ckpt_dir
+                report_files[slot.rank] = os.path.join(
+                    td, f"fleet_report_{slot.rank}.json")
+                env["SERVE_REPORT_FILE"] = report_files[slot.rank]
+                env.pop("HOROVOD_FAULT_INJECT", None)
+                env.pop("SERVE_WEDGE_TRIGGER", None)
+                env.pop("SERVE_PORT", None)
+                env.pop("HOROVOD_METRICS_PORT", None)
+                if slot.rank < 2:  # the two doors
+                    env["SERVE_PORT"] = str(door_ports[slot.rank])
+                    env["HOROVOD_METRICS_PORT"] = str(
+                        metrics_ports[slot.rank])
+                if slot.rank == 0:
+                    env["HOROVOD_FAULT_INJECT"] = (
+                        f"killdoor:after={args.killdoor_after}")
+                procs[slot.rank] = subprocess.Popen(
+                    [sys.executable, script], env=env)
+            print(f"fleet: spawned {np_} workers; active door "
+                  f":{door_ports[0]} (killdoor-armed), standby door "
+                  f":{door_ports[1]}", flush=True)
+
+            deadline = time.monotonic() + 120
+            for port in door_ports:
+                while True:
+                    try:
+                        _, status, body = _infer(port, 1.0)
+                        if status == 200:
+                            assert body["output"] == 2.0, body
+                            break
+                        # 503-stale / 429 while the fleet settles its
+                        # first leases: retryable by contract.
+                    except (ConnectionRefusedError, OSError):
+                        pass
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"door :{port} never came up")
+                    time.sleep(0.25)
+
+            # Streaming through the STANDBY door — a FORWARDED stream:
+            # chunks ride coordinator commands back to the origin door.
+            status, ctype, frames = _infer_stream(door_ports[1], 3.0, 3)
+            assert status == 200, (status, frames)
+            assert "ndjson" in ctype, ctype
+            data = [f for f in frames if not f.get("final")]
+            fin = [f for f in frames if f.get("final")]
+            assert len(data) >= 2, frames
+            assert all("weight_step" in f for f in data), frames
+            assert all(f.get("output") == 6.0 for f in data), frames
+            assert [f["seq"] for f in data] == list(range(len(data))), (
+                frames)
+            assert len(fin) == 1 and fin[0].get("status") == "ok", frames
+            # Unary stays the default wire shape.
+            _, status, body = _infer(door_ports[1], 1.0)
+            assert status == 200 and body.get("output") == 2.0, body
+            assert "final" not in body, body
+            print(f"streaming OK: {len(data)} chunks (each stamped "
+                  f"weight_step) + terminal frame through the standby "
+                  f"door; unary default intact", flush=True)
+
+            # -- phase 4: kill the ACTIVE door mid-traffic --------------
+            t4_results, t4_errors = [], []
+            t4_done = threading.Event()
+
+            def t4_traffic():
+                _, res, errs = _client_burst(
+                    door_ports[1], args.clients, args.per_client,
+                    value=1.0, until=t4_done)
+                t4_results.extend(res)
+                t4_errors.extend(errs)
+
+            t = threading.Thread(target=t4_traffic, daemon=True)
+            t.start()
+            try:
+                time.sleep(0.5)  # the burst is genuinely in flight
+                # The metrics endpoint serves on communicator rank 0
+                # only: before the kill that is world rank 0; AFTER the
+                # failover rank 1 re-inits as rank 0 and its endpoint
+                # (metrics_ports[1]) lights up — itself a signal the
+                # election happened.
+                view0 = _get_view(metrics_ports[0], "/serving")
+                w0 = view0["world"]
+                # Trip the killdoor: admissions at the ACTIVE door.
+                # The killing admission itself gets no response — that
+                # connection error is the fault, not a lost request.
+                for _ in range(args.killdoor_after + 3):
+                    if procs[0].poll() is not None:
+                        break
+                    try:
+                        _infer(door_ports[0], 1.0, timeout=10)
+                    except Exception:
+                        break
+                    time.sleep(0.05)
+                assert procs[0].wait(timeout=30) != 0  # died by design
+                deadline = time.monotonic() + 90
+                while True:
+                    try:
+                        view = _get_view(metrics_ports[1], "/serving")
+                        if (view.get("role") == "coordinator"
+                                and view.get("evictions", 0) >= 1
+                                and 0 not in view.get("members", [0])):
+                            break
+                    except OSError:
+                        view = None
+                    assert time.monotonic() < deadline, view
+                    time.sleep(0.5)
+            finally:
+                t4_done.set()
+            t.join()
+            assert not t4_errors, t4_errors[:3]
+            bad = [r for r in t4_results if r[0] != 200]
+            assert not bad, bad[:3]  # accepted at a survivor => answered
+            assert view.get("door") == 1, view
+            assert view.get("door_epoch", 0) >= 1, view
+            # A hard kill surfaces as the finalized transport text
+            # ("rank 1: recv from peer 0 failed"): the dead rank shows
+            # up as "peer 0".  A liveness verdict would say "rank 0 ...
+            # declared dead".  Either way rank 0 must be the one named.
+            assert any("peer 0" in v or "rank 0" in v
+                       for v in view["verdicts"]), view
+            print(f"phase 4 OK: active door killed after "
+                  f"{args.killdoor_after} admissions; door 1 won the "
+                  f"election (epoch {view['door_epoch']}, world "
+                  f"{w0}->{view['world']}), {len(t4_results)} "
+                  f"surviving-door requests all 200, verdict names "
+                  f"rank 0", flush=True)
+
+            # -- phase 5: the autoscaler closes the loop ----------------
+            # Idle: backlog ~0 per replica -> shrink toward the door
+            # floor; the victim parks.
+            w_now = view["world"]
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    v = _get_view(metrics_ports[1], "/serving")
+                    # Shrink observed — or the mesh already sits at the
+                    # door floor with everyone else parked (the idle
+                    # window before the kill may have drained it first).
+                    if v["world"] < w_now or (
+                            v["world"] <= len(v.get("doors", [1]))
+                            and v.get("parked")):
+                        break
+                except OSError:
+                    v = None
+                assert time.monotonic() < deadline, ("no scale-down", v)
+                time.sleep(0.3)
+            assert v.get("parked"), v
+            print(f"phase 5: idle shrink {w_now} -> {v['world']} "
+                  f"(parked {v['parked']})", flush=True)
+            # Idle traffic keeps shrinking the mesh all the way to the
+            # door floor (min_replicas tracks the live door count).
+            # Wait for it to settle there, else the grow check below
+            # races a further shrink: capture world=2, mesh shrinks to
+            # 1, grows back to 2 — and "> 2" never fires.
+            deadline = time.monotonic() + 60
+            while v["world"] > len(v.get("doors", [1])):
+                assert time.monotonic() < deadline, ("no floor", v)
+                time.sleep(0.3)
+                v = _get_view(metrics_ports[1], "/serving")
+            shrunk = v["world"]
+
+            t5_results, t5_errors, t5_lats = [], [], []
+            t5_done = threading.Event()
+
+            def t5_traffic():
+                lats, res, errs = _client_burst(
+                    door_ports[1], args.clients, args.per_client,
+                    value=2.0, until=t5_done)
+                t5_lats.extend(lats)
+                t5_results.extend(res)
+                t5_errors.extend(errs)
+
+            t = threading.Thread(target=t5_traffic, daemon=True)
+            t.start()
+            grew = False
+            try:
+                deadline = time.monotonic() + 90
+                while True:
+                    try:
+                        v = _get_view(metrics_ports[1], "/serving")
+                        if v["world"] > shrunk:
+                            grew = True
+                            break
+                    except OSError:
+                        v = None
+                    assert time.monotonic() < deadline, ("no scale-up", v)
+                    time.sleep(0.3)
+            finally:
+                t5_done.set()
+            t.join()
+            assert grew
+            assert not t5_errors, t5_errors[:3]
+            bad = [r for r in t5_results if r[0] != 200]
+            assert not bad, bad[:3]
+            t5_lats.sort()
+            p99 = _quantile(t5_lats, 0.99)
+            assert p99 < 30.0, p99  # the stated latency bound
+            ev = _get_view(metrics_ports[1], "/events")
+            rows = ((ev.get("fleet") or {}).get("events")
+                    or (ev.get("local") or {}).get("events") or [])
+            kinds = {d.get("kind") for d in rows}
+            assert "serving.scale" in kinds, kinds
+            assert "serving.door_elected" in kinds, kinds
+            print(f"phase 5 OK: grow back to {v['world']} under "
+                  f"{args.clients}-client traffic; {len(t5_results)} "
+                  f"requests all 200, p99={p99*1e3:.1f}ms; "
+                  f"serving.scale + serving.door_elected journaled",
+                  flush=True)
+
+            # -- graceful stop ------------------------------------------
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door_ports[1], timeout=30)
+            conn.request("POST", "/admin/stop")
+            assert conn.getresponse().status == 200
+            conn.close()
+            for r in sorted(procs):
+                if r == 0:
+                    continue  # the killdoor victim
+                rc = procs[r].wait(timeout=120)
+                if rc != 0:
+                    print(f"FAIL: fleet rank {r} exited {rc}",
+                          flush=True)
+                    ok = False
+            print(json.dumps({
+                "metric": "serving_fleet_smoke",
+                "requests": len(t4_results) + len(t5_results),
+                "p99_ms": round(p99 * 1e3, 2),
+            }))
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            server.stop()
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", dest="np_", type=int, default=4)
+    ap.add_argument("--clients", type=int, default=6,
+                    help="concurrent client threads (default 6)")
+    ap.add_argument("--per-client", type=int, default=8,
+                    help="requests per client per phase")
+    ap.add_argument("--wedge-rank", type=int, default=2)
+    ap.add_argument("--hb-interval", type=float, default=0.5)
+    ap.add_argument("--hb-miss", type=int, default=4)
+    ap.add_argument("--skip-wedge", action="store_true",
+                    help="phases 1-2 only (no chaos)")
+    ap.add_argument("--skip-fleet", action="store_true",
+                    help="phases 1-3 only (no doors/autoscaler mesh)")
+    ap.add_argument("--fleet-only", action="store_true",
+                    help="phases 4-5 only")
+    ap.add_argument("--killdoor-after", type=int, default=5,
+                    help="admissions before the chaos rule kills the "
+                         "active door (phase 4)")
+    args = ap.parse_args()
+    ok = True
+    if not args.fleet_only:
+        ok = run_base(args) and ok
+    if not args.skip_fleet:
+        ok = run_fleet(args) and ok
     print("PASS" if ok else "FAIL", flush=True)
     return 0 if ok else 1
 

@@ -1,0 +1,557 @@
+"""Fleet-serving tests (docs/serving.md "Redundant front doors",
+"Streaming responses", "Serving autoscaler"): door leases + the
+election epoch fence, the forwarding DoorManager (including the
+half-streamed-interruption guarantee), the serving/load KV row
+round-trip, the autoscaler policy + cooldown + change-only publish,
+killdoor spec parsing, env knobs, and a streaming HTTP end-to-end.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common.telemetry import MetricsRegistry
+from horovod_tpu.serving.batcher import (STATUS_ERROR, STATUS_OK,
+                                         STATUS_SHUTDOWN)
+from horovod_tpu.serving.doors import (DoorGuard, DoorManager, WorkItem,
+                                       admit_doc, lease_slots,
+                                       publish_door_row, read_door_row)
+
+
+class FakeKV:
+    """In-memory rendezvous-KV double (put/get bytes by scope/key)."""
+
+    def __init__(self):
+        self.store = {}
+
+    def put(self, scope, key, value):
+        self.store[(scope, key)] = value
+
+    def get(self, scope, key):
+        return self.store.get((scope, key))
+
+
+def _frontend(monkeypatch, port=0, **env):
+    from horovod_tpu.serving.frontend import InferenceFrontend
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    return InferenceFrontend(port=port, registry=MetricsRegistry()).start()
+
+
+def _http(port, method, path, body=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(method, path,
+                 json.dumps(body) if body is not None else None)
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read() or b"null"))
+    conn.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Leases, the door row, and the election epoch fence
+
+def test_lease_slots_split():
+    assert lease_slots(256, 2) == 128
+    assert lease_slots(256, 3) == 85
+    # Never below one slot: a door that cannot admit is not a door.
+    assert lease_slots(3, 8) == 1
+    assert lease_slots(0, 1) == 1
+    assert lease_slots(10, 0) == 10  # degenerate n_doors clamps to 1
+
+
+def test_door_row_roundtrip():
+    kv = FakeKV()
+    assert read_door_row(kv) is None
+    publish_door_row(kv, epoch=3, door=1, doors=[1, 2], members=[1, 2, 5])
+    row = read_door_row(kv)
+    assert row["epoch"] == 3 and row["door"] == 1
+    assert row["doors"] == [1, 2] and row["members"] == [1, 2, 5]
+    assert row["stopped"] is False and row["wall"] > 0
+    publish_door_row(kv, epoch=4, door=2, doors=[2], members=[2],
+                     stopped=True)
+    assert read_door_row(kv)["stopped"] is True
+    # No KV / a KV blink degrade to None, never raise.
+    publish_door_row(None, epoch=1, door=0, doors=[0], members=[0])
+    assert read_door_row(None) is None
+
+
+def test_door_guard_epoch_fence():
+    """The fence: a door that did NOT participate in a re-mesh sees a
+    newer row epoch and refuses to admit; participating (renew) moves
+    its lease forward."""
+    kv = FakeKV()
+    guard = DoorGuard(kv, epoch=1, slots=4, refresh_s=0.0)
+    publish_door_row(kv, epoch=1, door=0, doors=[0, 1], members=[0, 1])
+    assert not guard.stale()
+    # The fleet re-leased at epoch 2 without this door.
+    publish_door_row(kv, epoch=2, door=1, doors=[1], members=[1, 2])
+    assert guard.stale()
+    # Participation renews the lease (and may resplit the slots).
+    guard.renew(2, slots=8, active=False)
+    assert not guard.stale()
+    assert guard.slots == 8 and guard.active is False
+    # No KV = own epoch = never stale (the classic single door).
+    assert not DoorGuard(None, epoch=0).stale()
+
+
+def test_stale_door_rejects_admission_with_503(monkeypatch):
+    """A stale door's LATE admissions bounce: submit() -> None and the
+    HTTP surface answers 503 naming both epochs — not a seat in a
+    budget the fleet already re-leased."""
+    kv = FakeKV()
+    fe = _frontend(monkeypatch)
+    try:
+        fe.door_guard = DoorGuard(kv, epoch=1, refresh_s=0.0)
+        publish_door_row(kv, epoch=1, door=0, doors=[0], members=[0])
+        assert fe.submit("ok") is not None
+        publish_door_row(kv, epoch=5, door=1, doors=[1], members=[1])
+        assert fe.submit("late") is None
+        code, body = _http(fe.port, "POST", "/v1/infer", {"inputs": 1})
+        assert code == 503, body
+        assert "epoch 1" in body["error"] and "epoch 5" in body["error"]
+        snap = fe.registry.snapshot()
+        assert snap[
+            'horovod_serving_requests_total{status="rejected"}'] >= 2
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# WorkItem wire round-trip
+
+def test_workitem_admit_roundtrip_and_expiry(monkeypatch):
+    fe = _frontend(monkeypatch, port=None)
+    try:
+        req = fe.submit([1, 2], tokens=7, timeout_s=5.0, stream=True,
+                        chunks=3)
+        now = time.monotonic()
+        doc = admit_doc(req, origin=2, now=now)
+        assert doc["rid"] == f"2:{req.id}" and doc["origin"] == 2
+        assert 0 < doc["timeout_rem"] <= 5.0
+        # Rebuild on the coordinator: the deadline travels as REMAINING
+        # seconds (monotonic clocks do not compare across processes).
+        w = WorkItem.from_admit(doc, now=100.0)
+        assert w.rid == doc["rid"] and w.payload == [1, 2]
+        assert w.tokens == 7 and w.stream and w.n_chunks == 3
+        assert w.req is None and w.chunk_seq == 0
+        assert not w.expired(now=100.0)
+        assert w.expired(now=100.0 + doc["timeout_rem"])
+        # The local form keeps the future and the chunk cursor.
+        wl = WorkItem.from_local(req, origin=2)
+        assert wl.req is req and wl.rid == f"2:{req.id}"
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# DoorManager: forwarding, routed completion, failover fates
+
+def test_door_manager_forwards_and_settles(monkeypatch):
+    fe = _frontend(monkeypatch, port=None,
+                   HOROVOD_SERVING_MAX_DELAY_MS=0)
+    try:
+        dm = DoorManager(fe, my_world=3)
+        req = fe.submit(5.0)
+        rf = dm.reply_fields()
+        assert [d["rid"] for d in rf["admit"]] == [f"3:{req.id}"]
+        assert rf["stop_req"] is False
+        assert rf["door_pending"] == 1  # admitted, not yet answered
+        # Another origin's completion is ignored; ours settles.
+        dm.on_command({"complete": {
+            f"9:{req.id}": {"status": STATUS_OK, "output": 0.0},
+            f"3:{req.id}": {"status": STATUS_OK, "output": 10.0,
+                            "weight_step": 7},
+        }})
+        assert req.done and req.status == STATUS_OK
+        assert req.result == {"output": 10.0, "weight_step": 7}
+        assert dm.reply_fields()["door_pending"] == 0
+        snap = fe.registry.snapshot()
+        assert snap['horovod_serving_requests_total{status="ok"}'] == 1
+        # stop_req rises with the local stop flag.
+        fe.request_stop()
+        assert dm.reply_fields()["stop_req"] is True
+    finally:
+        fe.stop()
+
+
+def test_door_manager_recovery_fates(monkeypatch):
+    """After a re-mesh: unary forwards re-forward (idempotent — the
+    coordinator dedups by rid); a HALF-STREAMED forward survives a
+    replica loss but a coordinator loss ends it with an error frame —
+    a stream never silently hangs."""
+    fe = _frontend(monkeypatch, port=None)
+    try:
+        dm = DoorManager(fe, my_world=1)
+        unary = fe.submit(1.0)
+        stream = fe.submit(2.0, stream=True, chunks=4)
+        rf = dm.reply_fields()
+        assert len(rf["admit"]) == 2
+        # Two chunks landed before the fault.
+        dm.on_command({"chunks": {f"1:{stream.id}": [
+            {"seq": 0, "output": 4.0, "weight_step": 3},
+            {"seq": 1, "output": 4.0, "weight_step": 3},
+        ]}})
+        assert stream.chunk_seq == 2 and not stream.done
+        # Replica (non-coordinator) loss: the coordinator still holds
+        # the stream state — everything pends, the unary re-forwards.
+        dm.on_recovery(coordinator_died=False)
+        rf = dm.reply_fields()
+        assert [d["rid"] for d in rf["admit"]] == [f"1:{unary.id}"]
+        assert not stream.done
+        # Coordinator loss: the stream state died with it.
+        dm.on_recovery(coordinator_died=True)
+        assert stream.done and stream.status == STATUS_ERROR
+        frames = []
+        while True:
+            f = stream.next_chunk(0.1)
+            if f is None:
+                break
+            frames.append(f)
+        assert frames[-1]["final"] and frames[-1]["status"] == STATUS_ERROR
+        assert "failover" in frames[-1]["error"]
+        # The unary re-forwards once more; the origin future is intact.
+        rf = dm.reply_fields()
+        assert [d["rid"] for d in rf["admit"]] == [f"1:{unary.id}"]
+        assert not unary.done
+    finally:
+        fe.stop()
+
+
+def test_door_manager_promote_and_fail_pending(monkeypatch):
+    fe = _frontend(monkeypatch, port=None)
+    try:
+        dm = DoorManager(fe, my_world=1)
+        unary = fe.submit(1.0)
+        half = fe.submit(2.0, stream=True, chunks=3)
+        fresh_stream = fe.submit(3.0, stream=True, chunks=3)
+        dm.reply_fields()
+        dm.on_command({"chunks": {
+            f"1:{half.id}": [{"seq": 0, "output": 1.0}]}})
+        # This door WON the election: half-streamed ends loudly, the
+        # rest comes back in admission order for the head requeue.
+        keep = dm.promote()
+        assert keep == [unary, fresh_stream]
+        assert half.done and half.status == STATUS_ERROR
+        assert not dm.pending  # the manager is spent
+        # Terminal shutdown answers everything still pending.
+        dm2 = DoorManager(fe, my_world=1)
+        req = fe.submit(9.0)
+        dm2.reply_fields()
+        dm2.fail_pending("serving stopped")
+        assert req.done and req.status == STATUS_SHUTDOWN
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# Verdict attribution for hard kills
+
+def test_failed_rank_attribution_from_finalized_transport_text():
+    """A hard-killed door surfaces as a transport error finalized
+    through the engine: the structured .peer is lost and the TEXT
+    leads with the REPORTER ("rank 1: recv from peer 0 failed") — the
+    peer is the dead one. Grabbing the first "rank N" would make every
+    survivor declare ITSELF dead and end serving."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    from horovod_tpu.serving.replicas import failed_rank_from_error
+
+    assert failed_rank_from_error(HorovodInternalError(
+        "rank 1: recv from peer 0 failed: peer closed connection")) == 0
+    assert failed_rank_from_error(HorovodInternalError(
+        "rank 2: recv from peer 0 failed: [Errno 104] Connection "
+        "reset by peer")) == 0
+    # The liveness-verdict text still attributes the DECLARED rank.
+    assert failed_rank_from_error(HorovodInternalError(
+        "rank 2 (host x) declared dead by rank 0: no heartbeat")) == 2
+
+
+# ---------------------------------------------------------------------------
+# serving/load round-trip: coordinator publisher -> autoscaler consumer
+
+def test_serving_load_row_roundtrip(monkeypatch):
+    from horovod_tpu.serving.autoscaler import read_load
+    from horovod_tpu.serving.replicas import ServingCoordinator
+
+    kv = FakeKV()
+    assert read_load(kv) is None and read_load(None) is None
+    fe = _frontend(monkeypatch, port=None)
+    try:
+        fe.submit("queued")  # queue depth 1
+        coord = ServingCoordinator.__new__(ServingCoordinator)
+        coord.rendezvous = kv
+        coord.frontend = fe
+        coord._next_load_pub = 0.0
+        coord._dispatching = [object(), object()]
+        coord._remote_q = [object()]
+        coord._continuations = []
+
+        class RS:
+            world = 3
+            doors = [0, 1]
+            members = [0, 1, 4]
+            weight_step = 42
+
+        coord.rs = RS()
+        ServingCoordinator._publish_load(coord)
+        row = read_load(kv)
+        assert row["queue_depth"] == 1
+        # inflight = dispatching(2) + forwarded(1) + continuations(0)
+        # + queued(1): the fleet-wide admitted-but-unanswered signal.
+        assert row["inflight"] == 4
+        assert row["replicas"] == 3 and row["doors"] == 2
+        assert row["weight_step"] == 42 and row["time"] > 0
+        # Rate limit: an immediate second publish is a no-op.
+        fe.submit("another")
+        ServingCoordinator._publish_load(coord)
+        assert read_load(kv)["queue_depth"] == 1
+    finally:
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: pure policy, cooldown gate, change-only publish
+
+def test_autoscaler_decide_policy():
+    from horovod_tpu.serving.autoscaler import decide
+
+    up = decide(backlog=8, replicas=2, min_replicas=1, max_replicas=4)
+    assert up[0] == "scale_up" and up[1] == 3
+    down = decide(backlog=0, replicas=3, min_replicas=1, max_replicas=4)
+    assert down[0] == "scale_down" and down[1] == 2
+    # At the cap / at the floor: hold, whatever the backlog says.
+    assert decide(backlog=99, replicas=4, min_replicas=1,
+                  max_replicas=4)[0] == "hold"
+    assert decide(backlog=0, replicas=2, min_replicas=2,
+                  max_replicas=4)[0] == "hold"
+    # Between the watermarks: steady state.
+    assert decide(backlog=2, replicas=2, min_replicas=1,
+                  max_replicas=4)[0] == "hold"
+
+
+def test_autoscaler_cadence_cooldown_and_publish(monkeypatch):
+    from horovod_tpu.common import events as events_mod
+    from horovod_tpu.serving.autoscaler import ServingAutoscaler
+
+    emitted = []
+    monkeypatch.setattr(events_mod, "emit",
+                        lambda kind, **kw: emitted.append((kind, kw)))
+    kv = FakeKV()
+    reg = MetricsRegistry()
+    au = ServingAutoscaler(kv, interval=1.0, min_replicas=1,
+                           registry=reg)
+    assert au.enabled
+    assert not ServingAutoscaler(None, interval=1.0, registry=reg).enabled
+    assert not ServingAutoscaler(kv, interval=0, registry=reg).enabled
+    kv.put("serving", "load", json.dumps(
+        {"queue_depth": 9, "inflight": 2}).encode())
+    # backlog = max(queue_depth, inflight) = 9 over 2 replicas -> grow.
+    plan = au.maybe(replicas=2, parked=2, now=100.0)
+    assert plan is not None and plan[0] == "scale_up" and plan[1] == 3
+    # Off-cadence: no decision at all.
+    assert au.maybe(replicas=3, parked=1, now=100.5) is None
+    # On cadence but inside the cooldown (3x interval): vetoed to hold.
+    assert au.maybe(replicas=3, parked=1, now=101.5) is None
+    snap = reg.snapshot()
+    assert snap['horovod_serving_scale_decisions_total'
+                '{decision="scale_up"}'] == 1
+    assert snap['horovod_serving_scale_decisions_total'
+                '{decision="hold"}'] == 1
+    # Cooldown over, still hot -> grow again (cap = replicas + parked).
+    plan = au.maybe(replicas=3, parked=1, now=104.0)
+    assert plan is not None and plan[1] == 4
+    # The KV mirror row tracks the latest decision for hvdtop.
+    row = json.loads(kv.get("serving", "scale").decode())
+    assert row["action"] == "scale_up" and row["target"] == 4
+    # Journal on CHANGE only: two scale_ups at different targets = two
+    # events; the interleaved cooldown-hold is a third. No HOLD spam.
+    kinds = [k for k, _ in emitted]
+    assert kinds.count("serving.scale") == len(emitted) == 3
+    # Idle shrink respects the door floor via min_replicas.
+    kv.put("serving", "load", json.dumps(
+        {"queue_depth": 0, "inflight": 0}).encode())
+    au.min_replicas = 2
+    plan = au.maybe(replicas=4, parked=0, now=120.0)
+    assert plan is not None and plan[0] == "scale_down" and plan[1] == 3
+    assert au.maybe(replicas=2, parked=2, now=140.0) is None  # at floor
+
+
+# ---------------------------------------------------------------------------
+# killdoor chaos spec
+
+def test_killdoor_spec_parsing():
+    from horovod_tpu.common.fault_injection import parse_spec
+
+    (rule,) = parse_spec("killdoor:after=5")
+    assert rule.action == "killdoor" and rule.after == 5
+    with pytest.raises(ValueError):
+        parse_spec("killdoor:after=-1")
+    with pytest.raises(ValueError):
+        parse_spec("killdoor:op=send")  # op= is a transport-rule field
+
+
+def test_killdoor_counts_active_door_only(monkeypatch):
+    """A killdoor rule counts ACCEPTED admissions at the ACTIVE door
+    only — standby-door traffic must never trip it. (The lethal hit
+    itself is os._exit, so the test stays one hit short.)"""
+    from horovod_tpu.common import fault_injection as fi
+
+    inj = fi.FaultInjector()
+    monkeypatch.setenv("HOROVOD_FAULT_INJECT", "killdoor:after=2")
+    inj._load_env()
+    assert inj.active
+    for _ in range(5):
+        inj.check_door_admit(active=False)  # standby: never counts
+    inj.check_door_admit(active=True)
+    inj.check_door_admit(active=True)  # hit 2 == after: still alive
+    (rule,) = inj._rules
+    assert rule.hits == 2
+
+
+# ---------------------------------------------------------------------------
+# Env knobs (the parse-test satellite)
+
+def test_fleet_env_knob_parsing(monkeypatch):
+    from horovod_tpu.utils import env as env_cfg
+
+    for k in ("HOROVOD_SERVING_DOORS", "HOROVOD_SERVING_STREAM",
+              "HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS",
+              "HVD_TPU_SERVING_DOORS"):
+        monkeypatch.delenv(k, raising=False)
+    # Defaults: one door, streaming allowed, autoscaler off.
+    assert env_cfg.serving_doors() == 1
+    assert env_cfg.serving_stream_enabled() is True
+    assert env_cfg.serving_autoscale_interval_seconds() == 0.0
+    # Explicit values + floors.
+    monkeypatch.setenv("HOROVOD_SERVING_DOORS", "3")
+    assert env_cfg.serving_doors() == 3
+    monkeypatch.setenv("HOROVOD_SERVING_DOORS", "0")
+    assert env_cfg.serving_doors() == 1
+    monkeypatch.setenv("HOROVOD_SERVING_STREAM", "0")
+    assert env_cfg.serving_stream_enabled() is False
+    monkeypatch.setenv(
+        "HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS", "2.5")
+    assert env_cfg.serving_autoscale_interval_seconds() == 2.5
+    monkeypatch.setenv(
+        "HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS", "-3")
+    assert env_cfg.serving_autoscale_interval_seconds() == 0.0
+    # Bogus values fall to the defaults — a typo must never silently
+    # disable the redundancy (or enable a policy loop) the operator
+    # did not ask for.
+    monkeypatch.setenv("HOROVOD_SERVING_DOORS", "many")
+    assert env_cfg.serving_doors() == 1
+    monkeypatch.setenv(
+        "HOROVOD_SERVING_AUTOSCALE_INTERVAL_SECONDS", "fast")
+    assert env_cfg.serving_autoscale_interval_seconds() == 0.0
+    # The HVD_TPU_ alias prefix works here like everywhere else.
+    monkeypatch.delenv("HOROVOD_SERVING_DOORS")
+    monkeypatch.setenv("HVD_TPU_SERVING_DOORS", "2")
+    assert env_cfg.serving_doors() == 2
+
+
+# ---------------------------------------------------------------------------
+# Streaming HTTP end-to-end (one process, a fake completer thread)
+
+def _completer(fe, stop, weight_step=11):
+    """Stands in for the serving loop: one chunk per request per pass,
+    then the final completion — the coordinator's exact contract."""
+    while not stop.is_set():
+        batch = fe.batcher.next_batch(0.05)
+        for req in batch or []:
+            if req.stream:
+                for seq in range(req.n_chunks):
+                    req.push_chunk({"seq": seq,
+                                    "output": req.payload * 2,
+                                    "weight_step": weight_step})
+                req.complete({"output": req.payload * 2,
+                              "weight_step": weight_step}, STATUS_OK)
+            else:
+                req.complete({"output": req.payload * 2,
+                              "weight_step": weight_step}, STATUS_OK)
+
+
+def _stream(port, body):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/v1/infer", json.dumps(body))
+    resp = conn.getresponse()
+    ctype = resp.getheader("Content-Type", "")
+    raw = resp.read()
+    conn.close()
+    if "ndjson" in ctype:
+        frames = [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
+    else:
+        frames = json.loads(raw or b"null")
+    return resp.status, ctype, frames
+
+
+def test_streaming_http_end_to_end(monkeypatch):
+    fe = _frontend(monkeypatch, HOROVOD_SERVING_MAX_DELAY_MS=0)
+    stop = threading.Event()
+    t = threading.Thread(target=_completer, args=(fe, stop), daemon=True)
+    t.start()
+    try:
+        status, ctype, frames = _stream(
+            fe.port, {"inputs": 3.0, "stream": True, "chunks": 3})
+        assert status == 200 and "ndjson" in ctype
+        data = [f for f in frames if not f.get("final")]
+        fin = [f for f in frames if f.get("final")]
+        assert len(data) == 3, frames
+        assert [f["seq"] for f in data] == [0, 1, 2]
+        # Every chunk proves which weights produced it.
+        assert all(f["weight_step"] == 11 for f in data)
+        assert all(f["output"] == 6.0 for f in data)
+        assert len(fin) == 1 and fin[0]["status"] == STATUS_OK
+        assert fin[0]["chunks"] == 3
+        # Unary JSON stays the default wire shape.
+        status, ctype, body = _stream(fe.port, {"inputs": 2.0})
+        assert status == 200 and "ndjson" not in ctype
+        assert body == {"output": 4.0, "weight_step": 11}
+        assert fe.registry.counter(
+            "horovod_serving_streamed_chunks_total").value == 3
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        fe.stop()
+
+
+def test_streaming_master_switch_answers_unary(monkeypatch):
+    """HOROVOD_SERVING_STREAM=0: a {"stream": true} request is served
+    as plain unary JSON — the switch gates the wire shape only, never
+    drops the request."""
+    fe = _frontend(monkeypatch, HOROVOD_SERVING_MAX_DELAY_MS=0,
+                   HOROVOD_SERVING_STREAM=0)
+    stop = threading.Event()
+    t = threading.Thread(target=_completer, args=(fe, stop), daemon=True)
+    t.start()
+    try:
+        status, ctype, body = _stream(
+            fe.port, {"inputs": 5.0, "stream": True, "chunks": 3})
+        assert status == 200 and "ndjson" not in ctype
+        assert body["output"] == 10.0 and body["weight_step"] == 11
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        fe.stop()
+
+
+def test_stream_deadline_mid_wait_terminal_frame(monkeypatch):
+    """An admitted-but-never-dispatched streaming request answers at
+    its deadline exactly like unary (504 semantics, before any bytes
+    hit the wire) — not a hang."""
+    fe = _frontend(monkeypatch,
+                   HOROVOD_SERVING_REQUEST_TIMEOUT_SECONDS=0.1)
+    try:
+        t0 = time.monotonic()
+        status, ctype, body = _stream(
+            fe.port, {"inputs": 1.0, "stream": True, "chunks": 3})
+        assert status == 504, (status, body)
+        assert time.monotonic() - t0 < 5
+        assert "deadline" in body["error"]
+    finally:
+        fe.stop()
